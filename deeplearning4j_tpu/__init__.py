@@ -1,0 +1,12 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework with the
+capabilities of Deeplearning4j (reference: Willdata/deeplearning4j).
+
+Architecture (SURVEY.md §8): whole-model training steps compile to single XLA
+computations via jax/pjit; the reference's per-op JNI dispatch, workspaces,
+and Aeron gradient mesh are replaced by XLA fusion, buffer donation, and
+ICI/DCN collectives emitted from sharding annotations.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.environment import environment, Environment

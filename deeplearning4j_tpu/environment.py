@@ -1,0 +1,117 @@
+"""Process-level configuration: the framework's single documented flag registry.
+
+Reference parity: ND4J's ``ND4JSystemProperties`` / ``ND4JEnvironmentVars``
+(nd4j-common, org.nd4j.common.config) and libnd4j's ``Environment`` singleton
+(libnd4j/include/system/Environment.h) expose debug/verbose/profiling switches,
+memory limits, and backend selection as JVM system properties + env vars.
+
+TPU-native realization: one Python singleton backed by ``DL4J_TPU_*`` env vars,
+plus passthroughs to the JAX config plane (``jax_debug_nans``,
+``jax_default_matmul_precision``) which play the role the CUDA environment
+(CudaEnvironment.getConfiguration()) played in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+_PREFIX = "DL4J_TPU_"
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(_PREFIX + name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(_PREFIX + name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(_PREFIX + name)
+    return int(v) if v is not None else default
+
+
+@dataclasses.dataclass
+class Environment:
+    """Global runtime flags. Mirrors libnd4j Environment + ND4JSystemProperties.
+
+    Access via :func:`environment` — a process-wide singleton.
+    """
+
+    # -- debug plane (libnd4j Environment::setDebug/setVerbose) --------------
+    debug: bool = dataclasses.field(default_factory=lambda: _env_bool("DEBUG", False))
+    verbose: bool = dataclasses.field(default_factory=lambda: _env_bool("VERBOSE", False))
+    # NaN/Inf panic: ND4J OpProfiler checkForNAN/checkForINF analog; routes to
+    # jax.config.jax_debug_nans when enabled.
+    check_nan: bool = dataclasses.field(default_factory=lambda: _env_bool("CHECK_NAN", False))
+
+    # -- numeric policy -------------------------------------------------------
+    # Default floating dtype for parameters (DL4J: DataType.FLOAT default;
+    # gradient checks switch to DOUBLE — tests do the same via set_default_dtype).
+    default_dtype: str = dataclasses.field(default_factory=lambda: _env_str("DTYPE", "float32"))
+    # Compute dtype for matmul/conv-heavy paths; bfloat16 keeps the MXU fed.
+    compute_dtype: str = dataclasses.field(default_factory=lambda: _env_str("COMPUTE_DTYPE", "bfloat16"))
+    matmul_precision: str = dataclasses.field(
+        default_factory=lambda: _env_str("MATMUL_PRECISION", "default")
+    )
+
+    # -- layout policy (SURVEY §8.3 hard part 3) ------------------------------
+    # Reference is NCHW-default (cuDNN heritage). Internally we are NHWC for
+    # TPU-friendly layouts; NCHW is accepted at the API edge and transposed.
+    prefer_nhwc: bool = dataclasses.field(default_factory=lambda: _env_bool("PREFER_NHWC", True))
+
+    # -- profiling plane (OpProfiler / ProfilingListener) ---------------------
+    profiling: bool = dataclasses.field(default_factory=lambda: _env_bool("PROFILING", False))
+    profile_dir: str = dataclasses.field(default_factory=lambda: _env_str("PROFILE_DIR", "/tmp/dl4j_tpu_profile"))
+
+    # -- platform-helper selection (cuDNN helper analog, SURVEY §3.1) ---------
+    # "auto": pick Pallas kernels on TPU where registered, XLA elsewhere.
+    # "xla": force XLA lowering. "pallas": force custom kernels where available.
+    helper_mode: str = dataclasses.field(default_factory=lambda: _env_str("HELPERS", "auto"))
+    log_helper_selection: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("LOG_HELPERS", False)
+    )
+
+    # -- distributed ----------------------------------------------------------
+    coordinator_address: Optional[str] = dataclasses.field(
+        default_factory=lambda: os.environ.get(_PREFIX + "COORDINATOR") or None
+    )
+    num_processes: int = dataclasses.field(default_factory=lambda: _env_int("NUM_PROCESSES", 1))
+    process_id: int = dataclasses.field(default_factory=lambda: _env_int("PROCESS_ID", 0))
+
+    def apply_jax_config(self) -> None:
+        """Push flags into the JAX config plane. Call once at startup."""
+        import jax
+
+        if self.check_nan:
+            jax.config.update("jax_debug_nans", True)
+        if self.matmul_precision != "default":
+            jax.config.update("jax_default_matmul_precision", self.matmul_precision)
+        if self.default_dtype == "float64":
+            jax.config.update("jax_enable_x64", True)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+_INSTANCE: Optional[Environment] = None
+
+
+def environment() -> Environment:
+    """The process-wide Environment singleton (libnd4j Environment::getInstance)."""
+    global _INSTANCE
+    if _INSTANCE is None:
+        _INSTANCE = Environment()
+    return _INSTANCE
+
+
+def reset_environment() -> Environment:
+    """Re-read env vars (tests only)."""
+    global _INSTANCE
+    _INSTANCE = Environment()
+    return _INSTANCE

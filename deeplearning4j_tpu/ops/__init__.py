@@ -1,0 +1,18 @@
+"""Tensor/op layer — the ND4J + libnd4j role, collapsed.
+
+The reference's L4 (INDArray/op classes) + L2 (libnd4j kernels) layers become:
+jax.Array + a named op catalog lowering to XLA. Importing this package
+populates the global op registry.
+"""
+
+from deeplearning4j_tpu.ops.registry import registry, op, exec_op, OpRegistry
+from deeplearning4j_tpu.ops import nn_ops, activations, losses, random, compression, weight_init
+from deeplearning4j_tpu.ops.activations import get_activation, ACTIVATIONS
+from deeplearning4j_tpu.ops.losses import get_loss, LOSSES
+from deeplearning4j_tpu.ops.weight_init import init_weights
+
+__all__ = [
+    "registry", "op", "exec_op", "OpRegistry",
+    "nn_ops", "activations", "losses", "random", "compression", "weight_init",
+    "get_activation", "ACTIVATIONS", "get_loss", "LOSSES", "init_weights",
+]

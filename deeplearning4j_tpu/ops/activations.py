@@ -1,0 +1,157 @@
+"""Activation catalog — parity with ND4J's IActivation implementations.
+
+Reference: org.nd4j.linalg.activations.Activation enum + impl classes
+(nd4j-api, org/nd4j/linalg/activations/impl/*). Each reference impl carries a
+hand-written backprop method; here gradients come from jax autodiff, so an
+activation is just a pure function. The *name set* below matches the
+reference's Activation enum so JSON configs round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def identity(x):
+    return x
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def leakyrelu(x, alpha: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def gelu(x):
+    # Reference GELU (ActivationGELU) uses the tanh approximation by default.
+    return jax.nn.gelu(x, approximate=True)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return jax.nn.mish(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def rationaltanh(x):
+    # ActivationRationalTanh: 1.7159 * tanh_approx(2x/3) using a rational
+    # approximation f(x) = clip-free algebraic tanh; we follow the published
+    # formula tanh_approx(y) = sign(y) * (1 - 1/(1+|y|+y^2+1.41645*y^4)).
+    y = 2.0 * x / 3.0
+    a = jnp.abs(y)
+    approx = 1.0 - 1.0 / (1.0 + a + y * y + 1.41645 * (y ** 4))
+    return 1.7159 * jnp.sign(y) * approx
+
+
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def cube(x):
+    return x ** 3
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def logsoftmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def thresholdedrelu(x, theta: float = 1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+def rrelu(x, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0):
+    # Deterministic (inference-mode) RReLU: slope = mean of the range, matching
+    # the reference's test-time behavior of ActivationRReLU.
+    return jnp.where(x >= 0, x, x * ((lower + upper) / 2.0))
+
+
+def prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+# Name table == Activation enum surface (lowercased, as Jackson serializes).
+ACTIVATIONS: Dict[str, Callable] = {
+    "identity": identity,
+    "linear": identity,
+    "relu": relu,
+    "relu6": relu6,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "gelu": gelu,
+    "swish": swish,
+    "mish": mish,
+    "sigmoid": sigmoid,
+    "hardsigmoid": hardsigmoid,
+    "tanh": tanh,
+    "hardtanh": hardtanh,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "softplus": softplus,
+    "softsign": softsign,
+    "cube": cube,
+    "softmax": softmax,
+    "logsoftmax": logsoftmax,
+    "thresholdedrelu": thresholdedrelu,
+    "rrelu": rrelu,
+}
+
+
+def get_activation(name_or_fn) -> Callable:
+    """Resolve an activation by enum name (case-insensitive) or callable."""
+    if callable(name_or_fn):
+        return name_or_fn
+    name = str(name_or_fn).lower()
+    try:
+        return ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation '{name_or_fn}'; known: {sorted(ACTIVATIONS)}"
+        ) from None

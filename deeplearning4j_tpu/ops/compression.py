@@ -1,0 +1,117 @@
+"""Threshold gradient compression — the Strom-2015 codec the reference uses
+for asynchronous gradient sharing.
+
+Reference: native ops encode_threshold / decode_threshold (+ encode_bitmap)
+in libnd4j (ops/declarable/generic/compression/threshold.cpp [M]) driven by
+DL4J's EncodedGradientsAccumulator + AdaptiveThresholdAlgorithm
+(org/deeplearning4j/optimize/solvers/accumulation/**).
+
+TPU-native disposition (SURVEY §3.5/§6.8): the *synchronous* ICI all-reduce
+path doesn't need compression at all; this codec survives as an optional
+DCN-crossing compressor and as capability parity. On TPU we keep the encoded
+form DENSE-shaped (fixed-size index buffer) so shapes stay static under jit:
+``encode_threshold`` returns (indices[int32, K], signs[int8, K], count) with K
+a static capacity, plus the residual; entries beyond ``count`` are -1 padding.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import op
+
+
+class ThresholdEncoded(NamedTuple):
+    indices: jax.Array  # int32 [capacity], -1 padded
+    signs: jax.Array    # int8 [capacity]
+    count: jax.Array    # int32 scalar — number of valid entries
+    threshold: jax.Array  # f32 scalar — the tau used
+
+
+@op("encode_threshold")
+def encode_threshold(grad, *, threshold: float, capacity: int) -> Tuple[ThresholdEncoded, jax.Array]:
+    """Sparse-encode entries with |g| > tau as (index, sign); residual keeps the
+    rest PLUS the sub-threshold remainder of encoded entries, exactly like the
+    reference: decoded value is +/- tau, residual = g - decoded.
+
+    Returns (encoded, residual). Static shapes: capacity bounds the number of
+    encoded entries; overflow entries stay in the residual (matches the
+    reference's behavior of bounding message size).
+    """
+    flat = grad.reshape(-1)
+    tau = jnp.asarray(threshold, flat.dtype)
+    mask = jnp.abs(flat) > tau
+    # Rank entries: all above-threshold first, in index order (stable).
+    order = jnp.argsort(~mask, stable=True)  # True(above) sorts first
+    top = order[:capacity]
+    valid = mask[top]
+    count = jnp.sum(mask).astype(jnp.int32)
+    kept = jnp.minimum(count, capacity)
+    indices = jnp.where(valid, top.astype(jnp.int32), -1)
+    signs = jnp.where(valid, jnp.sign(flat[top]), 0.0).astype(jnp.int8)
+    decoded_vals = jnp.where(valid, jnp.sign(flat[top]) * tau, 0.0)
+    residual = flat.at[jnp.where(valid, top, flat.shape[0] - 1)].add(
+        jnp.where(valid, -decoded_vals, 0.0)
+    )
+    enc = ThresholdEncoded(indices=indices, signs=signs, count=kept,
+                           threshold=tau.astype(jnp.float32))
+    return enc, residual.reshape(grad.shape)
+
+
+@op("decode_threshold")
+def decode_threshold(encoded: ThresholdEncoded, *, shape) -> jax.Array:
+    """Densify an encoded update: out[idx] += sign * tau."""
+    size = 1
+    for s in shape:
+        size *= int(s)
+    out = jnp.zeros((size,), jnp.float32)
+    valid = encoded.indices >= 0
+    safe_idx = jnp.where(valid, encoded.indices, 0)
+    vals = jnp.where(valid, encoded.signs.astype(jnp.float32) * encoded.threshold, 0.0)
+    out = out.at[safe_idx].add(vals)
+    return out.reshape(shape)
+
+
+@op("encode_bitmap")
+def encode_bitmap(grad, *, threshold: float):
+    """Bitmap variant (reference encode_bitmap): 2-bit code per entry
+    {0: below, 1: +tau, 2: -tau}; here an int8 map + residual."""
+    tau = jnp.asarray(threshold, grad.dtype)
+    code = jnp.where(grad > tau, 1, jnp.where(grad < -tau, 2, 0)).astype(jnp.int8)
+    decoded = jnp.where(code == 1, tau, jnp.where(code == 2, -tau, 0.0))
+    residual = grad - decoded
+    return code, residual
+
+
+@op("decode_bitmap")
+def decode_bitmap(code, *, threshold: float, dtype=jnp.float32):
+    tau = jnp.asarray(threshold, dtype)
+    return jnp.where(code == 1, tau, jnp.where(code == 2, -tau, 0.0)).astype(dtype)
+
+
+class AdaptiveThreshold:
+    """AdaptiveThresholdAlgorithm parity: adjusts tau toward a target sparsity.
+
+    Reference keeps the last iteration's encoding ratio and multiplies/divides
+    tau by a decay factor to chase a target fraction of encoded elements.
+    Pure-python state, used at orchestration level.
+    """
+
+    def __init__(self, initial: float = 1e-3, target_sparsity: float = 1e-3,
+                 decay: float = 1.2, min_threshold: float = 1e-6,
+                 max_threshold: float = 1.0):
+        self.threshold = float(initial)
+        self.target = float(target_sparsity)
+        self.decay = float(decay)
+        self.min = float(min_threshold)
+        self.max = float(max_threshold)
+
+    def update(self, encoded_fraction: float) -> float:
+        if encoded_fraction > self.target * 1.5:
+            self.threshold = min(self.threshold * self.decay, self.max)
+        elif encoded_fraction < self.target / 1.5:
+            self.threshold = max(self.threshold / self.decay, self.min)
+        return self.threshold

@@ -1,0 +1,183 @@
+"""Loss-function catalog — parity with ND4J ILossFunction implementations.
+
+Reference: org/nd4j/linalg/lossfunctions/impl/* (LossMCXENT, LossMSE,
+LossBinaryXENT, LossL1/L2, LossHinge, LossSquaredHinge, LossKLD, LossMAPE,
+LossMSLE, LossPoisson, LossCosineProximity, LossNegativeLogLikelihood,
+LossSparseMCXENT, LossWasserstein, LossFMeasure...). Each reference impl
+hand-codes computeGradient; here gradients are autodiff'd, so a loss is a pure
+function (predictions, labels, mask) -> scalar mean score per example,
+averaged like the reference's computeScore(average=true).
+
+All losses accept an optional per-example (or per-timestep) mask array and a
+per-output weight vector, matching ILossFunction's signature
+(labels, preOutput, activationFn, mask). Activation is applied by the caller
+(output layer) — except the fused softmax/sigmoid cross-entropy paths which
+mirror the reference's numerically-stable special cases.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _apply_mask_and_mean(per_example, mask):
+    """per_example: [B] or [B,T] score per example; mask broadcastable."""
+    if mask is not None:
+        m = mask.astype(per_example.dtype)
+        while m.ndim > per_example.ndim:
+            m = m.squeeze(-1)
+        per_example = per_example * m
+        return jnp.sum(per_example) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(per_example)
+
+
+def _reduce_feature_axis(x, weights=None):
+    if weights is not None:
+        x = x * weights
+    return jnp.sum(x, axis=-1)
+
+
+def mcxent(probs, labels, mask=None, weights=None, *, eps: float = 1e-8):
+    """Multi-class cross entropy on probabilities (LossMCXENT)."""
+    ll = labels * jnp.log(jnp.clip(probs, eps, 1.0))
+    return _apply_mask_and_mean(-_reduce_feature_axis(ll, weights), mask)
+
+
+def softmax_cross_entropy_with_logits(logits, labels, mask=None, weights=None):
+    """Fused stable softmax+CE (the path LossMCXENT takes with softmax)."""
+    lse = jax.nn.log_softmax(logits, axis=-1)
+    return _apply_mask_and_mean(-_reduce_feature_axis(labels * lse, weights), mask)
+
+
+def sparse_mcxent(logits, label_ids, mask=None):
+    """LossSparseMCXENT: integer labels, stable log-softmax gather."""
+    lse = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(lse, label_ids[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return _apply_mask_and_mean(-ll, mask)
+
+
+def negative_log_likelihood(probs, labels, mask=None, weights=None):
+    """LossNegativeLogLikelihood — same math as MCXENT in the reference."""
+    return mcxent(probs, labels, mask, weights)
+
+
+def binary_xent(probs, labels, mask=None, weights=None, *, eps: float = 1e-8):
+    """LossBinaryXENT on probabilities (sigmoid applied by caller)."""
+    p = jnp.clip(probs, eps, 1.0 - eps)
+    ll = labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p)
+    return _apply_mask_and_mean(-_reduce_feature_axis(ll, weights), mask)
+
+
+def sigmoid_cross_entropy_with_logits(logits, labels, mask=None, weights=None):
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _apply_mask_and_mean(_reduce_feature_axis(per, weights), mask)
+
+
+def mse(preds, labels, mask=None, weights=None):
+    """LossMSE: mean over feature axis is a SUM in the reference (per-example
+    score = sum of squared errors / nOut handled via MEAN_SQUARED naming);
+    DL4J LossMSE averages over the output dimension."""
+    per = (preds - labels) ** 2
+    if weights is not None:
+        per = per * weights
+    return _apply_mask_and_mean(jnp.mean(per, axis=-1), mask)
+
+
+def l2(preds, labels, mask=None, weights=None):
+    """LossL2: sum of squared errors (no /nOut)."""
+    return _apply_mask_and_mean(_reduce_feature_axis((preds - labels) ** 2, weights), mask)
+
+
+def mae(preds, labels, mask=None, weights=None):
+    per = jnp.abs(preds - labels)
+    if weights is not None:
+        per = per * weights
+    return _apply_mask_and_mean(jnp.mean(per, axis=-1), mask)
+
+
+def l1(preds, labels, mask=None, weights=None):
+    return _apply_mask_and_mean(_reduce_feature_axis(jnp.abs(preds - labels), weights), mask)
+
+
+def mape(preds, labels, mask=None, weights=None, *, eps: float = 1e-8):
+    per = jnp.abs((labels - preds) / jnp.maximum(jnp.abs(labels), eps)) * 100.0
+    if weights is not None:
+        per = per * weights
+    return _apply_mask_and_mean(jnp.mean(per, axis=-1), mask)
+
+
+def msle(preds, labels, mask=None, weights=None):
+    per = (jnp.log1p(jnp.maximum(preds, -1 + 1e-7)) - jnp.log1p(jnp.maximum(labels, -1 + 1e-7))) ** 2
+    if weights is not None:
+        per = per * weights
+    return _apply_mask_and_mean(jnp.mean(per, axis=-1), mask)
+
+
+def poisson(preds, labels, mask=None, weights=None, *, eps: float = 1e-8):
+    per = preds - labels * jnp.log(jnp.maximum(preds, eps))
+    return _apply_mask_and_mean(_reduce_feature_axis(per, weights), mask)
+
+
+def kl_divergence(preds, labels, mask=None, weights=None, *, eps: float = 1e-8):
+    per = labels * (jnp.log(jnp.clip(labels, eps, 1.0)) - jnp.log(jnp.clip(preds, eps, 1.0)))
+    return _apply_mask_and_mean(_reduce_feature_axis(per, weights), mask)
+
+
+def hinge(preds, labels, mask=None, weights=None):
+    """LossHinge: labels in {-1, +1}."""
+    per = jnp.maximum(0.0, 1.0 - labels * preds)
+    return _apply_mask_and_mean(_reduce_feature_axis(per, weights), mask)
+
+
+def squared_hinge(preds, labels, mask=None, weights=None):
+    per = jnp.maximum(0.0, 1.0 - labels * preds) ** 2
+    return _apply_mask_and_mean(_reduce_feature_axis(per, weights), mask)
+
+
+def cosine_proximity(preds, labels, mask=None, weights=None, *, eps: float = 1e-8):
+    pn = preds / jnp.maximum(jnp.linalg.norm(preds, axis=-1, keepdims=True), eps)
+    ln = labels / jnp.maximum(jnp.linalg.norm(labels, axis=-1, keepdims=True), eps)
+    per = -jnp.sum(pn * ln, axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def wasserstein(preds, labels, mask=None, weights=None):
+    """LossWasserstein: mean(labels * preds) (critic loss form)."""
+    per = jnp.mean(labels * preds, axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+# Name table mirrors DL4J's LossFunctions.LossFunction enum.
+LOSSES: Dict[str, Callable] = {
+    "mcxent": mcxent,
+    "negativeloglikelihood": negative_log_likelihood,
+    "sparse_mcxent": sparse_mcxent,
+    "xent": binary_xent,
+    "mse": mse,
+    "squared_loss": mse,
+    "l2": l2,
+    "mean_absolute_error": mae,
+    "l1": l1,
+    "mean_absolute_percentage_error": mape,
+    "mean_squared_logarithmic_error": msle,
+    "poisson": poisson,
+    "kl_divergence": kl_divergence,
+    "reconstruction_crossentropy": binary_xent,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "cosine_proximity": cosine_proximity,
+    "wasserstein": wasserstein,
+}
+
+
+def get_loss(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    name = str(name_or_fn).lower()
+    try:
+        return LOSSES[name]
+    except KeyError:
+        raise ValueError(f"unknown loss '{name_or_fn}'; known: {sorted(LOSSES)}") from None

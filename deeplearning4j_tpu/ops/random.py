@@ -1,0 +1,80 @@
+"""RNG + distribution ops.
+
+Reference: ND4J org/nd4j/linalg/api/rng (Nd4jRandom), native Philox-style
+generator (libnd4j helpers/RandomLauncher.h), distribution ops
+(random/uniform, normal, bernoulli, truncated_normal, dropout RNG).
+
+TPU-native: JAX's counter-based threefry/rbg PRNG is the Philox analog —
+explicit splittable keys instead of a stateful global generator. For API
+parity with Nd4j.getRandom().setSeed(...) we keep a thin stateful wrapper
+that hands out split keys; everything inside jit takes explicit keys.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import op
+
+
+class RandomSource:
+    """Stateful key dispenser (Nd4j.getRandom() analog, trace-unsafe by design:
+    use only at orchestration level, never inside jit)."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+
+    def set_seed(self, seed: int) -> None:
+        self._key = jax.random.key(seed)
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def split(self, n: int):
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return subs
+
+
+_DEFAULT = RandomSource(123)
+
+
+def default_rng() -> RandomSource:
+    return _DEFAULT
+
+
+@op("random_uniform")
+def random_uniform(key, *, shape: Sequence[int], minval: float = 0.0, maxval: float = 1.0,
+                   dtype=jnp.float32):
+    return jax.random.uniform(key, tuple(shape), dtype, minval, maxval)
+
+
+@op("random_normal")
+def random_normal(key, *, shape: Sequence[int], mean: float = 0.0, stddev: float = 1.0,
+                  dtype=jnp.float32):
+    return mean + stddev * jax.random.normal(key, tuple(shape), dtype)
+
+
+@op("random_truncated_normal")
+def random_truncated_normal(key, *, shape: Sequence[int], mean: float = 0.0,
+                            stddev: float = 1.0, dtype=jnp.float32):
+    return mean + stddev * jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape), dtype)
+
+
+@op("random_bernoulli")
+def random_bernoulli(key, *, shape: Sequence[int], prob: float = 0.5, dtype=jnp.float32):
+    return jax.random.bernoulli(key, prob, tuple(shape)).astype(dtype)
+
+
+@op("random_gamma")
+def random_gamma(key, *, shape: Sequence[int], alpha: float = 1.0, beta: float = 1.0,
+                 dtype=jnp.float32):
+    return jax.random.gamma(key, alpha, tuple(shape), dtype) / beta
+
+
+@op("random_exponential")
+def random_exponential(key, *, shape: Sequence[int], rate: float = 1.0, dtype=jnp.float32):
+    return jax.random.exponential(key, tuple(shape), dtype) / rate

@@ -1,0 +1,134 @@
+"""Named-op registry: the compat surface of libnd4j's ~270 "declarable ops".
+
+Reference parity:
+  * libnd4j ``OpRegistrator`` (include/ops/declarable/OpRegistrator.h) maps op
+    names -> DeclarableOp instances; each op carries a shape function.
+  * Platform helpers (include/ops/declarable/platform/cudnn/*) override the
+    generic implementation when usable, chosen at exec time via
+    ``PlatformHelper::isUsable``.
+
+TPU-native realization: ops are pure Python callables lowering to jax.lax /
+jax.numpy (hence XLA HLO). The registry exists for (a) the *name catalog* —
+what users of the reference could call by name via DynamicCustomOp — and
+(b) the platform-helper table: an op may have an alternate Pallas kernel
+implementation selected on TPU backends. Shape functions come for free from
+``jax.eval_shape`` (the analog of the reference's calculateOutputShape JNI
+round-trip, but at trace time, not per step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from deeplearning4j_tpu.environment import environment
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class OpDescriptor:
+    """One declarable op: generic impl + optional platform (Pallas) overrides."""
+
+    name: str
+    fn: Callable[..., Any]
+    doc: str = ""
+    # platform -> (impl, is_usable predicate on kwargs)
+    platform_impls: Dict[str, Callable[..., Any]] = dataclasses.field(default_factory=dict)
+    platform_usable: Dict[str, Callable[..., bool]] = dataclasses.field(default_factory=dict)
+
+    def resolve(self, *args: Any, **kwargs: Any) -> Callable[..., Any]:
+        """Pick the implementation — the PlatformHelper::isUsable analog."""
+        env = environment()
+        if env.helper_mode == "xla":
+            return self.fn
+        backend = jax.default_backend()
+        impl = self.platform_impls.get(backend)
+        if impl is None and env.helper_mode == "pallas":
+            impl = self.platform_impls.get("tpu")
+        if impl is not None:
+            usable = self.platform_usable.get(backend, lambda *a, **k: True)
+            try:
+                ok = usable(*args, **kwargs)
+            except Exception:  # pragma: no cover - defensive
+                ok = False
+            if ok:
+                if env.log_helper_selection:
+                    logger.info("op %s: selected %s platform helper", self.name, backend)
+                return impl
+        return self.fn
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.resolve(*args, **kwargs)(*args, **kwargs)
+
+
+class OpRegistry:
+    """Global name -> op table (libnd4j OpRegistrator analog)."""
+
+    def __init__(self) -> None:
+        self._ops: Dict[str, OpDescriptor] = {}
+
+    def register(self, name: str, fn: Callable[..., Any], doc: str = "") -> OpDescriptor:
+        if name in self._ops:
+            raise ValueError(f"op '{name}' already registered")
+        desc = OpDescriptor(name=name, fn=fn, doc=doc or (fn.__doc__ or ""))
+        self._ops[name] = desc
+        return desc
+
+    def register_platform(
+        self,
+        name: str,
+        platform: str,
+        fn: Callable[..., Any],
+        usable: Optional[Callable[..., bool]] = None,
+    ) -> None:
+        desc = self._ops[name]
+        desc.platform_impls[platform] = fn
+        if usable is not None:
+            desc.platform_usable[platform] = usable
+
+    def get(self, name: str) -> OpDescriptor:
+        try:
+            return self._ops[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown op '{name}' — known ops: {sorted(self._ops)[:20]}..."
+            ) from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._ops
+
+    def names(self) -> List[str]:
+        return sorted(self._ops)
+
+    def exec(self, name: str, *args: Any, **kwargs: Any) -> Any:
+        """Execute a named op (Nd4j.exec(DynamicCustomOp) analog)."""
+        return self.get(name)(*args, **kwargs)
+
+    def calculate_output_shape(self, name: str, *args: Any, **kwargs: Any):
+        """Abstract-eval an op (DeclarableOp shape-function analog)."""
+        return jax.eval_shape(functools.partial(self.get(name).fn, **kwargs), *args)
+
+
+_REGISTRY = OpRegistry()
+
+
+def registry() -> OpRegistry:
+    return _REGISTRY
+
+
+def op(name: str, doc: str = "") -> Callable[[Callable[..., Any]], OpDescriptor]:
+    """Decorator: register a function as a named declarable op."""
+
+    def wrap(fn: Callable[..., Any]) -> OpDescriptor:
+        return _REGISTRY.register(name, fn, doc)
+
+    return wrap
+
+
+def exec_op(name: str, *args: Any, **kwargs: Any) -> Any:
+    return _REGISTRY.exec(name, *args, **kwargs)

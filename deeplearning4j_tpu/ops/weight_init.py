@@ -1,0 +1,117 @@
+"""Weight initialization — parity with DL4J's WeightInit enum.
+
+Reference: org.deeplearning4j.nn.weights.WeightInit + WeightInitUtil
+(deeplearning4j-nn). fanIn/fanOut semantics follow the reference: for a dense
+W[in, out], fanIn=in, fanOut=out; for conv kernels [kH,kW,in,out],
+fanIn=kH*kW*in, fanOut=kH*kW*out.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape: Sequence[int]) -> Tuple[float, float]:
+    if len(shape) == 1:
+        return float(shape[0]), float(shape[0])
+    if len(shape) == 2:
+        return float(shape[0]), float(shape[1])
+    receptive = 1
+    for s in shape[:-2]:
+        receptive *= s
+    return float(receptive * shape[-2]), float(receptive * shape[-1])
+
+
+def init_weights(key, shape, scheme: str = "xavier", *, dtype=jnp.float32,
+                 distribution=None, gain: float = 1.0):
+    """Initialize an array per a WeightInit scheme name."""
+    scheme = str(scheme).lower()
+    fan_in, fan_out = _fans(shape)
+    shape = tuple(int(s) for s in shape)
+
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("identity init requires square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "normal":
+        # Reference NORMAL: N(0, 1/sqrt(fanIn))
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "uniform":
+        # Reference UNIFORM: U(-a, a), a = 1/sqrt(fanIn)
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "xavier":
+        # Reference XAVIER: N(0, 2/(fanIn+fanOut))
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "xavier_uniform":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "xavier_fan_in":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "xavier_legacy":
+        std = math.sqrt(1.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "relu":
+        # He init: N(0, 2/fanIn)
+        return math.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if scheme == "relu_uniform":
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "sigmoid_uniform":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "lecun_normal":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "lecun_uniform":
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "var_scaling_normal_fan_in":
+        return gain * jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "var_scaling_normal_fan_out":
+        return gain * jax.random.normal(key, shape, dtype) / math.sqrt(fan_out)
+    if scheme == "var_scaling_normal_fan_avg":
+        return gain * jax.random.normal(key, shape, dtype) / math.sqrt((fan_in + fan_out) / 2)
+    if scheme == "var_scaling_uniform_fan_in":
+        a = gain * math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "var_scaling_uniform_fan_out":
+        a = gain * math.sqrt(3.0 / fan_out)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "var_scaling_uniform_fan_avg":
+        a = gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a distribution spec")
+        return distribution_init(key, shape, distribution, dtype=dtype)
+    raise ValueError(f"unknown weight init scheme '{scheme}'")
+
+
+def distribution_init(key, shape, spec, *, dtype=jnp.float32):
+    """Distribution spec: dict like {"type": "normal", "mean": 0, "std": 0.01}
+    (reference org.deeplearning4j.nn.conf.distribution.*)."""
+    t = spec.get("type", "normal").lower()
+    shape = tuple(int(s) for s in shape)
+    if t == "normal" or t == "gaussian":
+        return spec.get("mean", 0.0) + spec.get("std", 1.0) * jax.random.normal(key, shape, dtype)
+    if t == "uniform":
+        return jax.random.uniform(key, shape, dtype, spec.get("lower", -1.0), spec.get("upper", 1.0))
+    if t == "truncated_normal":
+        return spec.get("mean", 0.0) + spec.get("std", 1.0) * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dtype)
+    if t == "orthogonal":
+        return spec.get("gain", 1.0) * jax.nn.initializers.orthogonal()(key, shape, dtype)
+    if t == "constant":
+        return jnp.full(shape, spec.get("value", 0.0), dtype)
+    if t == "binomial":
+        return jax.random.bernoulli(key, spec.get("prob", 0.5), shape).astype(dtype)
+    raise ValueError(f"unknown distribution type '{t}'")

@@ -1,0 +1,42 @@
+"""Test harness.
+
+Reference test strategy parity (SURVEY §5): tests run on the CPU backend as
+the de-facto reference implementation; distributed logic is exercised on a
+virtual multi-device mesh (the analog of DL4J's Spark local[N] + Aeron
+loopback tests). We force an 8-device CPU platform BEFORE jax import.
+"""
+
+import os
+
+# Unit tests run on the CPU reference backend; the real chip is exercised by
+# bench.py and the driver's compile checks. The ambient environment pins
+# JAX_PLATFORMS=axon via a sitecustomize that also updates jax.config at
+# interpreter startup, so overriding the env var alone is not enough — we must
+# update the config after import, before any backend is touched.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(12345)
+
+
+@pytest.fixture
+def jax_key():
+    import jax
+
+    return jax.random.key(0)
+
+
+def assert_allclose(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)

@@ -1,0 +1,248 @@
+"""Op-catalog tests — per-op numeric cases vs numpy oracles.
+
+Reference analog: libnd4j DeclarableOpsTests*.cpp (hand-computed expectations)
+and ND4J OpValidation per-op forward checks.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.ops import registry, exec_op
+from deeplearning4j_tpu.ops import nn_ops, activations, losses
+from deeplearning4j_tpu.ops.weight_init import init_weights
+
+
+class TestRegistry:
+    def test_catalog_populated(self):
+        names = registry().names()
+        for required in ["conv2d", "maxpool2d", "batchnorm", "lstm_cell",
+                         "dot_product_attention", "matmul", "encode_threshold"]:
+            assert required in names
+
+    def test_exec_by_name(self):
+        a = jnp.ones((2, 3))
+        b = jnp.ones((3, 4))
+        out = exec_op("matmul", a, b)
+        np.testing.assert_allclose(out, 3 * np.ones((2, 4)))
+
+    def test_shape_calculation(self):
+        shape = registry().calculate_output_shape(
+            "conv2d", jax.ShapeDtypeStruct((2, 8, 8, 3), jnp.float32),
+            jax.ShapeDtypeStruct((3, 3, 3, 16), jnp.float32),
+            stride=1, padding="same")
+        assert shape.shape == (2, 8, 8, 16)
+
+    def test_unknown_op_raises(self):
+        with pytest.raises(KeyError):
+            registry().get("nonexistent_op_xyz")
+
+
+class TestConv:
+    def test_conv2d_identity_kernel(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        w = jnp.zeros((3, 3, 1, 1)).at[1, 1, 0, 0].set(1.0)
+        out = nn_ops.conv2d(x, w, padding="same")
+        np.testing.assert_allclose(out, x)
+
+    def test_conv2d_vs_manual(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 5, 5, 3).astype(np.float32)
+        w = rng.randn(3, 3, 3, 4).astype(np.float32)
+        out = np.asarray(nn_ops.conv2d(jnp.array(x), jnp.array(w), padding="valid"))
+        # manual valid conv at position (0,0), batch 0, out-channel 1
+        patch = x[0, 0:3, 0:3, :]
+        expected = np.sum(patch * w[:, :, :, 1])
+        np.testing.assert_allclose(out[0, 0, 0, 1], expected, rtol=1e-4)
+
+    def test_depthwise(self):
+        x = jnp.ones((1, 4, 4, 2))
+        w = jnp.ones((3, 3, 2, 1))
+        out = nn_ops.depthwise_conv2d(x, w, padding="valid")
+        assert out.shape == (1, 2, 2, 2)
+        np.testing.assert_allclose(out, 9.0 * np.ones((1, 2, 2, 2)))
+
+    def test_deconv_shape(self):
+        x = jnp.ones((1, 4, 4, 3))
+        w = jnp.ones((2, 2, 3, 8))
+        out = nn_ops.deconv2d(x, w, stride=2, padding="valid")
+        assert out.shape == (1, 8, 8, 8)
+
+
+class TestPooling:
+    def test_maxpool(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        out = nn_ops.maxpool2d(x, kernel=2, stride=2)
+        np.testing.assert_allclose(np.asarray(out).reshape(2, 2),
+                                   [[5.0, 7.0], [13.0, 15.0]])
+
+    def test_avgpool(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        out = nn_ops.avgpool2d(x, kernel=2, stride=2)
+        np.testing.assert_allclose(np.asarray(out).reshape(2, 2),
+                                   [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_pnorm(self):
+        x = jnp.ones((1, 2, 2, 1)) * 2.0
+        out = nn_ops.pnormpool2d(x, kernel=2, stride=2, p=2.0)
+        np.testing.assert_allclose(np.asarray(out).ravel(), [4.0])
+
+
+class TestNorm:
+    def test_batchnorm_inference(self):
+        x = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+        mean = jnp.array([2.0, 3.0])
+        var = jnp.array([1.0, 1.0])
+        out = nn_ops.batchnorm(x, mean, var, eps=0.0)
+        np.testing.assert_allclose(out, [[-1.0, -1.0], [1.0, 1.0]], atol=1e-6)
+
+    def test_batchnorm_train_normalizes(self):
+        rng = np.random.RandomState(1)
+        x = jnp.array(rng.randn(64, 8).astype(np.float32) * 3 + 5)
+        out, nm, nv = nn_ops.batch_norm_train(
+            x, jnp.ones(8), jnp.zeros(8), jnp.zeros(8), jnp.ones(8), axis=(0,))
+        np.testing.assert_allclose(np.asarray(out).mean(0), np.zeros(8), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(out).std(0), np.ones(8), atol=1e-2)
+
+    def test_layer_norm(self):
+        x = jnp.array([[1.0, 2.0, 3.0]])
+        out = nn_ops.layer_norm(x, jnp.ones(3), eps=0.0)
+        np.testing.assert_allclose(np.asarray(out).mean(), 0.0, atol=1e-6)
+
+
+class TestAttention:
+    def test_attention_uniform(self):
+        # identical keys -> uniform weights -> mean of values
+        q = jnp.ones((1, 2, 4))
+        k = jnp.ones((1, 3, 4))
+        v = jnp.arange(6.0).reshape(1, 3, 2)
+        out = nn_ops.dot_product_attention(q, k, v)
+        np.testing.assert_allclose(out[0, 0], np.asarray(v[0]).mean(0), rtol=1e-5)
+
+    def test_attention_mask(self):
+        q = jnp.ones((1, 1, 4))
+        k = jnp.ones((1, 3, 4))
+        v = jnp.array([[[1.0], [2.0], [100.0]]])
+        mask = jnp.array([[[True, True, False]]])
+        out = nn_ops.dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(out[0, 0, 0], 1.5, rtol=1e-4)
+
+    def test_mha_shape(self):
+        B, L, D = 2, 5, 8
+        rng = np.random.RandomState(0)
+        q = jnp.array(rng.randn(B, L, D).astype(np.float32))
+        w = [jnp.array(rng.randn(D, D).astype(np.float32) * 0.1) for _ in range(4)]
+        out = nn_ops.multi_head_dot_product_attention(q, q, q, *w, num_heads=2)
+        assert out.shape == (B, L, D)
+
+
+class TestRecurrentCells:
+    def test_lstm_cell_shapes_and_bounds(self):
+        B, I, H = 3, 4, 5
+        rng = np.random.RandomState(0)
+        h, c = nn_ops.lstm_cell(
+            jnp.array(rng.randn(B, I).astype(np.float32)),
+            jnp.zeros((B, H)), jnp.zeros((B, H)),
+            jnp.array(rng.randn(I, 4 * H).astype(np.float32)),
+            jnp.array(rng.randn(H, 4 * H).astype(np.float32)),
+            jnp.zeros(4 * H))
+        assert h.shape == (B, H) and c.shape == (B, H)
+        assert np.all(np.abs(np.asarray(h)) <= 1.0)
+
+    def test_gru_cell(self):
+        B, I, H = 2, 3, 4
+        rng = np.random.RandomState(0)
+        h = nn_ops.gru_cell(
+            jnp.array(rng.randn(B, I).astype(np.float32)), jnp.zeros((B, H)),
+            jnp.array(rng.randn(I, 3 * H).astype(np.float32)),
+            jnp.array(rng.randn(H, 3 * H).astype(np.float32)),
+            jnp.zeros(3 * H), jnp.zeros(3 * H))
+        assert h.shape == (B, H)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name", sorted(activations.ACTIVATIONS))
+    def test_finite(self, name):
+        fn = activations.get_activation(name)
+        x = jnp.linspace(-3, 3, 7)
+        out = fn(x)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_known_values(self):
+        np.testing.assert_allclose(activations.relu(jnp.array([-1.0, 2.0])), [0.0, 2.0])
+        np.testing.assert_allclose(activations.sigmoid(jnp.array([0.0])), [0.5])
+        np.testing.assert_allclose(
+            np.asarray(activations.softmax(jnp.array([1.0, 1.0]))), [0.5, 0.5])
+        np.testing.assert_allclose(activations.hardsigmoid(jnp.array([-10.0, 0.0, 10.0])),
+                                   [0.0, 0.5, 1.0])
+
+
+class TestLosses:
+    def test_mcxent_perfect(self):
+        probs = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        assert float(losses.mcxent(probs, labels)) < 1e-6
+
+    def test_softmax_ce_matches_mcxent(self):
+        rng = np.random.RandomState(0)
+        logits = jnp.array(rng.randn(4, 5).astype(np.float32))
+        labels = jax.nn.one_hot(jnp.array([0, 1, 2, 3]), 5)
+        fused = losses.softmax_cross_entropy_with_logits(logits, labels)
+        unfused = losses.mcxent(jax.nn.softmax(logits), labels)
+        np.testing.assert_allclose(float(fused), float(unfused), rtol=1e-5)
+
+    def test_sparse_matches_dense(self):
+        rng = np.random.RandomState(0)
+        logits = jnp.array(rng.randn(4, 5).astype(np.float32))
+        ids = jnp.array([0, 1, 2, 3])
+        dense = losses.softmax_cross_entropy_with_logits(logits, jax.nn.one_hot(ids, 5))
+        sparse = losses.sparse_mcxent(logits, ids)
+        np.testing.assert_allclose(float(dense), float(sparse), rtol=1e-5)
+
+    def test_mse(self):
+        preds = jnp.array([[1.0, 2.0]])
+        labels = jnp.array([[0.0, 0.0]])
+        np.testing.assert_allclose(float(losses.mse(preds, labels)), 2.5)
+
+    def test_mask(self):
+        preds = jnp.array([[1.0], [100.0]])
+        labels = jnp.array([[0.0], [0.0]])
+        mask = jnp.array([1.0, 0.0])
+        np.testing.assert_allclose(float(losses.mse(preds, labels, mask)), 1.0)
+
+
+class TestWeightInit:
+    @pytest.mark.parametrize("scheme", ["xavier", "relu", "uniform", "normal",
+                                        "lecun_normal", "xavier_uniform"])
+    def test_variance(self, scheme, jax_key):
+        w = init_weights(jax_key, (256, 128), scheme)
+        assert w.shape == (256, 128)
+        assert float(jnp.std(w)) > 0.0
+
+    def test_zero_ones_identity(self, jax_key):
+        assert float(jnp.sum(init_weights(jax_key, (3, 3), "zero"))) == 0.0
+        assert float(jnp.sum(init_weights(jax_key, (3, 3), "ones"))) == 9.0
+        np.testing.assert_allclose(init_weights(jax_key, (3, 3), "identity"), np.eye(3))
+
+
+class TestCompression:
+    def test_roundtrip(self):
+        from deeplearning4j_tpu.ops import compression
+
+        g = jnp.array([0.5, -0.01, 0.02, -2.0, 0.001])
+        enc, residual = compression.encode_threshold(g, threshold=0.1, capacity=4)
+        dec = compression.decode_threshold(enc, shape=(5,))
+        # decoded + residual == original
+        np.testing.assert_allclose(np.asarray(dec) + np.asarray(residual),
+                                   np.asarray(g), atol=1e-6)
+        assert int(enc.count) == 2
+
+    def test_bitmap(self):
+        from deeplearning4j_tpu.ops import compression
+
+        g = jnp.array([0.5, -0.5, 0.0])
+        code, residual = compression.encode_bitmap(g, threshold=0.1)
+        dec = compression.decode_bitmap(code, threshold=0.1)
+        np.testing.assert_allclose(np.asarray(dec) + np.asarray(residual),
+                                   np.asarray(g), atol=1e-6)
