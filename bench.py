@@ -1,0 +1,88 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Measures training throughput (images/sec) of the flagship model on the
+default JAX backend (the real TPU chip under the driver; XLA-CPU locally).
+The baseline reference (BASELINE.json) published no numbers
+(``published == {}``), so ``vs_baseline`` ratchets against the last recorded
+value in BENCH_HISTORY.json (1.0 on first run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import nn
+    from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+
+    BATCH = 256
+    net = nn.MultiLayerNetwork(
+        nn.builder().seed(123)
+        .updater(nn.Adam(learning_rate=1e-3)).weight_init("xavier").list()
+        .layer(nn.ConvolutionLayer(n_out=20, kernel=(5, 5), activation="relu"))
+        .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        .layer(nn.ConvolutionLayer(n_out=50, kernel=(5, 5), activation="relu"))
+        .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        .layer(nn.DenseLayer(n_out=500, activation="relu"))
+        .layer(nn.OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        .set_input_type(nn.InputType.convolutional_flat(28, 28, 1))
+        .build()
+    ).init()
+
+    feats, labels = synthetic_mnist(BATCH)
+    y = np.zeros((BATCH, 10), np.float32)
+    y[np.arange(BATCH), labels] = 1.0
+    x = jnp.asarray(feats)
+    yj = jnp.asarray(y)
+
+    step_fn = net._make_train_step()
+    params, opt_state, net_state = net.params, net.opt_state, net.net_state
+    key = jax.random.key(0)
+
+    def one(i, params, opt_state, net_state):
+        return step_fn(params, opt_state, net_state,
+                       jnp.asarray(i, jnp.int32), key, x, yj, None, None)
+
+    # warmup/compile
+    params, opt_state, net_state, loss = one(0, params, opt_state, net_state)
+    loss.block_until_ready()
+
+    iters = 50
+    t0 = time.perf_counter()
+    for i in range(1, iters + 1):
+        params, opt_state, net_state, loss = one(i, params, opt_state, net_state)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    imgs_per_sec = BATCH * iters / dt
+
+    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.json")
+    prev = None
+    if os.path.exists(hist_path):
+        try:
+            prev = json.load(open(hist_path)).get("value")
+        except Exception:
+            prev = None
+    vs_baseline = imgs_per_sec / prev if prev else 1.0
+    try:
+        json.dump({"value": imgs_per_sec}, open(hist_path, "w"))
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": "lenet5_mnist_train_images_per_sec",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

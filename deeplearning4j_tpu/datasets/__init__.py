@@ -1,0 +1,14 @@
+"""Data layer — DataSet/iterators/normalizers + built-in dataset fetchers.
+
+Reference parity: org/nd4j/linalg/dataset/** and deeplearning4j-datasets
+(SURVEY §3.2, §3.3)."""
+
+from deeplearning4j_tpu.datasets.dataset import (
+    DataSet,
+    DataSetIterator,
+    ListDataSetIterator,
+    AsyncDataSetIterator,
+    NormalizerStandardize,
+    NormalizerMinMaxScaler,
+    ImagePreProcessingScaler,
+)

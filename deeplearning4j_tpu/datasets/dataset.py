@@ -1,0 +1,255 @@
+"""DataSet + iterators + normalizers — the ND4J dataset package analog.
+
+Reference parity:
+  * org/nd4j/linalg/dataset/DataSet.java — features/labels (+ per-example
+    masks for sequence data), batching, shuffling, splitting.
+  * org/nd4j/linalg/dataset/api/iterator/DataSetIterator.java and impls
+    (ListDataSetIterator, ExistingDataSetIterator, IteratorDataSetIterator);
+    AsyncDataSetIterator (prefetch thread) — on TPU the async-prefetch role is
+    played by dispatching device puts ahead of compute; a thread-based
+    prefetcher is still provided for host-side pipelines.
+  * Normalizers: NormalizerStandardize, NormalizerMinMaxScaler,
+    ImagePreProcessingScaler (org/nd4j/linalg/dataset/api/preprocessor/*).
+
+Host-side data stays numpy; device transfer happens at the jit boundary.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DataSet:
+    """features/labels (+ masks) minibatch container (DataSet.java)."""
+
+    def __init__(self, features, labels=None, features_mask=None, labels_mask=None):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> Tuple["DataSet", "DataSet"]:
+        def cut(a, lo, hi):
+            return None if a is None else a[lo:hi]
+
+        n = self.num_examples()
+        return (
+            DataSet(self.features[:n_train], cut(self.labels, 0, n_train),
+                    cut(self.features_mask, 0, n_train), cut(self.labels_mask, 0, n_train)),
+            DataSet(self.features[n_train:], cut(self.labels, n_train, n),
+                    cut(self.features_mask, n_train, n), cut(self.labels_mask, n_train, n)),
+        )
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        for i in range(0, self.num_examples(), batch_size):
+            j = i + batch_size
+
+            def cut(a):
+                return None if a is None else a[i:j]
+
+            out.append(DataSet(self.features[i:j], cut(self.labels),
+                               cut(self.features_mask), cut(self.labels_mask)))
+        return out
+
+    @staticmethod
+    def merge(sets: Sequence["DataSet"]) -> "DataSet":
+        def cat(parts):
+            if any(p is None for p in parts):
+                return None
+            return np.concatenate(parts, axis=0)
+
+        return DataSet(
+            np.concatenate([d.features for d in sets], axis=0),
+            cat([d.labels for d in sets]),
+            cat([d.features_mask for d in sets]),
+            cat([d.labels_mask for d in sets]),
+        )
+
+
+class DataSetIterator:
+    """DataSetIterator.java analog: resettable iterator over DataSet batches."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    @property
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    def set_pre_processor(self, pre) -> None:
+        self._pre = pre
+
+    def _maybe_pre(self, ds: DataSet) -> DataSet:
+        pre = getattr(self, "_pre", None)
+        if pre is not None:
+            pre.transform(ds)
+        return ds
+
+
+class ListDataSetIterator(DataSetIterator):
+    """ListDataSetIterator.java: iterate a list (or one big DataSet) in batches."""
+
+    def __init__(self, data, batch_size: int = 32, shuffle: bool = False, seed: int = 0):
+        if isinstance(data, DataSet):
+            self._all = data
+            self._batches = None
+        else:
+            self._all = None
+            self._batches = list(data)
+        self._bs = batch_size
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+
+    @property
+    def batch_size(self) -> int:
+        return self._bs
+
+    def __iter__(self):
+        if self._all is not None:
+            ds = self._all
+            if self._shuffle:
+                ds = DataSet(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+                ds.shuffle(self._seed + self._epoch)
+            self._epoch += 1
+            for b in ds.batch_by(self._bs):
+                yield self._maybe_pre(b)
+        else:
+            for b in self._batches:
+                yield self._maybe_pre(b)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """AsyncDataSetIterator.java: background-thread prefetch of N batches."""
+
+    def __init__(self, base: DataSetIterator, prefetch: int = 2):
+        self._base = base
+        self._prefetch = prefetch
+
+    @property
+    def batch_size(self) -> int:
+        return self._base.batch_size
+
+    def reset(self):
+        self._base.reset()
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self._prefetch)
+        DONE = object()
+
+        def worker():
+            try:
+                for item in self._base:
+                    q.put(item)
+                q.put(DONE)
+            except BaseException as e:  # propagate to the consumer
+                q.put(e)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is DONE:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+
+# ---------------------------------------------------------------------------
+# Normalizers (api/preprocessor/*)
+# ---------------------------------------------------------------------------
+
+
+class NormalizerStandardize:
+    """NormalizerStandardize.java: per-feature z-score from fitted stats."""
+
+    def __init__(self):
+        self.mean = None
+        self.std = None
+
+    def fit(self, data) -> None:
+        feats = data.features if isinstance(data, DataSet) else DataSet.merge(list(data)).features
+        # feature/channel axis is LAST in our layout (NHWC / (N,T,F) / (N,F))
+        axes = tuple(range(feats.ndim - 1))
+        self.mean = feats.mean(axis=axes)
+        self.std = feats.std(axis=axes) + 1e-8
+
+    def transform(self, ds: DataSet) -> None:
+        ds.features = (ds.features - self.mean) / self.std
+
+    def revert(self, ds: DataSet) -> None:
+        ds.features = ds.features * self.std + self.mean
+
+    def state(self):
+        return {"mean": self.mean, "std": self.std}
+
+    def load_state(self, s):
+        self.mean, self.std = np.asarray(s["mean"]), np.asarray(s["std"])
+
+
+class NormalizerMinMaxScaler:
+    """NormalizerMinMaxScaler.java: rescale features to [lo, hi]."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0):
+        self.lo, self.hi = lo, hi
+        self.fmin = None
+        self.fmax = None
+
+    def fit(self, data) -> None:
+        feats = data.features if isinstance(data, DataSet) else DataSet.merge(list(data)).features
+        flat = feats.reshape(feats.shape[0], -1)
+        self.fmin = flat.min()
+        self.fmax = flat.max()
+
+    def transform(self, ds: DataSet) -> None:
+        rng = max(self.fmax - self.fmin, 1e-8)
+        ds.features = (ds.features - self.fmin) / rng * (self.hi - self.lo) + self.lo
+
+    def state(self):
+        return {"fmin": self.fmin, "fmax": self.fmax, "lo": self.lo, "hi": self.hi}
+
+    def load_state(self, s):
+        self.fmin, self.fmax = s["fmin"], s["fmax"]
+        self.lo, self.hi = s.get("lo", 0.0), s.get("hi", 1.0)
+
+
+class ImagePreProcessingScaler:
+    """ImagePreProcessingScaler.java: pixels [0, maxPixel] -> [lo, hi]."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0, max_pixel: float = 255.0):
+        self.lo, self.hi, self.max_pixel = lo, hi, max_pixel
+
+    def fit(self, data) -> None:  # stateless
+        pass
+
+    def transform(self, ds: DataSet) -> None:
+        ds.features = ds.features / self.max_pixel * (self.hi - self.lo) + self.lo
+
+    def state(self):
+        return {"lo": self.lo, "hi": self.hi, "max_pixel": self.max_pixel}
+
+    def load_state(self, s):
+        self.lo, self.hi, self.max_pixel = s["lo"], s["hi"], s["max_pixel"]

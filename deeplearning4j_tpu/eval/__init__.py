@@ -1,0 +1,10 @@
+"""Evaluation — org/nd4j/evaluation/** parity (SURVEY §3.2)."""
+
+from deeplearning4j_tpu.eval.evaluation import (
+    Evaluation,
+    EvaluationBinary,
+    ROC,
+    ROCMultiClass,
+    RegressionEvaluation,
+    EvaluationCalibration,
+)

@@ -1,0 +1,48 @@
+"""NN layer — the DL4J-proper role: configs, layers, networks, training.
+
+Reference parity: deeplearning4j-nn (SURVEY §3.3). Public names mirror the
+reference API surface (NeuralNetConfiguration builder, MultiLayerNetwork,
+layer config classes, updaters, listeners, ModelSerializer).
+"""
+
+from deeplearning4j_tpu.nn.conf import (
+    InputType,
+    builder,
+    MultiLayerConfiguration,
+    NeuralNetConfigurationBuilder,
+    DenseLayer,
+    OutputLayer,
+    LossLayer,
+    EmbeddingLayer,
+    EmbeddingSequenceLayer,
+    ConvolutionLayer,
+    Deconvolution2D,
+    DepthwiseConvolution2D,
+    SeparableConvolution2D,
+    SubsamplingLayer,
+    Upsampling2D,
+    GlobalPoolingLayer,
+    BatchNormalization,
+    LocalResponseNormalization,
+    ActivationLayer,
+    DropoutLayer,
+    LSTM,
+    GravesLSTM,
+    SimpleRnn,
+    Bidirectional,
+    RnnOutputLayer,
+    LastTimeStep,
+    SelfAttentionLayer,
+)
+from deeplearning4j_tpu.nn.updater import (
+    Sgd, Adam, AdaMax, Nadam, AmsGrad, AdaGrad, AdaDelta, RmsProp, Nesterovs, NoOp,
+    Schedule, StepSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+    SigmoidSchedule, CycleSchedule, MapSchedule, get_updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.listeners import (
+    TrainingListener, ScoreIterationListener, PerformanceListener,
+    CollectScoresIterationListener, EvaluativeListener, CheckpointListener,
+    TimeIterationListener,
+)
+from deeplearning4j_tpu.nn.serde import save_model, restore_model, restore_normalizer

@@ -1,0 +1,813 @@
+"""Declarative layer/network configuration with JSON round-trip.
+
+Reference parity:
+  * org/deeplearning4j/nn/conf/NeuralNetConfiguration.java (builder),
+    MultiLayerConfiguration.java, conf/layers/* (DenseLayer, ConvolutionLayer,
+    SubsamplingLayer, BatchNormalization, LSTM, EmbeddingLayer, OutputLayer,
+    ...), conf/inputs/InputType.java (shape inference between layers),
+    conf/preprocessor/* (shape adapters).
+  * Jackson-polymorphic JSON serialization — the property that makes
+    ModelSerializer zips self-describing — is reproduced with an "@type"
+    discriminator and dataclass round-trip.
+
+TPU-native realization: configs are frozen dataclasses; ``build()`` produces a
+``MultiLayerConfiguration`` whose layers know how to (a) infer their output
+InputType, (b) initialize a param pytree leaf-dict, and (c) apply as a pure
+function (see layers.py). The runtime model (multilayer.py) compiles the whole
+stack into one XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from deeplearning4j_tpu.nn.updater import Updater, Adam, get_updater
+
+# ---------------------------------------------------------------------------
+# InputType — shape inference tokens (conf/inputs/InputType.java)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    """Shape token flowing between layer configs at build time.
+
+    kind: 'feedforward' (size,), 'recurrent' (size, timesteps),
+    'convolutional' (height, width, channels — stored NHWC internally per
+    SURVEY §8.3 layout policy; the NCHW reference order is accepted at the API
+    edge), 'convolutionalflat'.
+    """
+
+    kind: str
+    size: int = 0
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    timesteps: int = -1  # -1: variable
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType("feedforward", size=size)
+
+    @staticmethod
+    def recurrent(size: int, timesteps: int = -1) -> "InputType":
+        return InputType("recurrent", size=size, timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType("convolutional", height=height, width=width, channels=channels)
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType(
+            "convolutionalflat",
+            size=height * width * channels,
+            height=height,
+            width=width,
+            channels=channels,
+        )
+
+    def flat_size(self) -> int:
+        if self.kind in ("feedforward", "convolutionalflat", "recurrent"):
+            return self.size if self.size else self.height * self.width * self.channels
+        return self.height * self.width * self.channels
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d):
+        return InputType(**d)
+
+
+# ---------------------------------------------------------------------------
+# Layer configs
+# ---------------------------------------------------------------------------
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerConf:
+    """Base layer config (conf/layers/Layer.java analog).
+
+    Per-layer overrides of the net-wide defaults (updater/lr/regularization/
+    weight init) mirror the reference's layer-level overrides.
+    """
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    weight_decay: Optional[float] = None
+    dropout: Optional[float] = None  # retain-prob semantics NOT used; this is drop rate
+    updater: Optional[Any] = None
+
+    # --- overridden by subclasses ---
+    def output_type(self, itype: InputType) -> InputType:
+        return itype
+
+    def has_params(self) -> bool:
+        return False
+
+    # JSON
+    def to_dict(self) -> Dict[str, Any]:
+        d = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Updater):
+                v = {"__updater__": v.to_dict()}
+            d[f.name] = v
+        d["@type"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "LayerConf":
+        d = dict(d)
+        cls = LAYER_TYPES[d.pop("@type")]
+        for k, v in list(d.items()):
+            if isinstance(v, dict) and "__updater__" in v:
+                d[k] = Updater.from_dict(v["__updater__"])
+            elif isinstance(v, list):
+                d[k] = tuple(v)
+        return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseLayer(LayerConf):
+    """conf/layers/DenseLayer.java: fully connected, W (nIn,nOut) + b."""
+
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class OutputLayer(DenseLayer):
+    """conf/layers/OutputLayer.java: dense + loss function."""
+
+    loss: str = "mcxent"
+
+
+@dataclasses.dataclass(frozen=True)
+class LossLayer(LayerConf):
+    """conf/layers/LossLayer.java: loss without params (identity transform)."""
+
+    loss: str = "mcxent"
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingLayer(LayerConf):
+    """conf/layers/EmbeddingLayer.java: int ids -> embedding rows."""
+
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = False
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingSequenceLayer(LayerConf):
+    """conf/layers/EmbeddingSequenceLayer.java: id sequence -> vec sequence."""
+
+    n_in: int = 0
+    n_out: int = 0
+    input_length: int = -1
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, self.input_length)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvolutionLayer(LayerConf):
+    """conf/layers/ConvolutionLayer.java.
+
+    NCHW at the API edge (reference default, `hasBias`, `convolutionMode`);
+    NHWC internally (SURVEY §8.3). kernel/stride/dilation are (h, w) pairs.
+    convolution_mode: 'truncate' (reference Truncate ≙ VALID-with-truncation)
+    or 'same'.
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        ph, pw = _pair(self.padding)
+        ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+        if self.convolution_mode == "same":
+            oh = -(-itype.height // sh)
+            ow = -(-itype.width // sw)
+        else:
+            oh = (itype.height + 2 * ph - ekh) // sh + 1
+            ow = (itype.width + 2 * pw - ekw) // sw + 1
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class Deconvolution2D(ConvolutionLayer):
+    """conf/layers/Deconvolution2D.java: transposed convolution."""
+
+    def output_type(self, itype):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        if self.convolution_mode == "same":
+            oh, ow = itype.height * sh, itype.width * sw
+        else:
+            oh = sh * (itype.height - 1) + kh - 2 * ph
+            ow = sw * (itype.width - 1) + kw - 2 * pw
+        return InputType.convolutional(oh, ow, self.n_out)
+
+
+@dataclasses.dataclass(frozen=True)
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """conf/layers/DepthwiseConvolution2D.java (depth_multiplier folded into n_out)."""
+
+    depth_multiplier: int = 1
+
+    def output_type(self, itype):
+        base = super().output_type(itype)
+        return InputType.convolutional(base.height, base.width, itype.channels * self.depth_multiplier)
+
+
+@dataclasses.dataclass(frozen=True)
+class SeparableConvolution2D(ConvolutionLayer):
+    """conf/layers/SeparableConvolution2D.java."""
+
+    depth_multiplier: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SubsamplingLayer(LayerConf):
+    """conf/layers/SubsamplingLayer.java: pooling (MAX/AVG/PNORM)."""
+
+    pooling_type: str = "max"  # max | avg | pnorm
+    kernel: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def output_type(self, itype):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        if self.convolution_mode == "same":
+            oh = -(-itype.height // sh)
+            ow = -(-itype.width // sw)
+        else:
+            oh = (itype.height + 2 * ph - kh) // sh + 1
+            ow = (itype.width + 2 * pw - kw) // sw + 1
+        return InputType.convolutional(oh, ow, itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class Upsampling2D(LayerConf):
+    """conf/layers/Upsampling2D.java."""
+
+    size: Tuple[int, int] = (2, 2)
+
+    def output_type(self, itype):
+        sh, sw = _pair(self.size)
+        return InputType.convolutional(itype.height * sh, itype.width * sw, itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalPoolingLayer(LayerConf):
+    """conf/layers/GlobalPoolingLayer.java: conv/recurrent -> feedforward."""
+
+    pooling_type: str = "avg"  # avg | max | sum | pnorm
+
+    def output_type(self, itype):
+        if itype.kind == "recurrent":
+            return InputType.feed_forward(itype.size)
+        return InputType.feed_forward(itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchNormalization(LayerConf):
+    """conf/layers/BatchNormalization.java: gamma/beta + running stats."""
+
+    n_out: int = 0  # inferred if 0
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+
+    def output_type(self, itype):
+        return itype
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalResponseNormalization(LayerConf):
+    """conf/layers/LocalResponseNormalization.java."""
+
+    n: int = 5
+    k: float = 2.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+
+@dataclasses.dataclass(frozen=True)
+class ActivationLayer(LayerConf):
+    """conf/layers/ActivationLayer.java: standalone activation."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DropoutLayer(LayerConf):
+    """conf/layers/DropoutLayer.java: standalone dropout."""
+
+    rate: float = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class LSTM(LayerConf):
+    """conf/layers/LSTM.java: scan-based LSTM over the time axis.
+
+    Gate order and math follow the reference LSTMHelpers.java
+    (input/forget/output/cell-gate with optional forget-gate bias init).
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class GravesLSTM(LSTM):
+    """conf/layers/GravesLSTM.java (legacy peephole variant — math matches
+    plain LSTM here; peepholes omitted, documented divergence)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleRnn(LayerConf):
+    """conf/layers/recurrent/SimpleRnn.java."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class Bidirectional(LayerConf):
+    """conf/layers/recurrent/Bidirectional.java: wraps an RNN layer config.
+
+    mode: CONCAT | ADD | MUL | AVERAGE (reference Bidirectional.Mode).
+    """
+
+    fwd: Optional[Dict[str, Any]] = None  # serialized inner LayerConf
+    mode: str = "concat"
+
+    def inner(self) -> LayerConf:
+        return LayerConf.from_dict(dict(self.fwd))
+
+    def output_type(self, itype):
+        out = self.inner().output_type(itype)
+        if self.mode == "concat":
+            return InputType.recurrent(out.size * 2, out.timesteps)
+        return out
+
+    def has_params(self):
+        return True
+
+    @staticmethod
+    def wrap(inner: LayerConf, mode: str = "concat", name=None) -> "Bidirectional":
+        return Bidirectional(fwd=inner.to_dict(), mode=mode, name=name)
+
+
+@dataclasses.dataclass(frozen=True)
+class RnnOutputLayer(LayerConf):
+    """conf/layers/RnnOutputLayer.java: per-timestep dense + loss."""
+
+    n_in: int = 0
+    n_out: int = 0
+    loss: str = "mcxent"
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class LastTimeStep(LayerConf):
+    """conf/layers/recurrent/LastTimeStep.java: wraps an RNN, emits last step
+    (mask-aware)."""
+
+    fwd: Optional[Dict[str, Any]] = None
+    mode: str = "last"
+
+    def inner(self) -> LayerConf:
+        return LayerConf.from_dict(dict(self.fwd))
+
+    def output_type(self, itype):
+        out = self.inner().output_type(itype)
+        return InputType.feed_forward(out.size)
+
+    def has_params(self):
+        return True
+
+    @staticmethod
+    def wrap(inner: LayerConf, name=None) -> "LastTimeStep":
+        return LastTimeStep(fwd=inner.to_dict(), name=name)
+
+
+@dataclasses.dataclass(frozen=True)
+class SelfAttentionLayer(LayerConf):
+    """conf/layers/SelfAttentionLayer.java: MHA over a sequence, Q=K=V=input."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    project_input: bool = True
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def has_params(self):
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Preprocessors (conf/preprocessor/*) — shape adapters between layers
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class InputPreProcessor:
+    """Base preprocessor. Applied to the activations flowing between layers."""
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["@type"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        if d is None:
+            return None
+        d = dict(d)
+        return PREPROCESSORS[d.pop("@type")](**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """(N, H*W*C) -> (N, H, W, C) [reference: -> NCHW; NHWC internally]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """(N, H, W, C) -> (N, H*W*C); flatten order matches reference NCHW
+    flattening (C-major) so exported flat params/activations line up."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """(N, T, F) -> (N*T, F)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """(N*T, F) -> (N, T, F)."""
+
+
+PREPROCESSORS = {
+    c.__name__: c
+    for c in [
+        FeedForwardToCnnPreProcessor,
+        CnnToFeedForwardPreProcessor,
+        RnnToFeedForwardPreProcessor,
+        FeedForwardToRnnPreProcessor,
+    ]
+}
+
+
+LAYER_TYPES = {
+    c.__name__: c
+    for c in [
+        DenseLayer,
+        OutputLayer,
+        LossLayer,
+        EmbeddingLayer,
+        EmbeddingSequenceLayer,
+        ConvolutionLayer,
+        Deconvolution2D,
+        DepthwiseConvolution2D,
+        SeparableConvolution2D,
+        SubsamplingLayer,
+        Upsampling2D,
+        GlobalPoolingLayer,
+        BatchNormalization,
+        LocalResponseNormalization,
+        ActivationLayer,
+        DropoutLayer,
+        LSTM,
+        GravesLSTM,
+        SimpleRnn,
+        Bidirectional,
+        RnnOutputLayer,
+        LastTimeStep,
+        SelfAttentionLayer,
+    ]
+}
+
+
+# ---------------------------------------------------------------------------
+# Network-level configuration + builder
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """MultiLayerConfiguration.java analog: ordered layers + global defaults.
+
+    ``input_type`` drives build-time shape inference (setInputType analog):
+    n_in fields left at 0 are filled in, and preprocessors are auto-inserted
+    exactly where the reference's InputType logic would put them.
+    """
+
+    layers: List[LayerConf] = dataclasses.field(default_factory=list)
+    preprocessors: Dict[int, InputPreProcessor] = dataclasses.field(default_factory=dict)
+    input_type: Optional[InputType] = None
+    seed: int = 0
+    updater: Any = dataclasses.field(default_factory=Adam)
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    l1: float = 0.0
+    l2: float = 0.0
+    weight_decay: float = 0.0
+    dtype: str = "float32"
+    gradient_normalization: Optional[str] = None  # None|clip_l2_per_layer|clip_value|clip_l2_global
+    gradient_normalization_threshold: float = 1.0
+    tbptt_fwd_length: int = -1
+    tbptt_back_length: int = -1
+    backprop_type: str = "standard"  # standard | tbptt
+
+    # ---- JSON round trip --------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "layers": [l.to_dict() for l in self.layers],
+                "preprocessors": {str(k): v.to_dict() for k, v in self.preprocessors.items()},
+                "input_type": self.input_type.to_dict() if self.input_type else None,
+                "seed": self.seed,
+                "updater": {"__updater__": get_updater(self.updater).to_dict()},
+                "activation": self.activation,
+                "weight_init": self.weight_init,
+                "l1": self.l1,
+                "l2": self.l2,
+                "weight_decay": self.weight_decay,
+                "dtype": self.dtype,
+                "gradient_normalization": self.gradient_normalization,
+                "gradient_normalization_threshold": self.gradient_normalization_threshold,
+                "tbptt_fwd_length": self.tbptt_fwd_length,
+                "tbptt_back_length": self.tbptt_back_length,
+                "backprop_type": self.backprop_type,
+            },
+            indent=2,
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        d = json.loads(s)
+        conf = MultiLayerConfiguration(
+            layers=[LayerConf.from_dict(l) for l in d["layers"]],
+            preprocessors={
+                int(k): InputPreProcessor.from_dict(v)
+                for k, v in d.get("preprocessors", {}).items()
+            },
+            input_type=InputType.from_dict(d["input_type"]) if d.get("input_type") else None,
+            seed=d.get("seed", 0),
+            updater=Updater.from_dict(d["updater"]["__updater__"]),
+            activation=d.get("activation", "identity"),
+            weight_init=d.get("weight_init", "xavier"),
+            l1=d.get("l1", 0.0),
+            l2=d.get("l2", 0.0),
+            weight_decay=d.get("weight_decay", 0.0),
+            dtype=d.get("dtype", "float32"),
+            gradient_normalization=d.get("gradient_normalization"),
+            gradient_normalization_threshold=d.get("gradient_normalization_threshold", 1.0),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", -1),
+            tbptt_back_length=d.get("tbptt_back_length", -1),
+            backprop_type=d.get("backprop_type", "standard"),
+        )
+        return conf
+
+    # ---- defaults resolution ---------------------------------------------
+    def layer_activation(self, lc: LayerConf) -> str:
+        return lc.activation if lc.activation is not None else self.activation
+
+    def layer_weight_init(self, lc: LayerConf) -> str:
+        return lc.weight_init if lc.weight_init is not None else self.weight_init
+
+    def layer_updater(self, lc: LayerConf) -> Updater:
+        return get_updater(lc.updater) if lc.updater is not None else get_updater(self.updater)
+
+    def layer_l1(self, lc: LayerConf) -> float:
+        return lc.l1 if lc.l1 is not None else self.l1
+
+    def layer_l2(self, lc: LayerConf) -> float:
+        return lc.l2 if lc.l2 is not None else self.l2
+
+    def layer_weight_decay(self, lc: LayerConf) -> float:
+        return lc.weight_decay if lc.weight_decay is not None else self.weight_decay
+
+
+class NeuralNetConfigurationBuilder:
+    """NeuralNetConfiguration.Builder + ListBuilder in one fluent object.
+
+    Mirrors the reference usage:
+        conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-3))
+                .list()
+                .layer(ConvolutionLayer(...)).layer(...)
+                .set_input_type(InputType.convolutional_flat(28, 28, 1))
+                .build())
+    """
+
+    def __init__(self) -> None:
+        self._conf = MultiLayerConfiguration()
+
+    def seed(self, s: int):
+        self._conf.seed = s
+        return self
+
+    def updater(self, u):
+        self._conf.updater = u
+        return self
+
+    def activation(self, a: str):
+        self._conf.activation = a
+        return self
+
+    def weight_init(self, w: str):
+        self._conf.weight_init = w
+        return self
+
+    def l1(self, v: float):
+        self._conf.l1 = v
+        return self
+
+    def l2(self, v: float):
+        self._conf.l2 = v
+        return self
+
+    def weight_decay(self, v: float):
+        self._conf.weight_decay = v
+        return self
+
+    def dtype(self, d: str):
+        self._conf.dtype = d
+        return self
+
+    def gradient_normalization(self, kind: str, threshold: float = 1.0):
+        self._conf.gradient_normalization = kind
+        self._conf.gradient_normalization_threshold = threshold
+        return self
+
+    def tbptt(self, fwd_length: int, back_length: Optional[int] = None):
+        self._conf.backprop_type = "tbptt"
+        self._conf.tbptt_fwd_length = fwd_length
+        self._conf.tbptt_back_length = back_length or fwd_length
+        return self
+
+    def list(self):
+        return self
+
+    def layer(self, lc: LayerConf):
+        self._conf.layers.append(lc)
+        return self
+
+    def input_pre_processor(self, idx: int, p: InputPreProcessor):
+        self._conf.preprocessors[idx] = p
+        return self
+
+    def set_input_type(self, itype: InputType):
+        self._conf.input_type = itype
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        conf = self._conf
+        if conf.input_type is not None:
+            _infer_shapes(conf)
+        return conf
+
+
+def builder() -> NeuralNetConfigurationBuilder:
+    return NeuralNetConfigurationBuilder()
+
+
+def _infer_shapes(conf: MultiLayerConfiguration) -> None:
+    """setInputType analog: fill n_in=0 fields, auto-insert preprocessors."""
+    itype = conf.input_type
+    new_layers: List[LayerConf] = []
+    for i, lc in enumerate(conf.layers):
+        itype, lc = _adapt(conf, i, itype, lc)
+        new_layers.append(lc)
+        itype = lc.output_type(itype)
+    conf.layers = new_layers
+
+
+def _adapt(conf, i, itype, lc) -> Tuple[InputType, LayerConf]:
+    """Insert preprocessors & fill n_in for one layer (InputType.getPreProcessorForInputType)."""
+    needs_ff = isinstance(lc, (DenseLayer, OutputLayer, EmbeddingLayer))
+    is_conv = isinstance(lc, (ConvolutionLayer, SubsamplingLayer, Upsampling2D, LocalResponseNormalization))
+    if i not in conf.preprocessors:
+        if itype.kind == "convolutionalflat" and is_conv:
+            conf.preprocessors[i] = FeedForwardToCnnPreProcessor(
+                itype.height, itype.width, itype.channels
+            )
+            itype = InputType.convolutional(itype.height, itype.width, itype.channels)
+        elif itype.kind == "convolutional" and needs_ff:
+            conf.preprocessors[i] = CnnToFeedForwardPreProcessor(
+                itype.height, itype.width, itype.channels
+            )
+            itype = InputType.feed_forward(itype.flat_size())
+        elif itype.kind == "convolutionalflat" and needs_ff:
+            itype = InputType.feed_forward(itype.size)
+    else:
+        p = conf.preprocessors[i]
+        if isinstance(p, FeedForwardToCnnPreProcessor):
+            itype = InputType.convolutional(p.height, p.width, p.channels)
+        elif isinstance(p, CnnToFeedForwardPreProcessor):
+            itype = InputType.feed_forward(p.height * p.width * p.channels)
+
+    # wrapper layers: infer the INNER config's n_in, then rebuild the wrapper
+    if isinstance(lc, (Bidirectional, LastTimeStep)):
+        inner = lc.inner()
+        if getattr(inner, "n_in", 1) == 0:
+            size = itype.size if itype.kind == "recurrent" else itype.flat_size()
+            inner = dataclasses.replace(inner, n_in=size)
+            lc = dataclasses.replace(lc, fwd=inner.to_dict())
+        return itype, lc
+
+    # fill n_in / n_out where inferable
+    updates: Dict[str, Any] = {}
+    if hasattr(lc, "n_in") and getattr(lc, "n_in") == 0:
+        if itype.kind in ("feedforward", "convolutionalflat"):
+            updates["n_in"] = itype.flat_size()
+        elif itype.kind == "recurrent":
+            updates["n_in"] = itype.size
+        elif itype.kind == "convolutional":
+            updates["n_in"] = itype.channels
+    if isinstance(lc, BatchNormalization) and lc.n_out == 0:
+        updates["n_out"] = itype.channels if itype.kind == "convolutional" else itype.flat_size()
+    if updates:
+        lc = dataclasses.replace(lc, **updates)
+    return itype, lc

@@ -1,0 +1,640 @@
+"""Runtime layers: pure ``init``/``apply`` functions per layer config.
+
+Reference parity:
+  * org/deeplearning4j/nn/layers/** — each reference layer hand-implements
+    ``activate()`` (forward) and ``backpropGradient()`` (hand-written
+    backward) against ND4J ops.
+  * TPU-native realization: only the forward is written; the backward comes
+    from jax.grad over the whole network (the reference's per-layer
+    hand-written backprop dissolves — SURVEY §8.1). Layers are pure:
+    ``apply(params, x, state, *, train, rng, mask) -> (y, new_state, mask)``.
+    ``state`` carries non-trainable buffers (BatchNormalization running
+    stats — the reference stores them as params excluded from updates).
+
+Param naming matches the reference's param keys where they exist
+("W", "b", "gamma", "beta", "mean", "var", "RW" for recurrent weights) so
+flat-param export (params_flat) lines up for parity checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.ops import nn_ops
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.weight_init import init_weights
+
+Params = Dict[str, jax.Array]
+State = Dict[str, jax.Array]
+
+
+class Layer:
+    """Runtime twin of one LayerConf (org.deeplearning4j.nn.layers.BaseLayer)."""
+
+    def __init__(self, net_conf: C.MultiLayerConfiguration, lc: C.LayerConf, itype: C.InputType):
+        self.net_conf = net_conf
+        self.lc = lc
+        self.itype = itype  # input type AFTER preprocessor
+        self.otype = lc.output_type(itype)
+        self.activation = get_activation(net_conf.layer_activation(lc))
+        self.winit = net_conf.layer_weight_init(lc)
+        self.dtype = jnp.dtype(net_conf.dtype)
+
+    # -- override points ----------------------------------------------------
+    def init(self, key) -> Params:
+        return {}
+
+    def init_state(self) -> State:
+        return {}
+
+    def apply(self, params: Params, x, state: State, *, train: bool, rng, mask=None):
+        raise NotImplementedError
+
+    # -- common helpers -----------------------------------------------------
+    def _maybe_dropout(self, x, *, train: bool, rng):
+        """Input dropout, reference layer-level `dropOut` semantics (applied
+        to the layer INPUT, as in BaseLayer.applyDropOutIfNecessary)."""
+        rate = self.lc.dropout
+        if not rate or not train:
+            return x
+        return nn_ops.dropout.fn(x, rng, rate=rate)
+
+    def n_params(self, params: Params) -> int:
+        return sum(int(v.size) for v in params.values())
+
+
+class DenseLayerImpl(Layer):
+    """layers/feedforward/dense/DenseLayer.java: out = act(xW + b)."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        p = {"W": init_weights(key, (lc.n_in, lc.n_out), self.winit, dtype=self.dtype)}
+        if lc.has_bias:
+            p["b"] = jnp.zeros((lc.n_out,), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        z = x @ params["W"]
+        if "b" in params:
+            z = z + params["b"]
+        return self.activation(z), state, mask
+
+
+class OutputLayerImpl(DenseLayerImpl):
+    """layers/OutputLayer.java: dense + loss (loss applied by the network)."""
+
+
+class LossLayerImpl(Layer):
+    """layers/LossLayer.java: activation only; loss applied by the network."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return self.activation(x), state, mask
+
+
+class EmbeddingLayerImpl(Layer):
+    """layers/feedforward/embedding/EmbeddingLayer.java: ids -> rows."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        p = {"W": init_weights(key, (lc.n_in, lc.n_out), self.winit, dtype=self.dtype)}
+        if getattr(lc, "has_bias", False):
+            p["b"] = jnp.zeros((lc.n_out,), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        ids = x.astype(jnp.int32)
+        if ids.ndim == 2 and ids.shape[-1] == 1:
+            ids = ids[:, 0]
+        out = params["W"][ids]
+        if "b" in params:
+            out = out + params["b"]
+        return self.activation(out), state, mask
+
+
+class EmbeddingSequenceLayerImpl(EmbeddingLayerImpl):
+    """layers/feedforward/embedding/EmbeddingSequenceLayer.java.
+
+    Input (N, T) int ids -> (N, T, F).
+    """
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        ids = x.astype(jnp.int32)
+        if ids.ndim == 3 and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        out = params["W"][ids]
+        return self.activation(out), state, mask
+
+
+class ConvolutionLayerImpl(Layer):
+    """layers/convolution/ConvolutionLayer.java.
+
+    Internal layout NHWC, kernel HWIO (SURVEY §8.3 layout policy; reference is
+    NCHW/OIHW from its cuDNN heritage — accepted at the model edge, not here).
+    """
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        kh, kw = C._pair(lc.kernel)
+        p = {"W": init_weights(key, (kh, kw, lc.n_in, lc.n_out), self.winit, dtype=self.dtype)}
+        if lc.has_bias:
+            p["b"] = jnp.zeros((lc.n_out,), self.dtype)
+        return p
+
+    def _conv_args(self):
+        lc = self.lc
+        if lc.convolution_mode == "same":
+            padding = "same"
+        else:
+            ph, pw = C._pair(lc.padding)
+            padding = ((ph, ph), (pw, pw))
+        return dict(stride=C._pair(lc.stride), padding=padding, dilation=C._pair(lc.dilation))
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        z = nn_ops.conv2d.fn(x, params["W"], params.get("b"), **self._conv_args())
+        return self.activation(z), state, mask
+
+
+class Deconvolution2DImpl(ConvolutionLayerImpl):
+    """layers/convolution/Deconvolution2DLayer.java (transposed conv)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        if lc.convolution_mode == "same":
+            pad = "same"
+        else:
+            # explicit pad must match output_type: oh = s*(h-1) + k - 2p
+            pad = C._pair(lc.padding)
+        z = nn_ops.deconv2d.fn(x, params["W"], params.get("b"), stride=C._pair(lc.stride), padding=pad)
+        return self.activation(z), state, mask
+
+
+class DepthwiseConvolution2DImpl(Layer):
+    """layers/convolution/DepthwiseConvolution2DLayer.java."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        kh, kw = C._pair(lc.kernel)
+        mult = getattr(lc, "depth_multiplier", 1)
+        p = {"W": init_weights(key, (kh, kw, lc.n_in, mult), self.winit, dtype=self.dtype)}
+        if lc.has_bias:
+            p["b"] = jnp.zeros((lc.n_in * mult,), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        pad = "same" if lc.convolution_mode == "same" else "valid"
+        z = nn_ops.depthwise_conv2d.fn(
+            x, params["W"], params.get("b"), stride=C._pair(lc.stride), padding=pad,
+            dilation=C._pair(lc.dilation))
+        return self.activation(z), state, mask
+
+
+class SeparableConvolution2DImpl(Layer):
+    """layers/convolution/SeparableConvolution2DLayer.java."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        kh, kw = C._pair(lc.kernel)
+        mult = getattr(lc, "depth_multiplier", 1)
+        k1, k2 = jax.random.split(key)
+        p = {
+            "dW": init_weights(k1, (kh, kw, lc.n_in, mult), self.winit, dtype=self.dtype),
+            "pW": init_weights(k2, (1, 1, lc.n_in * mult, lc.n_out), self.winit, dtype=self.dtype),
+        }
+        if lc.has_bias:
+            p["b"] = jnp.zeros((lc.n_out,), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        pad = "same" if lc.convolution_mode == "same" else "valid"
+        z = nn_ops.separable_conv2d.fn(
+            x, params["dW"], params["pW"], params.get("b"),
+            stride=C._pair(lc.stride), padding=pad)
+        return self.activation(z), state, mask
+
+
+class SubsamplingLayerImpl(Layer):
+    """layers/convolution/subsampling/SubsamplingLayer.java."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        if lc.convolution_mode == "same":
+            pad = "same"
+        else:
+            ph, pw = C._pair(lc.padding)
+            pad = ((ph, ph), (pw, pw))
+        kw = dict(kernel=C._pair(lc.kernel), stride=C._pair(lc.stride), padding=pad)
+        if lc.pooling_type == "max":
+            y = nn_ops.maxpool2d.fn(x, **kw)
+        elif lc.pooling_type == "avg":
+            y = nn_ops.avgpool2d.fn(x, **kw)
+        elif lc.pooling_type == "pnorm":
+            y = nn_ops.pnormpool2d.fn(x, p=lc.pnorm, **kw)
+        else:
+            raise ValueError(f"unknown pooling type {lc.pooling_type}")
+        return y, state, mask
+
+
+class Upsampling2DImpl(Layer):
+    """layers/convolution/upsampling/Upsampling2D.java."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return nn_ops.upsampling2d.fn(x, size=C._pair(self.lc.size)), state, mask
+
+
+class GlobalPoolingLayerImpl(Layer):
+    """layers/pooling/GlobalPoolingLayer.java — conv NHWC (axes 1,2) or
+    recurrent (axis 1 = time, mask-aware)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        pt = self.lc.pooling_type
+        if x.ndim == 4:  # NHWC
+            axes = (1, 2)
+            m = None
+        else:  # (N, T, F)
+            axes = (1,)
+            m = mask
+        if m is not None:
+            m3 = m[..., None].astype(x.dtype)
+            if pt == "avg":
+                y = (x * m3).sum(axes) / jnp.maximum(m3.sum(axes), 1e-8)
+            elif pt == "sum":
+                y = (x * m3).sum(axes)
+            elif pt == "max":
+                y = jnp.where(m3 > 0, x, -jnp.inf).max(axes)
+            else:
+                y = ((jnp.abs(x) ** self.lc_pnorm()) * m3).sum(axes) ** (1.0 / self.lc_pnorm())
+        else:
+            if pt == "avg":
+                y = x.mean(axes)
+            elif pt == "sum":
+                y = x.sum(axes)
+            elif pt == "max":
+                y = x.max(axes)
+            else:
+                y = (jnp.abs(x) ** self.lc_pnorm()).sum(axes) ** (1.0 / self.lc_pnorm())
+        return y, state, None
+
+    def lc_pnorm(self):
+        return getattr(self.lc, "pnorm", 2)
+
+
+class BatchNormalizationImpl(Layer):
+    """layers/normalization/BatchNormalization.java.
+
+    gamma/beta trainable; running mean/var live in layer STATE (the reference
+    keeps them in the param buffer but excludes them from updates — state is
+    the functional equivalent). Reference decay semantics:
+    running = decay * running + (1-decay) * batch.
+    """
+
+    def init(self, key) -> Params:
+        n = self.lc.n_out
+        if self.lc.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.ones((n,), self.dtype), "beta": jnp.zeros((n,), self.dtype)}
+
+    def init_state(self) -> State:
+        n = self.lc.n_out
+        return {"mean": jnp.zeros((n,), self.dtype), "var": jnp.ones((n,), self.dtype)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        gamma = params.get("gamma")
+        beta = params.get("beta")
+        if train:
+            axes = tuple(range(x.ndim - 1))  # all but channel/feature
+            y, new_mean, new_var = nn_ops.batch_norm_train(
+                x, gamma, beta, state["mean"], state["var"],
+                axis=axes, eps=lc.eps, momentum=lc.decay)
+            return y, {"mean": new_mean, "var": new_var}, mask
+        y = nn_ops.batchnorm.fn(x, state["mean"], state["var"], gamma, beta, eps=lc.eps)
+        return y, state, mask
+
+
+class LocalResponseNormalizationImpl(Layer):
+    """layers/normalization/LocalResponseNormalization.java."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        y = nn_ops.local_response_normalization.fn(
+            x, depth=lc.n, bias=lc.k, alpha=lc.alpha, beta=lc.beta)
+        return y, state, mask
+
+
+class ActivationLayerImpl(Layer):
+    """layers/ActivationLayer.java."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return self.activation(x), state, mask
+
+
+class DropoutLayerImpl(Layer):
+    """layers/DropoutLayer.java."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        if not train:
+            return x, state, mask
+        return nn_ops.dropout.fn(x, rng, rate=self.lc.rate), state, mask
+
+
+# ---------------------------------------------------------------------------
+# Recurrent layers — lax.scan over time (layers/recurrent/*)
+# ---------------------------------------------------------------------------
+
+
+def _lstm_scan(params, x0, h0, c0, mask, *, gate_act, cell_act, reverse=False):
+    """Scan an LSTM over (N, T, F). Gate math per LSTMHelpers.java:
+    gates = x·Wih + h·Whh + b, order [i, f, o, g]; c' = f*c + i*g;
+    h = o * cell_act(c') — the layer's configured activation IS the
+    cell-output activation (reference default tanh), not a post-transform.
+
+    The whole loop is one lax.scan — XLA unrolls/pipelines it; the per-step
+    matmuls hit the MXU batched over N.
+    """
+    w_ih, w_hh, b = params["W"], params["RW"], params["b"]
+
+    masked = mask is not None
+
+    def step(carry, xm):
+        h, c = carry
+        xt, mt = xm
+        gates = xt @ w_ih + h @ w_hh + b
+        i, f, o, g = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * cell_act(c_new)
+        if masked:
+            m = mt[:, None]
+            h_new = jnp.where(m > 0, h_new, h)
+            c_new = jnp.where(m > 0, c_new, c)
+        return (h_new, c_new), h_new
+
+    xs = jnp.swapaxes(x0, 0, 1)  # (T, N, F)
+    ms = jnp.swapaxes(mask, 0, 1) if masked else jnp.zeros((xs.shape[0], 0))
+    (h_last, c_last), hs = jax.lax.scan(step, (h0, c0), (xs, ms), reverse=reverse)
+    return jnp.swapaxes(hs, 0, 1), h_last, c_last
+
+
+class LSTMImpl(Layer):
+    """layers/recurrent/LSTM.java — scan-based, mask-aware, stateful-capable.
+
+    The configured ``activation`` is the cell-output activation inside the
+    scan (reference default tanh). Stateful rnnTimeStep() support passes
+    ``initial=(h0, c0)`` and consumes the returned last state (wired by the
+    network's rnn_time_step path).
+    """
+
+    reverse = False
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        k1, k2 = jax.random.split(key)
+        b = jnp.zeros((4 * lc.n_out,), self.dtype)
+        # forget-gate bias init (reference forgetGateBiasInit): gate order [i,f,o,g]
+        b = b.at[lc.n_out : 2 * lc.n_out].set(lc.forget_gate_bias_init)
+        return {
+            "W": init_weights(k1, (lc.n_in, 4 * lc.n_out), self.winit, dtype=self.dtype),
+            "RW": init_weights(k2, (lc.n_out, 4 * lc.n_out), self.winit, dtype=self.dtype),
+            "b": b,
+        }
+
+    def apply(self, params, x, state, *, train, rng, mask=None, initial=None):
+        lc = self.lc
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        n = x.shape[0]
+        if initial is not None:
+            h0, c0 = initial
+        else:
+            h0 = jnp.zeros((n, lc.n_out), x.dtype)
+            c0 = jnp.zeros((n, lc.n_out), x.dtype)
+        gate_act = get_activation(lc.gate_activation)
+        hs, h_last, c_last = _lstm_scan(
+            params, x, h0, c0, mask, gate_act=gate_act, cell_act=self.activation,
+            reverse=self.reverse)
+        return hs, state, mask
+
+    def apply_with_state(self, params, x, *, mask=None, initial=None):
+        """Stateful forward for rnn_time_step: returns (out, (h_last, c_last))."""
+        lc = self.lc
+        n = x.shape[0]
+        if initial is not None:
+            h0, c0 = initial
+        else:
+            h0 = jnp.zeros((n, lc.n_out), x.dtype)
+            c0 = jnp.zeros((n, lc.n_out), x.dtype)
+        hs, h_last, c_last = _lstm_scan(
+            params, x, h0, c0, mask, gate_act=get_activation(lc.gate_activation),
+            cell_act=self.activation, reverse=self.reverse)
+        return hs, (h_last, c_last)
+
+
+class SimpleRnnImpl(Layer):
+    """layers/recurrent/SimpleRnn.java: h' = act(x·W + h·RW + b)."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": init_weights(k1, (lc.n_in, lc.n_out), self.winit, dtype=self.dtype),
+            "RW": init_weights(k2, (lc.n_out, lc.n_out), self.winit, dtype=self.dtype),
+            "b": jnp.zeros((lc.n_out,), self.dtype),
+        }
+
+    def apply(self, params, x, state, *, train, rng, mask=None, initial=None):
+        lc = self.lc
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        n = x.shape[0]
+        h0 = initial if initial is not None else jnp.zeros((n, lc.n_out), x.dtype)
+        act = self.activation
+        masked = mask is not None
+
+        def step(h, xm):
+            xt, mt = xm
+            h_new = act(xt @ params["W"] + h @ params["RW"] + params["b"])
+            if masked:
+                h_new = jnp.where(mt[:, None] > 0, h_new, h)
+            return h_new, h_new
+
+        xs = jnp.swapaxes(x, 0, 1)
+        ms = jnp.swapaxes(mask, 0, 1) if masked else jnp.zeros((xs.shape[0], 0))
+        _, hs = jax.lax.scan(step, h0, (xs, ms))
+        return jnp.swapaxes(hs, 0, 1), state, mask
+
+
+class BidirectionalImpl(Layer):
+    """layers/recurrent/BidirectionalLayer.java: fwd + bwd inner RNN, merged."""
+
+    def __init__(self, net_conf, lc, itype):
+        super().__init__(net_conf, lc, itype)
+        inner = lc.inner()
+        self.fwd_layer = build_layer(net_conf, inner, itype)
+        self.bwd_layer = build_layer(net_conf, inner, itype)
+        if isinstance(self.bwd_layer, LSTMImpl):
+            self.bwd_layer.reverse = True
+
+    def init(self, key) -> Params:
+        k1, k2 = jax.random.split(key)
+        return {"fwd": self.fwd_layer.init(k1), "bwd": self.bwd_layer.init(k2)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        yf, _, _ = self.fwd_layer.apply(params["fwd"], x, {}, train=train, rng=rng, mask=mask)
+        if isinstance(self.bwd_layer, LSTMImpl):
+            yb, _, _ = self.bwd_layer.apply(params["bwd"], x, {}, train=train, rng=rng, mask=mask)
+        else:
+            xr = jnp.flip(x, axis=1)
+            mr = None if mask is None else jnp.flip(mask, axis=1)
+            yb, _, _ = self.bwd_layer.apply(params["bwd"], xr, {}, train=train, rng=rng, mask=mr)
+            yb = jnp.flip(yb, axis=1)
+        mode = self.lc.mode
+        if mode == "concat":
+            y = jnp.concatenate([yf, yb], axis=-1)
+        elif mode == "add":
+            y = yf + yb
+        elif mode == "mul":
+            y = yf * yb
+        elif mode == "average":
+            y = 0.5 * (yf + yb)
+        else:
+            raise ValueError(f"unknown Bidirectional mode {mode}")
+        return y, state, mask
+
+
+class RnnOutputLayerImpl(Layer):
+    """layers/recurrent/RnnOutputLayer.java: time-distributed dense + loss."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        p = {"W": init_weights(key, (lc.n_in, lc.n_out), self.winit, dtype=self.dtype)}
+        if lc.has_bias:
+            p["b"] = jnp.zeros((lc.n_out,), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        z = x @ params["W"]
+        if "b" in params:
+            z = z + params["b"]
+        return self.activation(z), state, mask
+
+
+class LastTimeStepImpl(Layer):
+    """layers/recurrent/LastTimeStepLayer.java: inner RNN -> last unmasked step."""
+
+    def __init__(self, net_conf, lc, itype):
+        super().__init__(net_conf, lc, itype)
+        self.inner_layer = build_layer(net_conf, lc.inner(), itype)
+
+    def init(self, key) -> Params:
+        return {"inner": self.inner_layer.init(key)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        y, _, _ = self.inner_layer.apply(params["inner"], x, {}, train=train, rng=rng, mask=mask)
+        if mask is None:
+            out = y[:, -1]
+        else:
+            idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+            out = y[jnp.arange(y.shape[0]), idx]
+        return out, state, None
+
+
+class SelfAttentionLayerImpl(Layer):
+    """layers/SelfAttentionLayer.java — MHA with Q=K=V=input sequence.
+
+    Lowers to the registry's multi_head_dot_product_attention (which the
+    platform-helper table may override with a Pallas flash-attention kernel
+    on TPU — the cuDNN-helper analog)."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        ks = jax.random.split(key, 4)
+        d = lc.n_out
+        return {
+            "Wq": init_weights(ks[0], (lc.n_in, d), self.winit, dtype=self.dtype),
+            "Wk": init_weights(ks[1], (lc.n_in, d), self.winit, dtype=self.dtype),
+            "Wv": init_weights(ks[2], (lc.n_in, d), self.winit, dtype=self.dtype),
+            "Wo": init_weights(ks[3], (d, d), self.winit, dtype=self.dtype),
+        }
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        h = self.lc.n_heads
+        q = x @ params["Wq"]
+        k = x @ params["Wk"]
+        v = x @ params["Wv"]
+        n, t, d = q.shape
+        dh = d // h
+
+        def split(a):
+            return a.reshape(n, t, h, dh).transpose(0, 2, 1, 3)
+
+        qh, kh, vh = split(q), split(k), split(v)
+        scores = (qh @ jnp.swapaxes(kh, -1, -2)) / jnp.sqrt(jnp.asarray(dh, x.dtype))
+        if mask is not None:
+            am = mask[:, None, None, :]
+            scores = jnp.where(am > 0, scores, -1e9)
+        attn = jax.nn.softmax(scores, axis=-1)
+        out = (attn @ vh).transpose(0, 2, 1, 3).reshape(n, t, d)
+        return out @ params["Wo"], state, mask
+
+
+LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
+    C.DenseLayer: DenseLayerImpl,
+    C.OutputLayer: OutputLayerImpl,
+    C.LossLayer: LossLayerImpl,
+    C.EmbeddingLayer: EmbeddingLayerImpl,
+    C.EmbeddingSequenceLayer: EmbeddingSequenceLayerImpl,
+    C.ConvolutionLayer: ConvolutionLayerImpl,
+    C.Deconvolution2D: Deconvolution2DImpl,
+    C.DepthwiseConvolution2D: DepthwiseConvolution2DImpl,
+    C.SeparableConvolution2D: SeparableConvolution2DImpl,
+    C.SubsamplingLayer: SubsamplingLayerImpl,
+    C.Upsampling2D: Upsampling2DImpl,
+    C.GlobalPoolingLayer: GlobalPoolingLayerImpl,
+    C.BatchNormalization: BatchNormalizationImpl,
+    C.LocalResponseNormalization: LocalResponseNormalizationImpl,
+    C.ActivationLayer: ActivationLayerImpl,
+    C.DropoutLayer: DropoutLayerImpl,
+    C.LSTM: LSTMImpl,
+    C.GravesLSTM: LSTMImpl,
+    C.SimpleRnn: SimpleRnnImpl,
+    C.Bidirectional: BidirectionalImpl,
+    C.RnnOutputLayer: RnnOutputLayerImpl,
+    C.LastTimeStep: LastTimeStepImpl,
+    C.SelfAttentionLayer: SelfAttentionLayerImpl,
+}
+
+
+def build_layer(net_conf: C.MultiLayerConfiguration, lc: C.LayerConf, itype: C.InputType) -> Layer:
+    impl = LAYER_IMPLS.get(type(lc))
+    if impl is None:
+        raise ValueError(f"no runtime impl for layer config {type(lc).__name__}")
+    return impl(net_conf, lc, itype)
+
+
+def apply_preprocessor(p: Optional[C.InputPreProcessor], x):
+    """conf/preprocessor/* forward application."""
+    if p is None:
+        return x
+    if isinstance(p, C.FeedForwardToCnnPreProcessor):
+        # reference flattening is NCHW C-major; our runtime layout is NHWC
+        return x.reshape(x.shape[0], p.channels, p.height, p.width).transpose(0, 2, 3, 1)
+    if isinstance(p, C.CnnToFeedForwardPreProcessor):
+        # inverse: NHWC -> NCHW-major flatten to match reference flat ordering
+        return x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
+    if isinstance(p, C.RnnToFeedForwardPreProcessor):
+        return x.reshape(-1, x.shape[-1])
+    if isinstance(p, C.FeedForwardToRnnPreProcessor):
+        raise ValueError("FeedForwardToRnnPreProcessor needs batch size context; unsupported standalone")
+    return x

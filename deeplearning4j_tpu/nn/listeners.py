@@ -1,0 +1,173 @@
+"""Training listeners — org/deeplearning4j/optimize/listeners parity.
+
+Reference parity:
+  * TrainingListener.java iface: iterationDone / onEpochStart / onEpochEnd /
+    onForwardPass / onBackwardPass / onGradientCalculation.
+  * ScoreIterationListener, PerformanceListener (samples/sec + memory),
+    TimeIterationListener, CollectScoresIterationListener, CheckpointListener
+    (periodic save with retention policy), EvaluativeListener.
+
+The listener API is user-visible surface in the reference, so the shape is
+kept; model hooks call these from the host-side training loop (the device
+step itself is one fused XLA program — listeners observe per-iteration host
+state, exactly the granularity the reference offers).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingListener:
+    """TrainingListener.java analog. All hooks optional."""
+
+    def iteration_done(self, model, iteration: int, epoch: int, score: float) -> None:
+        pass
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """ScoreIterationListener.java: log score every N iterations."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.print_iterations == 0:
+            logger.info("Score at iteration %d is %s", iteration, score)
+
+
+class PerformanceListener(TrainingListener):
+    """PerformanceListener.java: throughput (samples/sec, batches/sec)."""
+
+    def __init__(self, frequency: int = 10, report_score: bool = False):
+        self.frequency = max(1, frequency)
+        self.report_score = report_score
+        self._last_time = None
+        self._last_iter = 0
+        self.history: List[Dict[str, float]] = []
+
+    def iteration_done(self, model, iteration, epoch, score):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time, self._last_iter = now, iteration
+            return
+        if iteration - self._last_iter >= self.frequency:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            batch = getattr(model, "last_batch_size", 0)
+            rec = {
+                "iteration": iteration,
+                "batches_per_sec": iters / dt,
+                "samples_per_sec": iters * batch / dt,
+                "iter_ms": 1000.0 * dt / iters,
+            }
+            self.history.append(rec)
+            msg = (f"iteration {iteration}: {rec['batches_per_sec']:.1f} batches/sec, "
+                   f"{rec['samples_per_sec']:.1f} samples/sec, {rec['iter_ms']:.2f} ms/iter")
+            if self.report_score:
+                msg += f", score {score}"
+            logger.info(msg)
+            self._last_time, self._last_iter = now, iteration
+
+
+class TimeIterationListener(TrainingListener):
+    """TimeIterationListener.java: ETA logging."""
+
+    def __init__(self, total_iterations: int, frequency: int = 50):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self._start = None
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self._start is None:
+            self._start = time.perf_counter()
+            return
+        if iteration and iteration % self.frequency == 0:
+            elapsed = time.perf_counter() - self._start
+            remaining = elapsed / iteration * max(self.total - iteration, 0)
+            logger.info("iteration %d/%d — est. remaining %.0fs", iteration, self.total, remaining)
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """CollectScoresIterationListener.java: record (iteration, score) pairs."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class EvaluativeListener(TrainingListener):
+    """EvaluativeListener.java: run evaluation every N iterations/epochs."""
+
+    def __init__(self, iterator, frequency: int = 1, unit: str = "epoch"):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.unit = unit
+        self.evaluations: List[Any] = []
+
+    def _evaluate(self, model):
+        e = model.evaluate(self.iterator)
+        self.evaluations.append(e)
+        logger.info("EvaluativeListener accuracy: %.4f", e.accuracy())
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self.unit == "iteration" and iteration and iteration % self.frequency == 0:
+            self._evaluate(model)
+
+    def on_epoch_end(self, model):
+        if self.unit == "epoch":
+            self._evaluate(model)
+
+
+class CheckpointListener(TrainingListener):
+    """CheckpointListener.java: periodic model save with retention.
+
+    save_every_n_iterations / save_every_n_epochs; keep_last N deletes older
+    checkpoints (reference keepLast/keepEvery retention policy).
+    """
+
+    def __init__(self, directory: str, save_every_n_iterations: Optional[int] = None,
+                 save_every_n_epochs: Optional[int] = None, keep_last: Optional[int] = None):
+        self.dir = directory
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.keep_last = keep_last
+        self.saved: List[str] = []
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, tag: str):
+        from deeplearning4j_tpu.nn.serde import save_model
+
+        path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
+        save_model(model, path)
+        self.saved.append(path)
+        if self.keep_last and len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+        logger.info("checkpoint saved: %s", path)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self.every_iter and iteration and iteration % self.every_iter == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def on_epoch_end(self, model):
+        if self.every_epoch:
+            ep = getattr(model, "epoch_count", 0)
+            if ep % self.every_epoch == 0:
+                self._save(model, f"epoch_{ep}")

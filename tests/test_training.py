@@ -1,0 +1,211 @@
+"""End-to-end training tests — the reference's MultiLayerTest /
+gradient-descent convergence tests + ModelSerializer round-trip
+(SURVEY §5.1, §6.4). Includes the BASELINE config[0] LeNet-MNIST smoke gate.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator, NormalizerStandardize
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.eval import Evaluation
+
+
+def xor_data(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64)
+    labels = np.zeros((n, 2), np.float32)
+    labels[np.arange(n), y] = 1.0
+    return x, labels
+
+
+class TestTrainingLoop:
+    def test_xor_converges(self):
+        x, y = xor_data()
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(12).updater(nn.Adam(learning_rate=0.02))
+            .weight_init("xavier").list()
+            .layer(nn.DenseLayer(n_out=32, activation="tanh"))
+            .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(2)).build()
+        ).init()
+        net.fit(x, y, epochs=150, batch_size=128)
+        acc = (net.predict(x) == y.argmax(-1)).mean()
+        assert acc > 0.95, f"XOR accuracy {acc}"
+        assert net.score() < 0.25
+
+    def test_regression_mse(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(256, 4).astype(np.float32)
+        w = rng.randn(4, 1).astype(np.float32)
+        y = x @ w + 0.7
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(5).updater(nn.Adam(learning_rate=0.05)).list()
+            .layer(nn.OutputLayer(n_out=1, activation="identity", loss="mse"))
+            .set_input_type(nn.InputType.feed_forward(4)).build()
+        ).init()
+        net.fit(x, y, epochs=100, batch_size=256)
+        learned_w = np.asarray(net.params[0]["W"])
+        np.testing.assert_allclose(learned_w, w, atol=0.05)
+
+    def test_listeners_called(self):
+        x, y = xor_data(128)
+        net = nn.MultiLayerNetwork(
+            nn.builder().list()
+            .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(2)).build()
+        ).init()
+        collect = nn.CollectScoresIterationListener()
+        net.set_listeners(collect, nn.ScoreIterationListener(5))
+        net.fit(x, y, epochs=2, batch_size=32)
+        assert len(collect.scores) == 8  # 4 batches × 2 epochs
+
+    def test_l2_regularization_shrinks_weights(self):
+        x, y = xor_data(256)
+        def build(l2):
+            return nn.MultiLayerNetwork(
+                nn.builder().seed(9).updater(nn.Sgd(learning_rate=0.1)).l2(l2).list()
+                .layer(nn.DenseLayer(n_out=32, activation="tanh"))
+                .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(nn.InputType.feed_forward(2)).build()
+            ).init()
+        a, b = build(0.0), build(0.1)
+        a.fit(x, y, epochs=20, batch_size=64)
+        b.fit(x, y, epochs=20, batch_size=64)
+        na = np.abs(np.asarray(a.params[0]["W"])).mean()
+        nb = np.abs(np.asarray(b.params[0]["W"])).mean()
+        assert nb < na
+
+    def test_gradient_clipping_runs(self):
+        x, y = xor_data(64)
+        net = nn.MultiLayerNetwork(
+            nn.builder().gradient_normalization("clip_l2_per_layer", 1.0).list()
+            .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(2)).build()
+        ).init()
+        net.fit(x, y, epochs=1, batch_size=32)
+        assert np.isfinite(net.score())
+
+    def test_batchnorm_network_trains(self):
+        x, y = xor_data(256)
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(2).updater(nn.Adam(learning_rate=0.02)).list()
+            .layer(nn.DenseLayer(n_out=16, activation="identity"))
+            .layer(nn.BatchNormalization())
+            .layer(nn.ActivationLayer(activation="relu"))
+            .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(2)).build()
+        ).init()
+        net.fit(x, y, epochs=40, batch_size=64)
+        acc = (net.predict(x) == y.argmax(-1)).mean()
+        assert acc > 0.9
+        # running stats were updated away from init
+        assert np.abs(np.asarray(net.net_state[1]["mean"])).sum() > 0
+
+
+class TestLeNetMnist:
+    """BASELINE config[0]: LeNet-5 MNIST single-chip smoke gate."""
+
+    @staticmethod
+    def lenet():
+        return nn.MultiLayerNetwork(
+            nn.builder().seed(123).updater(nn.Adam(learning_rate=1e-3))
+            .weight_init("xavier").list()
+            .layer(nn.ConvolutionLayer(n_out=20, kernel=(5, 5), activation="relu"))
+            .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(nn.ConvolutionLayer(n_out=50, kernel=(5, 5), activation="relu"))
+            .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(nn.DenseLayer(n_out=500, activation="relu"))
+            .layer(nn.OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.convolutional_flat(28, 28, 1)).build()
+        ).init()
+
+    def test_lenet_mnist_converges(self):
+        train = MnistDataSetIterator(batch_size=128, train=True, num_examples=2048)
+        test = MnistDataSetIterator(batch_size=256, train=False, num_examples=512)
+        net = self.lenet()
+        net.fit(train, epochs=3)
+        e: Evaluation = net.evaluate(test)
+        assert e.accuracy() > 0.90, f"LeNet MNIST accuracy {e.accuracy()}\n{e.stats()}"
+
+
+class TestSerde:
+    def test_save_restore_round_trip(self, tmp_path):
+        x, y = xor_data(128)
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(11).updater(nn.Adam(learning_rate=0.01)).list()
+            .layer(nn.DenseLayer(n_out=8, activation="relu"))
+            .layer(nn.BatchNormalization())
+            .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(2)).build()
+        ).init()
+        net.fit(x, y, epochs=3, batch_size=32)
+        path = str(tmp_path / "model.zip")
+        nn.save_model(net, path)
+        net2 = nn.restore_model(path)
+        np.testing.assert_allclose(net2.output(x), net.output(x), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(net2.params_flat(), net.params_flat(), rtol=1e-6)
+        # exact resume: continue training both, trajectories must match
+        net.fit(x, y, epochs=1, batch_size=32)
+        net2.fit(x, y, epochs=1, batch_size=32)
+        np.testing.assert_allclose(net2.params_flat(), net.params_flat(), rtol=1e-4, atol=1e-5)
+
+    def test_normalizer_round_trip(self, tmp_path):
+        x, y = xor_data(64)
+        ds = DataSet(x, y)
+        norm = NormalizerStandardize()
+        norm.fit(ds)
+        net = nn.MultiLayerNetwork(
+            nn.builder().list()
+            .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(2)).build()
+        ).init()
+        path = str(tmp_path / "m.zip")
+        nn.save_model(net, path, normalizer=norm)
+        norm2 = nn.restore_normalizer(path)
+        np.testing.assert_allclose(norm2.mean, norm.mean)
+        np.testing.assert_allclose(norm2.std, norm.std)
+
+    def test_params_flat_set_round_trip(self):
+        net = TestLeNetMnist.lenet()
+        flat = net.params_flat()
+        flat2 = flat + 0.25
+        net.set_params_flat(flat2)
+        np.testing.assert_allclose(net.params_flat(), flat2, rtol=1e-6)
+
+
+class TestEvaluation:
+    def test_evaluation_counts(self):
+        e = Evaluation()
+        labels = np.eye(3)[[0, 1, 2, 2]]
+        preds = np.eye(3)[[0, 1, 1, 2]]
+        e.eval(labels, preds)
+        assert e.accuracy() == pytest.approx(0.75)
+        assert e.confusion[2, 1] == 1
+        assert "Accuracy" in e.stats()
+
+    def test_merge(self):
+        a, b = Evaluation(), Evaluation()
+        a.eval(np.eye(2)[[0]], np.eye(2)[[0]])
+        b.eval(np.eye(2)[[1]], np.eye(2)[[0]])
+        a.merge(b)
+        assert a.accuracy() == pytest.approx(0.5)
+
+    def test_roc_auc_perfect(self):
+        from deeplearning4j_tpu.eval import ROC
+
+        r = ROC()
+        r.eval(np.array([1, 1, 0, 0]), np.array([0.9, 0.8, 0.2, 0.1]))
+        assert r.calculate_auc() == pytest.approx(1.0)
+
+    def test_regression_eval(self):
+        from deeplearning4j_tpu.eval import RegressionEvaluation
+
+        r = RegressionEvaluation()
+        r.eval(np.array([[1.0], [2.0]]), np.array([[1.1], [1.9]]))
+        assert r.mean_squared_error(0) == pytest.approx(0.01, rel=1e-3)
+        assert r.r_squared(0) > 0.9
