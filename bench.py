@@ -1,10 +1,14 @@
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Measures training throughput (images/sec) of the flagship model on the
-default JAX backend (the real TPU chip under the driver; XLA-CPU locally).
-The baseline reference (BASELINE.json) published no numbers
-(``published == {}``), so ``vs_baseline`` ratchets against the last recorded
-value in BENCH_HISTORY.json (1.0 on first run).
+Headline metric (BASELINE.json): ResNet-50 ImageNet-shaped training
+throughput, images/sec/chip, on the default JAX backend (the real TPU chip
+under the driver). The reference published no numbers
+(``BASELINE.json.published == {}``), so ``vs_baseline`` ratchets against the
+last recorded value in BENCH_HISTORY.json (1.0 on first run).
+
+Env knobs: BENCH_BATCH (default 64), BENCH_ITERS (default 20),
+BENCH_MODEL (resnet50 | lenet), BENCH_IMAGE (default 224; resnet50 only —
+LeNet is fixed 28×28 MNIST).
 """
 
 from __future__ import annotations
@@ -16,69 +20,100 @@ import time
 import numpy as np
 
 
-def main() -> None:
+def _bench_resnet50(batch: int, iters: int, image: int):
     import jax
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu import nn
-    from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+    from deeplearning4j_tpu import models, nn
+    from deeplearning4j_tpu.datasets.image import synthetic_image_batch
 
-    BATCH = 256
-    net = nn.MultiLayerNetwork(
-        nn.builder().seed(123)
-        .updater(nn.Adam(learning_rate=1e-3)).weight_init("xavier").list()
-        .layer(nn.ConvolutionLayer(n_out=20, kernel=(5, 5), activation="relu"))
-        .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
-        .layer(nn.ConvolutionLayer(n_out=50, kernel=(5, 5), activation="relu"))
-        .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
-        .layer(nn.DenseLayer(n_out=500, activation="relu"))
-        .layer(nn.OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
-        .set_input_type(nn.InputType.convolutional_flat(28, 28, 1))
-        .build()
-    ).init()
-
-    feats, labels = synthetic_mnist(BATCH)
-    y = np.zeros((BATCH, 10), np.float32)
-    y[np.arange(BATCH), labels] = 1.0
-    x = jnp.asarray(feats)
+    net = models.ResNet50(num_classes=1000, input_shape=(image, image, 3),
+                          updater=nn.Nesterovs(learning_rate=0.1, momentum=0.9)).init()
+    imgs, labels = synthetic_image_batch(batch, image, image, 3, 1000, seed=0)
+    y = np.zeros((batch, 1000), np.float32)
+    y[np.arange(batch), labels] = 1.0
+    x = jnp.asarray(imgs)
     yj = jnp.asarray(y)
+    in_name = net.conf.network_inputs[0]
+    out_name = net.conf.network_outputs[0]
 
     step_fn = net._make_train_step()
     params, opt_state, net_state = net.params, net.opt_state, net.net_state
     key = jax.random.key(0)
 
-    def one(i, params, opt_state, net_state):
-        return step_fn(params, opt_state, net_state,
-                       jnp.asarray(i, jnp.int32), key, x, yj, None, None)
+    def one(i, p, o, s):
+        return step_fn(p, o, s, jnp.asarray(i, jnp.int32), key,
+                       {in_name: x}, {out_name: yj}, None, None)
 
-    # warmup/compile
     params, opt_state, net_state, loss = one(0, params, opt_state, net_state)
-    loss.block_until_ready()
-
-    iters = 50
+    loss.block_until_ready()  # compile + warmup
     t0 = time.perf_counter()
     for i in range(1, iters + 1):
         params, opt_state, net_state, loss = one(i, params, opt_state, net_state)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
-    imgs_per_sec = BATCH * iters / dt
+    return batch * iters / dt, "resnet50_imagenet_train_images_per_sec"
+
+
+def _bench_lenet(batch: int, iters: int):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import models
+    from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+
+    net = models.LeNet(num_classes=10).init()
+    feats, labels = synthetic_mnist(batch)
+    y = np.zeros((batch, 10), np.float32)
+    y[np.arange(batch), labels] = 1.0
+    x = jnp.asarray(feats)
+    yj = jnp.asarray(y)
+    step_fn = net._make_train_step()
+    params, opt_state, net_state = net.params, net.opt_state, net.net_state
+    key = jax.random.key(0)
+
+    def one(i, p, o, s):
+        return step_fn(p, o, s, jnp.asarray(i, jnp.int32), key, x, yj, None, None)
+
+    params, opt_state, net_state, loss = one(0, params, opt_state, net_state)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(1, iters + 1):
+        params, opt_state, net_state, loss = one(i, params, opt_state, net_state)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    return batch * iters / dt, "lenet5_mnist_train_images_per_sec"
+
+
+def main() -> None:
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+
+    if model == "lenet":
+        value, metric = _bench_lenet(batch, iters)
+    else:
+        value, metric = _bench_resnet50(batch, iters, image)
 
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.json")
-    prev = None
+    hist = {}
     if os.path.exists(hist_path):
         try:
-            prev = json.load(open(hist_path)).get("value")
+            hist = json.load(open(hist_path))
         except Exception:
-            prev = None
-    vs_baseline = imgs_per_sec / prev if prev else 1.0
+            hist = {}
+    prev = hist.get(metric)
+    vs_baseline = value / prev if prev else 1.0
     try:
-        json.dump({"value": imgs_per_sec}, open(hist_path, "w"))
+        hist[metric] = value
+        json.dump(hist, open(hist_path, "w"))
     except Exception:
         pass
 
     print(json.dumps({
-        "metric": "lenet5_mnist_train_images_per_sec",
-        "value": round(imgs_per_sec, 1),
+        "metric": metric,
+        "value": round(value, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs_baseline, 3),
     }))

@@ -1,0 +1,8 @@
+"""Autodiff graph engine — the SameDiff role (SURVEY §3.2, §4.3)."""
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable, TrainingConfig
+from deeplearning4j_tpu.autodiff.gradcheck import (
+    check_gradients,
+    check_gradients_fn,
+    check_samediff_gradients,
+)

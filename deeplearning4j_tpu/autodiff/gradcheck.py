@@ -1,0 +1,140 @@
+"""Gradient checking — the correctness workhorse (SURVEY §5.2).
+
+Reference parity:
+  * org/deeplearning4j/gradientcheck/GradientCheckUtil.java — central finite
+    differences vs analytic backprop per parameter, in DOUBLE, with
+    max-relative-error thresholds.
+  * org/nd4j/autodiff/validation/{OpValidation, GradCheckUtil}.java — the
+    SameDiff-side equivalent + per-op validation TestCase.
+
+These helpers check OUR whole-graph jax.grad against finite differences of
+the same compiled forward. Because both run the same XLA computation, this
+validates the end-to-end trace (layer math, preprocessors, loss reduction),
+exactly what the reference's checkGradients validates for the hand-written
+backprop stack.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+DEFAULT_EPS = 1e-6
+DEFAULT_MAX_REL_ERROR = 1e-3
+DEFAULT_MIN_ABS_ERROR = 1e-8
+
+
+def _rel_error(a: float, n: float, min_abs: float) -> float:
+    if abs(a - n) < min_abs:
+        return 0.0
+    denom = abs(a) + abs(n)
+    return abs(a - n) / denom if denom > 0 else 0.0
+
+
+def check_gradients_fn(loss_fn, params, *, eps: float = DEFAULT_EPS,
+                       max_rel_error: float = DEFAULT_MAX_REL_ERROR,
+                       min_abs_error: float = DEFAULT_MIN_ABS_ERROR,
+                       max_per_param: int = 25,
+                       seed: int = 0, print_failures: bool = True) -> bool:
+    """Check jax.grad(loss_fn) vs central finite differences.
+
+    loss_fn: pytree params -> scalar. Checks up to ``max_per_param`` randomly
+    chosen coordinates per leaf (the reference subsamples the same way for
+    big layers). Runs under a SCOPED x64 context — GradientCheckUtil mandates
+    DataType.DOUBLE, but the rest of the framework stays f32.
+    """
+    with jax.enable_x64():
+        return _check_gradients_fn_x64(
+            loss_fn, params, eps=eps, max_rel_error=max_rel_error,
+            min_abs_error=min_abs_error, max_per_param=max_per_param,
+            seed=seed, print_failures=print_failures)
+
+
+def _check_gradients_fn_x64(loss_fn, params, *, eps, max_rel_error,
+                            min_abs_error, max_per_param, seed, print_failures) -> bool:
+    params = jax.tree.map(lambda a: jnp.asarray(np.asarray(a), jnp.float64), params)
+    analytic = jax.grad(loss_fn)(params)
+    leaves_p, treedef = jax.tree.flatten(params)
+    leaves_g = treedef.flatten_up_to(analytic)
+    rng = np.random.RandomState(seed)
+    ok = True
+    for li, (p, g) in enumerate(zip(leaves_p, leaves_g)):
+        p_np = np.asarray(p, np.float64)
+        g_np = np.asarray(g, np.float64)
+        n = p_np.size
+        idxs = range(n) if n <= max_per_param else rng.choice(n, max_per_param, replace=False)
+        for i in idxs:
+            orig = p_np.reshape(-1)[i]
+
+            def loss_at(v):
+                pp = p_np.copy().reshape(-1)
+                pp[i] = v
+                new_leaves = list(leaves_p)
+                new_leaves[li] = jnp.asarray(pp.reshape(p_np.shape))
+                return float(loss_fn(treedef.unflatten(new_leaves)))
+
+            num = (loss_at(orig + eps) - loss_at(orig - eps)) / (2 * eps)
+            ana = g_np.reshape(-1)[i]
+            rel = _rel_error(ana, num, min_abs_error)
+            if rel > max_rel_error:
+                ok = False
+                if print_failures:
+                    print(f"GRADCHECK FAIL leaf {li} idx {i}: analytic={ana:.8g} "
+                          f"numeric={num:.8g} rel={rel:.3g}")
+    return ok
+
+
+def check_gradients(net, features, labels, *, eps: float = DEFAULT_EPS,
+                    max_rel_error: float = DEFAULT_MAX_REL_ERROR,
+                    min_abs_error: float = DEFAULT_MIN_ABS_ERROR,
+                    max_per_param: int = 25, seed: int = 0,
+                    features_mask=None, labels_mask=None) -> bool:
+    """GradientCheckUtil.checkGradients(MultiLayerNetwork, ...) analog.
+
+    Checks the full forward+loss of a MultiLayerNetwork (train=False so
+    dropout/BN-stat updates don't spoil determinism, matching the reference's
+    requirement that gradient checks disable dropout).
+    """
+    with jax.enable_x64():
+        x = jnp.asarray(np.asarray(features), jnp.float64)
+        y = jnp.asarray(np.asarray(labels), jnp.float64)
+        fm = None if features_mask is None else jnp.asarray(np.asarray(features_mask), jnp.float64)
+        lm = None if labels_mask is None else jnp.asarray(np.asarray(labels_mask), jnp.float64)
+        net_state = jax.tree.map(lambda a: jnp.asarray(np.asarray(a), jnp.float64), net.net_state)
+
+        def loss_fn(params):
+            out, _ = net._forward(params, net_state, x, fm, train=False, rng=None)
+            return net._loss_from_out(out, y, lm)
+
+        return _check_gradients_fn_x64(
+            loss_fn, net.params, eps=eps, max_rel_error=max_rel_error,
+            min_abs_error=min_abs_error, max_per_param=max_per_param, seed=seed,
+            print_failures=True)
+
+
+def check_samediff_gradients(sd, feeds: Dict[str, np.ndarray], loss_name: str,
+                             *, eps: float = DEFAULT_EPS,
+                             max_rel_error: float = DEFAULT_MAX_REL_ERROR,
+                             max_per_param: int = 25, seed: int = 0) -> bool:
+    """GradCheckUtil.checkGradients(SameDiff) analog."""
+    trainable = [n for n, v in sd._vars.items() if v.vtype == "VARIABLE"]
+    with jax.enable_x64():
+        feeds64 = {k: jnp.asarray(np.asarray(v), jnp.float64) for k, v in feeds.items()}
+        others = {n: jnp.asarray(np.asarray(a), jnp.float64) for n, a in sd._arrays.items()
+                  if n not in trainable}
+
+        def loss_fn(train_vars):
+            env = dict(others)
+            env.update(train_vars)
+            env.update(feeds64)
+            return sd._interpret(env, [loss_name])[loss_name]
+
+        params = {n: jnp.asarray(np.asarray(sd._arrays[n]), jnp.float64) for n in trainable}
+        return _check_gradients_fn_x64(
+            loss_fn, params, eps=eps, max_rel_error=max_rel_error,
+            min_abs_error=DEFAULT_MIN_ABS_ERROR, max_per_param=max_per_param,
+            seed=seed, print_failures=True)

@@ -1,0 +1,854 @@
+"""SameDiff — the define-then-run autodiff graph engine, TPU-native.
+
+Reference parity:
+  * org/nd4j/autodiff/samediff/SameDiff.java (~12k lines — graph build,
+    variables, createGradFunction, fit, FlatBuffers serde) and SDVariable.java.
+  * org/nd4j/autodiff/samediff/internal/{AbstractSession, InferenceSession,
+    TrainingSession}.java — the dependency-tracked op-by-op interpreter.
+  * op factories: ops/SDMath.java, SDNN.java, SDCNN.java, SDRNN.java,
+    SDLoss.java, SDImage.java (code-generated in the reference).
+
+TPU-native realization (SURVEY §4.3 mapping): the user still builds a graph
+of named variables and recorded ops (API parity), but execution TRACES the
+whole graph into one function that jit-compiles to a single XLA computation —
+the reference's per-node interpreter (one JNI crossing per op per step)
+disappears. Autodiff is jax.grad over that traced function, replacing ~500
+hand-written ``doDiff`` rules. ``createGradFunction`` exists for API parity
+and simply marks gradients as requested outputs.
+
+Serde: JSON graph-def + npz arrays (the FlatBuffers-file analog), plus
+StableHLO text export of the compiled computation (`as_stablehlo`).
+"""
+
+from __future__ import annotations
+
+import json
+import zipfile
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops import registry as op_registry
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops import losses as loss_lib
+
+
+class SDVariable:
+    """SDVariable.java analog: a named symbolic tensor in one SameDiff graph.
+
+    variable_type: PLACEHOLDER | VARIABLE (trainable) | CONSTANT | ARRAY
+    (op output) — mirrors org.nd4j.autodiff.samediff.VariableType.
+    """
+
+    def __init__(self, sd: "SameDiff", name: str, vtype: str,
+                 shape: Optional[Tuple[int, ...]] = None, dtype=jnp.float32):
+        self.sd = sd
+        self.name = name
+        self.vtype = vtype
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+
+    # ---- python operator sugar (SDVariable.add/mul/... in the reference) --
+    def _bin(self, op: str, other) -> "SDVariable":
+        other = self.sd._lift(other)
+        return self.sd._record(op, [self, other])
+
+    def __add__(self, o):
+        return self._bin("add", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._bin("sub", o)
+
+    def __rsub__(self, o):
+        return self.sd._lift(o)._bin("sub", self)
+
+    def __mul__(self, o):
+        return self._bin("mul", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._bin("div", o)
+
+    def __rtruediv__(self, o):
+        return self.sd._lift(o)._bin("div", self)
+
+    def __pow__(self, o):
+        return self._bin("pow", o)
+
+    def __neg__(self):
+        return self.sd._record("neg", [self])
+
+    def __matmul__(self, o):
+        return self._bin("mmul", o)
+
+    # ---- common methods ---------------------------------------------------
+    def add(self, o):
+        return self.__add__(o)
+
+    def sub(self, o):
+        return self.__sub__(o)
+
+    def mul(self, o):
+        return self.__mul__(o)
+
+    def div(self, o):
+        return self.__truediv__(o)
+
+    def mmul(self, o):
+        return self.__matmul__(o)
+
+    def reshape(self, *shape):
+        return self.sd._record("reshape", [self], {"shape": tuple(int(s) for s in shape)})
+
+    def transpose(self, *axes):
+        return self.sd._record("transpose", [self], {"axes": axes or None})
+
+    def sum(self, *axes, keepdims=False):
+        return self.sd._record("reduce_sum", [self], {"axes": axes or None, "keepdims": keepdims})
+
+    def mean(self, *axes, keepdims=False):
+        return self.sd._record("reduce_mean", [self], {"axes": axes or None, "keepdims": keepdims})
+
+    def max(self, *axes, keepdims=False):
+        return self.sd._record("reduce_max", [self], {"axes": axes or None, "keepdims": keepdims})
+
+    def min(self, *axes, keepdims=False):
+        return self.sd._record("reduce_min", [self], {"axes": axes or None, "keepdims": keepdims})
+
+    def std(self, *axes, keepdims=False):
+        return self.sd._record("reduce_std", [self], {"axes": axes or None, "keepdims": keepdims})
+
+    def argmax(self, axis=-1):
+        return self.sd._record("argmax", [self], {"axis": axis})
+
+    def rename(self, new_name: str) -> "SDVariable":
+        self.sd._rename(self.name, new_name)
+        return self
+
+    def eval(self, feeds: Optional[Dict[str, Any]] = None):
+        """Evaluate just this variable (SDVariable.eval)."""
+        return self.sd.output(feeds or {}, [self.name])[self.name]
+
+    def __repr__(self):
+        return f"SDVariable(name={self.name!r}, type={self.vtype}, shape={self.shape})"
+
+
+class _Node:
+    """One recorded op application (the reference's SameDiffOp entry)."""
+
+    __slots__ = ("op", "inputs", "kwargs", "outputs")
+
+    def __init__(self, op: str, inputs: List[str], kwargs: Dict[str, Any], outputs: List[str]):
+        self.op = op
+        self.inputs = inputs
+        self.kwargs = kwargs
+        self.outputs = outputs
+
+
+# ---------------------------------------------------------------------------
+# Op implementations available to graphs. Each entry: name -> callable taking
+# (*input_arrays, **kwargs). Drawn from jnp/lax plus the declarable-op
+# registry (conv2d etc.), mirroring the reference op catalog naming.
+# ---------------------------------------------------------------------------
+
+
+def _reduce(fn):
+    def wrap(x, *, axes=None, keepdims=False):
+        ax = None if not axes else tuple(a for a in axes)
+        return fn(x, axis=ax, keepdims=keepdims)
+
+    return wrap
+
+
+GRAPH_OPS: Dict[str, Callable[..., Any]] = {
+    # elementwise binary
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b,
+    "pow": lambda a, b: a**b,
+    "floormod": lambda a, b: jnp.mod(a, b),
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "squared_difference": lambda a, b: (a - b) ** 2,
+    # comparisons
+    "gt": lambda a, b: (a > b).astype(jnp.float32),
+    "lt": lambda a, b: (a < b).astype(jnp.float32),
+    "gte": lambda a, b: (a >= b).astype(jnp.float32),
+    "lte": lambda a, b: (a <= b).astype(jnp.float32),
+    "eq": lambda a, b: (a == b).astype(jnp.float32),
+    "neq": lambda a, b: (a != b).astype(jnp.float32),
+    # elementwise unary
+    "neg": lambda a: -a,
+    "abs": jnp.abs,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda a: jax.lax.rsqrt(a),
+    "square": jnp.square,
+    "reciprocal": lambda a: 1.0 / a,
+    "sign": jnp.sign,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "erf": jax.lax.erf,
+    "clip_by_value_graph": lambda a, *, min_value, max_value: jnp.clip(a, min_value, max_value),
+    "cast": lambda a, *, dtype: a.astype(jnp.dtype(dtype)),
+    # activations
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leakyrelu": lambda a, *, alpha=0.01: jax.nn.leaky_relu(a, alpha),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "sigmoid": jax.nn.sigmoid,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "swish": jax.nn.swish,
+    "mish": jax.nn.mish,
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "hardtanh": jax.nn.hard_tanh,
+    "softmax": lambda a, *, axis=-1: jax.nn.softmax(a, axis=axis),
+    "log_softmax": lambda a, *, axis=-1: jax.nn.log_softmax(a, axis=axis),
+    # linalg / shape
+    "mmul": lambda a, b, *, transpose_a=False, transpose_b=False: jnp.matmul(
+        jnp.swapaxes(a, -1, -2) if transpose_a else a,
+        jnp.swapaxes(b, -1, -2) if transpose_b else b),
+    "tensordot": lambda a, b, *, axes: jnp.tensordot(a, b, axes=axes),
+    "reshape": lambda a, *, shape: jnp.reshape(a, shape),
+    "transpose": lambda a, *, axes=None: jnp.transpose(a, axes),
+    "permute": lambda a, *, axes: jnp.transpose(a, axes),
+    "expand_dims": lambda a, *, axis: jnp.expand_dims(a, axis),
+    "squeeze": lambda a, *, axis=None: jnp.squeeze(a, axis),
+    "concat": lambda *xs, axis=0: jnp.concatenate(xs, axis=axis),
+    "stack": lambda *xs, axis=0: jnp.stack(xs, axis=axis),
+    "unstack_first": lambda x: x[0],
+    "slice": lambda a, *, begin, size: jax.lax.dynamic_slice(a, begin, size),
+    "strided_slice": lambda a, *, begin, end, strides=None: a[
+        tuple(slice(b, e, s) for b, e, s in zip(begin, end, strides or [1] * len(begin)))],
+    "gather": lambda params, indices, *, axis=0: jnp.take(params, indices.astype(jnp.int32), axis=axis),
+    "tile": lambda a, *, reps: jnp.tile(a, reps),
+    "pad": lambda a, *, paddings, value=0.0: jnp.pad(a, paddings, constant_values=value),
+    "shape_of": lambda a: jnp.asarray(a.shape, jnp.int32),
+    "size": lambda a: jnp.asarray(a.size, jnp.int32),
+    "one_hot_graph": lambda a, *, depth: jax.nn.one_hot(a.astype(jnp.int32), depth),
+    "where": jnp.where,
+    "select": jnp.where,
+    # reductions
+    "reduce_sum": _reduce(jnp.sum),
+    "reduce_mean": _reduce(jnp.mean),
+    "reduce_max": _reduce(jnp.max),
+    "reduce_min": _reduce(jnp.min),
+    "reduce_prod": _reduce(jnp.prod),
+    "reduce_std": _reduce(jnp.std),
+    "reduce_var": _reduce(jnp.var),
+    "argmax": lambda a, *, axis=-1: jnp.argmax(a, axis=axis),
+    "argmin": lambda a, *, axis=-1: jnp.argmin(a, axis=axis),
+    "cumsum": lambda a, *, axis=0: jnp.cumsum(a, axis=axis),
+    "norm2": lambda a, *, axes=None: jnp.sqrt(jnp.sum(a**2, axis=None if not axes else tuple(axes))),
+    # nn composites
+    "linear": lambda x, w, b=None: (x @ w + b) if b is not None else x @ w,
+    "layer_norm_graph": lambda x, gain, bias=None, *, axis=-1, eps=1e-5: _layer_norm(x, gain, bias, axis, eps),
+    "batch_norm_graph": lambda x, mean, var, gamma, beta, *, eps=1e-5: (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta,
+    "dropout_graph": lambda x, *, rate, seed=0: x,  # inference identity; training uses rng plumbing
+    # losses (feed probabilities/logits per name, as the reference does)
+    "softmax_cross_entropy": lambda logits, labels: loss_lib.softmax_cross_entropy_with_logits(logits, labels),
+    "sparse_softmax_cross_entropy": lambda logits, ids: loss_lib.sparse_mcxent(logits, ids),
+    "sigmoid_cross_entropy": lambda logits, labels: loss_lib.sigmoid_cross_entropy_with_logits(logits, labels),
+    "mean_squared_error": lambda pred, labels: loss_lib.mse(pred, labels),
+    "absolute_difference": lambda pred, labels: loss_lib.mae(pred, labels),
+    "log_loss": lambda probs, labels: loss_lib.binary_xent(probs, labels),
+    "huber_loss": lambda pred, labels, *, delta=1.0: _huber(pred, labels, delta),
+    "cosine_distance": lambda a, b: loss_lib.cosine_proximity(a, b),
+}
+
+
+def _layer_norm(x, gain, bias, axis, eps):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps) * gain
+    return out + bias if bias is not None else out
+
+
+def _huber(pred, labels, delta):
+    err = jnp.abs(pred - labels)
+    quad = jnp.minimum(err, delta)
+    return jnp.mean(0.5 * quad**2 + delta * (err - quad))
+
+
+def resolve_graph_op(name: str) -> Callable[..., Any]:
+    if name in GRAPH_OPS:
+        return GRAPH_OPS[name]
+    reg = op_registry()
+    if name in reg:
+        return reg.get(name).fn
+    raise KeyError(f"unknown graph op '{name}'")
+
+
+# ---------------------------------------------------------------------------
+# Namespaced op factories (SDMath/SDNN/SDCNN/SDRNN/SDLoss analogs)
+# ---------------------------------------------------------------------------
+
+
+class _Namespace:
+    def __init__(self, sd: "SameDiff"):
+        self._sd = sd
+
+
+class SDMath(_Namespace):
+    def _u(self, op, x, **kw):
+        return self._sd._record(op, [self._sd._lift(x)], kw)
+
+    def abs(self, x):
+        return self._u("abs", x)
+
+    def exp(self, x):
+        return self._u("exp", x)
+
+    def log(self, x):
+        return self._u("log", x)
+
+    def sqrt(self, x):
+        return self._u("sqrt", x)
+
+    def square(self, x):
+        return self._u("square", x)
+
+    def sin(self, x):
+        return self._u("sin", x)
+
+    def cos(self, x):
+        return self._u("cos", x)
+
+    def tanh(self, x):
+        return self._u("tanh", x)
+
+    def erf(self, x):
+        return self._u("erf", x)
+
+    def sign(self, x):
+        return self._u("sign", x)
+
+    def floor(self, x):
+        return self._u("floor", x)
+
+    def neg(self, x):
+        return self._u("neg", x)
+
+    def max(self, a, b):
+        return self._sd._record("maximum", [self._sd._lift(a), self._sd._lift(b)])
+
+    def min(self, a, b):
+        return self._sd._record("minimum", [self._sd._lift(a), self._sd._lift(b)])
+
+    def clip_by_value(self, x, lo, hi):
+        return self._sd._record("clip_by_value_graph", [self._sd._lift(x)],
+                                {"min_value": lo, "max_value": hi})
+
+    def cast(self, x, dtype):
+        return self._sd._record("cast", [self._sd._lift(x)], {"dtype": str(np.dtype(dtype))})
+
+
+class SDNN(_Namespace):
+    def relu(self, x):
+        return self._sd._record("relu", [x])
+
+    def relu6(self, x):
+        return self._sd._record("relu6", [x])
+
+    def gelu(self, x):
+        return self._sd._record("gelu", [x])
+
+    def elu(self, x):
+        return self._sd._record("elu", [x])
+
+    def selu(self, x):
+        return self._sd._record("selu", [x])
+
+    def swish(self, x):
+        return self._sd._record("swish", [x])
+
+    def sigmoid(self, x):
+        return self._sd._record("sigmoid", [x])
+
+    def softplus(self, x):
+        return self._sd._record("softplus", [x])
+
+    def leaky_relu(self, x, alpha=0.01):
+        return self._sd._record("leakyrelu", [x], {"alpha": alpha})
+
+    def softmax(self, x, axis=-1):
+        return self._sd._record("softmax", [x], {"axis": axis})
+
+    def log_softmax(self, x, axis=-1):
+        return self._sd._record("log_softmax", [x], {"axis": axis})
+
+    def linear(self, x, w, b=None):
+        ins = [x, w] + ([b] if b is not None else [])
+        return self._sd._record("linear", ins)
+
+    def layer_norm(self, x, gain, bias=None, axis=-1, eps=1e-5):
+        ins = [x, gain] + ([bias] if bias is not None else [])
+        return self._sd._record("layer_norm_graph", ins, {"axis": axis, "eps": eps})
+
+    def batch_norm(self, x, mean, var, gamma, beta, eps=1e-5):
+        return self._sd._record("batch_norm_graph", [x, mean, var, gamma, beta], {"eps": eps})
+
+    def dropout(self, x, rate):
+        return self._sd._record("dropout_graph", [x], {"rate": rate})
+
+    def multi_head_dot_product_attention(self, q, k, v, wq, wk, wv, wo, num_heads):
+        return self._sd._record(
+            "multi_head_dot_product_attention", [q, k, v, wq, wk, wv, wo],
+            {"num_heads": num_heads})
+
+    def dot_product_attention(self, q, k, v):
+        return self._sd._record("dot_product_attention", [q, k, v])
+
+
+class SDCNN(_Namespace):
+    def conv2d(self, x, w, b=None, *, stride=1, padding="same", dilation=1):
+        ins = [x, w] + ([b] if b is not None else [])
+        return self._sd._record("conv2d", ins, {"stride": stride, "padding": padding,
+                                                "dilation": dilation})
+
+    def max_pooling2d(self, x, *, kernel, stride=None, padding="valid"):
+        return self._sd._record("maxpool2d", [x], {"kernel": kernel, "stride": stride,
+                                                   "padding": padding})
+
+    def avg_pooling2d(self, x, *, kernel, stride=None, padding="valid"):
+        return self._sd._record("avgpool2d", [x], {"kernel": kernel, "stride": stride,
+                                                   "padding": padding})
+
+    def upsampling2d(self, x, *, size=2):
+        return self._sd._record("upsampling2d", [x], {"size": size})
+
+
+class SDRNN(_Namespace):
+    def lstm_cell(self, x, h, c, w_ih, w_hh, b):
+        return self._sd._record("lstm_cell", [x, h, c, w_ih, w_hh, b], n_out=2)
+
+    def gru_cell(self, x, h, w_ih, w_hh, b_ih, b_hh):
+        return self._sd._record("gru_cell", [x, h, w_ih, w_hh, b_ih, b_hh])
+
+
+class SDLoss(_Namespace):
+    def softmax_cross_entropy(self, logits, labels):
+        return self._sd._record("softmax_cross_entropy", [logits, labels])
+
+    def sparse_softmax_cross_entropy(self, logits, ids):
+        return self._sd._record("sparse_softmax_cross_entropy", [logits, ids])
+
+    def sigmoid_cross_entropy(self, logits, labels):
+        return self._sd._record("sigmoid_cross_entropy", [logits, labels])
+
+    def mean_squared_error(self, pred, labels):
+        return self._sd._record("mean_squared_error", [pred, labels])
+
+    def absolute_difference(self, pred, labels):
+        return self._sd._record("absolute_difference", [pred, labels])
+
+    def log_loss(self, probs, labels):
+        return self._sd._record("log_loss", [probs, labels])
+
+    def huber_loss(self, pred, labels, delta=1.0):
+        return self._sd._record("huber_loss", [pred, labels], {"delta": delta})
+
+
+# ---------------------------------------------------------------------------
+# TrainingConfig (org/nd4j/autodiff/samediff/TrainingConfig.java)
+# ---------------------------------------------------------------------------
+
+
+class TrainingConfig:
+    def __init__(self, updater=None, l1: float = 0.0, l2: float = 0.0,
+                 weight_decay: float = 0.0,
+                 data_set_feature_mapping: Optional[Sequence[str]] = None,
+                 data_set_label_mapping: Optional[Sequence[str]] = None,
+                 loss_variables: Optional[Sequence[str]] = None):
+        from deeplearning4j_tpu.nn.updater import Adam, get_updater
+
+        self.updater = get_updater(updater) if updater is not None else Adam()
+        self.l1 = l1
+        self.l2 = l2
+        self.weight_decay = weight_decay
+        self.feature_mapping = list(data_set_feature_mapping or [])
+        self.label_mapping = list(data_set_label_mapping or [])
+        self.loss_variables = list(loss_variables or [])
+
+
+class SameDiff:
+    """The graph container + execution facade."""
+
+    def __init__(self) -> None:
+        self._vars: Dict[str, SDVariable] = {}
+        self._arrays: Dict[str, jnp.ndarray] = {}  # VARIABLE + CONSTANT values
+        self._nodes: List[_Node] = []
+        self._name_counter = 0
+        self.math = SDMath(self)
+        self.nn = SDNN(self)
+        self.cnn = SDCNN(self)
+        self.rnn = SDRNN(self)
+        self.loss = SDLoss(self)
+        self.training_config: Optional[TrainingConfig] = None
+        self._updater_state: Optional[Dict[str, Any]] = None
+        self._step = 0
+        self._jit_cache: Dict[Any, Any] = {}
+        self._grad_requested = False
+
+    # ------------------------------------------------------------- factories
+    @staticmethod
+    def create() -> "SameDiff":
+        return SameDiff()
+
+    def _fresh(self, prefix: str) -> str:
+        self._name_counter += 1
+        return f"{prefix}_{self._name_counter}"
+
+    def placeholder(self, name: str, shape: Sequence[Optional[int]] = None,
+                    dtype=jnp.float32) -> SDVariable:
+        v = SDVariable(self, name, "PLACEHOLDER",
+                       None if shape is None else tuple(-1 if s is None else s for s in shape),
+                       dtype)
+        self._vars[name] = v
+        return v
+
+    # reference alias
+    place_holder = placeholder
+
+    def var(self, name: str, array=None, shape: Sequence[int] = None,
+            dtype=jnp.float32, initializer: str = "xavier", key=None) -> SDVariable:
+        """Trainable variable — from array or (shape, weight-init scheme)."""
+        if array is None:
+            from deeplearning4j_tpu.ops.weight_init import init_weights
+
+            if shape is None:
+                raise ValueError("var() needs an array or a shape")
+            key = key if key is not None else jax.random.key(len(self._vars))
+            array = init_weights(key, tuple(shape), initializer, dtype=dtype)
+        arr = jnp.asarray(array)
+        v = SDVariable(self, name, "VARIABLE", arr.shape, arr.dtype)
+        self._vars[name] = v
+        self._arrays[name] = arr
+        return v
+
+    def constant(self, name_or_value, value=None) -> SDVariable:
+        if value is None:
+            name, value = self._fresh("const"), name_or_value
+        else:
+            name = name_or_value
+        arr = jnp.asarray(value)
+        v = SDVariable(self, name, "CONSTANT", arr.shape, arr.dtype)
+        self._vars[name] = v
+        self._arrays[name] = arr
+        return v
+
+    def _lift(self, x) -> SDVariable:
+        if isinstance(x, SDVariable):
+            return x
+        return self.constant(x)
+
+    def _rename(self, old: str, new: str) -> None:
+        if new in self._vars:
+            raise ValueError(f"variable '{new}' already exists")
+        v = self._vars.pop(old)
+        v.name = new
+        self._vars[new] = v
+        if old in self._arrays:
+            self._arrays[new] = self._arrays.pop(old)
+        for n in self._nodes:
+            n.inputs = [new if i == old else i for i in n.inputs]
+            n.outputs = [new if o == old else o for o in n.outputs]
+
+    # -------------------------------------------------------------- recording
+    def _record(self, op: str, inputs: List[SDVariable],
+                kwargs: Optional[Dict[str, Any]] = None, n_out: int = 1):
+        resolve_graph_op(op)  # fail fast on unknown op
+        out_names = [self._fresh(op) for _ in range(n_out)]
+        self._nodes.append(_Node(op, [v.name for v in inputs], dict(kwargs or {}), out_names))
+        outs = []
+        for n in out_names:
+            v = SDVariable(self, n, "ARRAY")
+            self._vars[n] = v
+            outs.append(v)
+        self._jit_cache.clear()  # graph changed; recompile
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    # -------------------------------------------------------------- execution
+    def _needed_nodes(self, wanted: Sequence[str]) -> List[_Node]:
+        """Ancestor subgraph of the wanted outputs (the dependency tracking
+        the reference's AbstractSession does per step — here once, at trace)."""
+        needed: set = set(wanted)
+        keep: List[_Node] = []
+        for node in reversed(self._nodes):
+            if any(o in needed for o in node.outputs):
+                keep.append(node)
+                needed.update(node.inputs)
+        keep.reverse()
+        return keep
+
+    def _interpret(self, env: Dict[str, Any], wanted: Sequence[str]) -> Dict[str, Any]:
+        """Run the needed subgraph in order (pure; called under trace/jit)."""
+        for node in self._needed_nodes(wanted):
+            if not all(i in env for i in node.inputs):
+                missing = [i for i in node.inputs if i not in env]
+                raise KeyError(
+                    f"op '{node.op}' needs {missing}; placeholders not fed or "
+                    f"graph out of order")
+            fn = resolve_graph_op(node.op)
+            res = fn(*[env[i] for i in node.inputs], **node.kwargs)
+            if len(node.outputs) == 1:
+                env[node.outputs[0]] = res
+            else:
+                for o, r in zip(node.outputs, res):
+                    env[o] = r
+        return {w: env[w] for w in wanted}
+
+    def _exec_fn(self, out_names: Tuple[str, ...]):
+        """Build + cache the jitted whole-graph function for given outputs."""
+        cache_key = ("exec", out_names)
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            def run(arrays, feeds):
+                env = dict(arrays)
+                env.update(feeds)
+                return self._interpret(env, out_names)
+
+            fn = jax.jit(run)
+            self._jit_cache[cache_key] = fn
+        return fn
+
+    def output(self, feeds: Dict[str, Any], outputs: Union[str, Sequence[str]]):
+        """Execute the graph — ONE compiled XLA computation
+        (InferenceSession.output analog, minus the interpreter)."""
+        if isinstance(outputs, str):
+            outputs = [outputs]
+        fn = self._exec_fn(tuple(outputs))
+        res = fn(self._arrays, {k: jnp.asarray(v) for k, v in feeds.items()})
+        return {k: np.asarray(v) for k, v in res.items()}
+
+    exec = output  # reference SameDiff.exec alias
+
+    # --------------------------------------------------------------- autodiff
+    def create_grad_function(self) -> None:
+        """API-parity marker (reference builds the grad subgraph eagerly;
+        we derive gradients by jax.grad at execution time)."""
+        self._grad_requested = True
+
+    def calculate_gradients(self, feeds: Dict[str, Any], loss_name: str,
+                            wrt: Optional[Sequence[str]] = None) -> Dict[str, np.ndarray]:
+        """Gradients of a scalar loss variable w.r.t. VARIABLEs
+        (sd.calculateGradients analog)."""
+        wrt = list(wrt) if wrt is not None else [
+            n for n, v in self._vars.items() if v.vtype == "VARIABLE"]
+        cache_key = ("grad", loss_name, tuple(wrt))
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            def loss_of(train_vars, other_arrays, feeds_):
+                env = dict(other_arrays)
+                env.update(train_vars)
+                env.update(feeds_)
+                return self._interpret(env, [loss_name])[loss_name]
+
+            fn = jax.jit(jax.grad(loss_of))
+            self._jit_cache[cache_key] = fn
+        train_vars = {n: self._arrays[n] for n in wrt}
+        other = {n: a for n, a in self._arrays.items() if n not in train_vars}
+        grads = fn(train_vars, other, {k: jnp.asarray(v) for k, v in feeds.items()})
+        return {k: np.asarray(v) for k, v in grads.items()}
+
+    # --------------------------------------------------------------- training
+    def set_training_config(self, tc: TrainingConfig) -> None:
+        self.training_config = tc
+
+    def _train_step_fn(self, loss_name: str):
+        tc = self.training_config
+        upd = tc.updater
+
+        def step_fn(train_vars, upd_state, step, other_arrays, feeds):
+            def loss_of(tv):
+                env = dict(other_arrays)
+                env.update(tv)
+                env.update(feeds)
+                return self._interpret(env, [loss_name])[loss_name]
+
+            loss, grads = jax.value_and_grad(loss_of)(train_vars)
+            lr = upd.lr(step)
+            new_vars, new_state = {}, {}
+            for n, g in grads.items():
+                w = train_vars[n]
+                if tc.l2:
+                    g = g + tc.l2 * w
+                if tc.l1:
+                    g = g + tc.l1 * jnp.sign(w)
+                u, s = upd.apply(g, upd_state[n], lr, step)
+                if tc.weight_decay:
+                    u = u + lr * tc.weight_decay * w
+                new_vars[n] = w - u
+                new_state[n] = s
+            return new_vars, new_state, loss
+
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def fit(self, iterator, epochs: int = 1, loss_name: Optional[str] = None) -> List[float]:
+        """sd.fit(DataSetIterator, nEpochs) — TrainingSession analog.
+
+        Feature/label arrays bind to placeholders via the TrainingConfig
+        mappings. Returns per-epoch mean losses (History analog)."""
+        tc = self.training_config
+        if tc is None:
+            raise ValueError("call set_training_config first")
+        loss_name = loss_name or (tc.loss_variables[0] if tc.loss_variables else None)
+        if loss_name is None:
+            raise ValueError("no loss variable configured")
+        trainable = [n for n, v in self._vars.items() if v.vtype == "VARIABLE"]
+        if self._updater_state is None:
+            self._updater_state = {n: tc.updater.init_state(self._arrays[n]) for n in trainable}
+        step_key = ("train", loss_name)
+        step_fn = self._jit_cache.get(step_key)
+        if step_fn is None:
+            step_fn = self._train_step_fn(loss_name)
+            self._jit_cache[step_key] = step_fn
+
+        from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+
+        if isinstance(iterator, DataSet):
+            iterator = ListDataSetIterator(iterator, batch_size=32)
+
+        history = []
+        for _ in range(epochs):
+            losses = []
+            for ds in iterator:
+                feeds = {}
+                feats = ds.features if isinstance(ds.features, (list, tuple)) else [ds.features]
+                labs = ds.labels if isinstance(ds.labels, (list, tuple)) else [ds.labels]
+                for name, arr in zip(tc.feature_mapping, feats):
+                    feeds[name] = jnp.asarray(arr)
+                for name, arr in zip(tc.label_mapping, labs):
+                    feeds[name] = jnp.asarray(arr)
+                train_vars = {n: self._arrays[n] for n in trainable}
+                other = {n: a for n, a in self._arrays.items() if n not in train_vars}
+                new_vars, self._updater_state, loss = step_fn(
+                    train_vars, self._updater_state,
+                    jnp.asarray(self._step, jnp.int32), other, feeds)
+                self._arrays.update(new_vars)
+                self._step += 1
+                losses.append(loss)
+            history.append(float(jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))))
+        return history
+
+    # ------------------------------------------------------------------ serde
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "variables": [
+                {"name": v.name, "vtype": v.vtype,
+                 "shape": list(v.shape) if v.shape else None,
+                 "dtype": str(np.dtype(v.dtype)) if v.dtype else "float32"}
+                for v in self._vars.values()
+            ],
+            "nodes": [
+                {"op": n.op, "inputs": n.inputs, "kwargs": _jsonable(n.kwargs),
+                 "outputs": n.outputs}
+                for n in self._nodes
+            ],
+            "name_counter": self._name_counter,
+        }
+
+    def save(self, path: str, save_updater_state: bool = False) -> None:
+        """sd.save(file) — zip of graph JSON + variable arrays
+        (FlatBuffers-file analog)."""
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("graph.json", json.dumps(self.to_dict(), indent=2))
+            import io
+
+            buf = io.BytesIO()
+            np.savez(buf, **{k: np.asarray(v) for k, v in self._arrays.items()})
+            z.writestr("arrays.npz", buf.getvalue())
+            if save_updater_state and self._updater_state is not None:
+                buf2 = io.BytesIO()
+                flat = {}
+                for n, st in self._updater_state.items():
+                    for k, v in st.items():
+                        flat[f"{n}::{k}"] = np.asarray(v)
+                np.savez(buf2, **flat)
+                z.writestr("updater.npz", buf2.getvalue())
+
+    @staticmethod
+    def load(path: str) -> "SameDiff":
+        sd = SameDiff()
+        with zipfile.ZipFile(path, "r") as z:
+            d = json.loads(z.read("graph.json").decode())
+            import io
+
+            arrays = np.load(io.BytesIO(z.read("arrays.npz")))
+            for vd in d["variables"]:
+                v = SDVariable(sd, vd["name"], vd["vtype"],
+                               tuple(vd["shape"]) if vd["shape"] else None,
+                               jnp.dtype(vd["dtype"]))
+                sd._vars[v.name] = v
+                if v.name in arrays.files:
+                    sd._arrays[v.name] = jnp.asarray(arrays[v.name])
+            for nd in d["nodes"]:
+                sd._nodes.append(_Node(nd["op"], list(nd["inputs"]),
+                                       dict(nd["kwargs"]), list(nd["outputs"])))
+            sd._name_counter = d.get("name_counter", len(sd._vars))
+            if "updater.npz" in z.namelist():
+                upd = np.load(io.BytesIO(z.read("updater.npz")))
+                state: Dict[str, Dict[str, Any]] = {}
+                for key in upd.files:
+                    n, k = key.split("::", 1)
+                    state.setdefault(n, {})[k] = jnp.asarray(upd[key])
+                sd._updater_state = state
+        return sd
+
+    def as_stablehlo(self, feeds: Dict[str, Any], outputs: Sequence[str]) -> str:
+        """StableHLO text of the whole-graph computation — the artifact the
+        reference's libnd4j GraphExecutioner FlatBuffers file maps to."""
+        fn = self._exec_fn(tuple(outputs))
+        return fn.lower(self._arrays,
+                        {k: jnp.asarray(v) for k, v in feeds.items()}).as_text()
+
+    # ------------------------------------------------------------------ misc
+    def variables(self) -> List[str]:
+        return list(self._vars)
+
+    def get_variable(self, name: str) -> SDVariable:
+        return self._vars[name]
+
+    def get_arr(self, name: str) -> np.ndarray:
+        return np.asarray(self._arrays[name])
+
+    def set_arr(self, name: str, value) -> None:
+        if name not in self._vars:
+            raise KeyError(name)
+        self._arrays[name] = jnp.asarray(value)
+
+    def summary(self) -> str:
+        lines = [f"SameDiff: {len(self._vars)} variables, {len(self._nodes)} ops"]
+        for n in self._nodes:
+            lines.append(f"  {','.join(n.outputs)} = {n.op}({','.join(n.inputs)})")
+        return "\n".join(lines)
+
+
+def _jsonable(kw: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in kw.items():
+        if isinstance(v, tuple):
+            v = list(v)
+        out[k] = v
+    return out

@@ -12,3 +12,12 @@ from deeplearning4j_tpu.datasets.dataset import (
     NormalizerMinMaxScaler,
     ImagePreProcessingScaler,
 )
+from deeplearning4j_tpu.datasets.image import (
+    ImageRecordReader,
+    SyntheticImageNetIterator,
+    FlipImageTransform,
+    RandomCropTransform,
+    RotateImageTransform,
+    ColorJitterTransform,
+    PipelineImageTransform,
+)

@@ -1,0 +1,7 @@
+"""Model import — TF GraphDef → SameDiff (samediff-import role)."""
+
+from deeplearning4j_tpu.imports.tf_import import (
+    TensorflowImporter,
+    import_frozen_graph,
+    register_tf_op,
+)

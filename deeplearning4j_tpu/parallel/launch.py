@@ -1,0 +1,79 @@
+"""Multi-host bootstrap + per-host data sharding.
+
+Reference parity (SURVEY §4.4, §6.8):
+  * SparkDl4jMultiLayer / SharedTrainingMaster driver-executor bootstrap:
+    Spark RPC broadcasts config + initial params; Aeron mesh forms for
+    gradient exchange; VirtualDataSetIterator partitions data per executor.
+
+TPU-native realization: ``jax.distributed.initialize`` (coordination service
+= the driver/parameter-server bootstrap role; rank assignment + barrier),
+after which every host runs the SAME SPMD program over the global mesh —
+gradient exchange is inside the compiled step (ICI/DCN collectives), not a
+transport we operate. Data: deterministic per-host shard assignment
+(host_id → slice of files/examples), the VirtualDataSetIterator role.
+
+In this 1-chip environment multi-host paths are exercised via
+multi-process CPU tests (SURVEY §5.5 translation).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """jax.distributed.initialize wrapper; env-var driven when args absent
+    (DL4J_TPU_COORDINATOR / DL4J_TPU_NUM_PROCS / DL4J_TPU_PROC_ID)."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("DL4J_TPU_COORDINATOR")
+    if num_processes is None and "DL4J_TPU_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["DL4J_TPU_NUM_PROCS"])
+    if process_id is None and "DL4J_TPU_PROC_ID" in os.environ:
+        process_id = int(os.environ["DL4J_TPU_PROC_ID"])
+    if coordinator_address is None:
+        return  # single-process run; nothing to do
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def host_shard(items: Sequence, process_id: Optional[int] = None,
+               num_processes: Optional[int] = None) -> list:
+    """Deterministic per-host shard of a work list (files, example ranges) —
+    the VirtualDataSetIterator partitioning role. host i takes items[i::N]."""
+    import jax
+
+    pid = process_id if process_id is not None else jax.process_index()
+    n = num_processes if num_processes is not None else jax.process_count()
+    return list(items)[pid::n]
+
+
+class ShardedDataSetIterator:
+    """Wrap a host-local iterator so each host sees its deterministic shard
+    of batches (batch-level round-robin)."""
+
+    def __init__(self, base, process_id: Optional[int] = None,
+                 num_processes: Optional[int] = None):
+        import jax
+
+        self.base = base
+        self.pid = process_id if process_id is not None else jax.process_index()
+        self.n = num_processes if num_processes is not None else jax.process_count()
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+    def reset(self):
+        self.base.reset()
+
+    def __iter__(self):
+        for i, ds in enumerate(self.base):
+            if i % self.n == self.pid:
+                yield ds

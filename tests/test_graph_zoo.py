@@ -1,0 +1,180 @@
+"""ComputationGraph + zoo tests — reference ComputationGraph tests +
+TestInstantiation-style zoo smoke tests (SURVEY §3.3, §5.1)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ComputationGraphConfiguration, ElementWiseVertex,
+    L2NormalizeVertex, MergeVertex, ScaleVertex, ShiftVertex, SubsetVertex,
+    graph_builder, save_graph, restore_graph,
+)
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu import models
+
+
+def xor_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64)
+    labels = np.zeros((n, 2), np.float32)
+    labels[np.arange(n), y] = 1.0
+    return x, labels
+
+
+class TestGraphBasics:
+    def test_linear_graph_matches_mln(self):
+        """Same arch as MLN → same class of results (two-API parity)."""
+        x, y = xor_data()
+        g = ComputationGraph(
+            graph_builder().seed(12).updater(nn.Adam(learning_rate=0.02))
+            .weight_init("xavier")
+            .add_inputs("in")
+            .set_input_types(**{"in": nn.InputType.feed_forward(2)})
+            .add_layer("h", nn.DenseLayer(n_out=32, activation="tanh"), "in")
+            .add_layer("out", nn.OutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "h")
+            .set_outputs("out").build()
+        ).init()
+        g.fit(x, y, epochs=150, batch_size=128)
+        acc = (g.output_single(x).argmax(-1) == y.argmax(-1)).mean()
+        assert acc > 0.95, acc
+
+    def test_multi_branch_merge(self):
+        g = ComputationGraph(
+            graph_builder().seed(1)
+            .add_inputs("in")
+            .set_input_types(**{"in": nn.InputType.feed_forward(4)})
+            .add_layer("a", nn.DenseLayer(n_out=3, activation="relu"), "in")
+            .add_layer("b", nn.DenseLayer(n_out=5, activation="tanh"), "in")
+            .add_vertex("m", MergeVertex(), "a", "b")
+            .add_layer("out", nn.OutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "m")
+            .set_outputs("out").build()
+        ).init()
+        out = g.output_single(np.ones((3, 4), np.float32))
+        assert out.shape == (3, 2)
+        assert g.conf.nodes[-1].layer.n_in == 8  # 3 + 5 merged
+
+    def test_residual_add(self):
+        g = ComputationGraph(
+            graph_builder().seed(2)
+            .add_inputs("in")
+            .set_input_types(**{"in": nn.InputType.feed_forward(6)})
+            .add_layer("d", nn.DenseLayer(n_out=6, activation="relu"), "in")
+            .add_vertex("add", ElementWiseVertex(op="add"), "d", "in")
+            .add_layer("out", nn.OutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "add")
+            .set_outputs("out").build()
+        ).init()
+        assert g.output_single(np.ones((2, 6), np.float32)).shape == (2, 2)
+
+    def test_vertices(self):
+        x = np.array([[3.0, 4.0]], np.float32)
+        assert np.allclose(ScaleVertex(scale=2.0).apply([x]), [[6, 8]])
+        assert np.allclose(ShiftVertex(shift=1.0).apply([x]), [[4, 5]])
+        n = L2NormalizeVertex().apply([x])
+        assert np.allclose(np.linalg.norm(n), 1.0, atol=1e-5)
+        s = SubsetVertex(from_idx=0, to_idx=0).apply([x])
+        assert s.shape == (1, 1)
+        m = ElementWiseVertex(op="max").apply([x, 2 * x])
+        assert np.allclose(m, 2 * x)
+        avg = ElementWiseVertex(op="average").apply([x, 3 * x])
+        assert np.allclose(avg, 2 * x)
+
+    def test_graph_json_round_trip(self):
+        conf = (
+            graph_builder().seed(3).updater(nn.Adam(learning_rate=1e-3))
+            .add_inputs("in")
+            .set_input_types(**{"in": nn.InputType.feed_forward(4)})
+            .add_layer("h", nn.DenseLayer(n_out=8, activation="relu"), "in")
+            .add_vertex("sc", ScaleVertex(scale=0.5), "h")
+            .add_layer("out", nn.OutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "sc")
+            .set_outputs("out").build()
+        )
+        # build once so shape inference fills n_in
+        ComputationGraph(conf)
+        js = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(js)
+        g2 = ComputationGraph(conf2).init()
+        assert g2.output_single(np.ones((1, 4), np.float32)).shape == (1, 2)
+
+    def test_graph_serde_round_trip(self, tmp_path):
+        x, y = xor_data(64)
+        g = ComputationGraph(
+            graph_builder().seed(4).updater(nn.Adam(learning_rate=0.01))
+            .add_inputs("in")
+            .set_input_types(**{"in": nn.InputType.feed_forward(2)})
+            .add_layer("h", nn.DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("out", nn.OutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "h")
+            .set_outputs("out").build()
+        ).init()
+        g.fit(x, y, epochs=2, batch_size=32)
+        p = str(tmp_path / "g.zip")
+        save_graph(g, p)
+        g2 = restore_graph(p)
+        np.testing.assert_allclose(g2.output_single(x), g.output_single(x),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestZoo:
+    """Zoo instantiation smoke tests (reference TestInstantiation pattern) —
+    small input shapes to keep compile times sane on CPU."""
+
+    def test_lenet(self):
+        net = models.LeNet(num_classes=10).init()
+        out = net.output(np.zeros((2, 784), np.float32))
+        assert out.shape == (2, 10)
+
+    def test_simple_cnn(self):
+        net = models.SimpleCNN(num_classes=5, input_shape=(32, 32, 3)).init()
+        out = net.output(np.zeros((2, 32, 32, 3), np.float32))
+        assert out.shape == (2, 5)
+
+    def test_vgg16_tiny(self):
+        net = models.VGG16(num_classes=10, input_shape=(32, 32, 3)).init()
+        out = net.output(np.zeros((1, 32, 32, 3), np.float32))
+        assert out.shape == (1, 10)
+
+    def test_resnet50_structure(self):
+        net = models.ResNet50(num_classes=10, input_shape=(64, 64, 3)).init()
+        # 53 conv layers incl. projections; ~23.6M params at 1000 classes
+        out = net.output_single(np.zeros((1, 64, 64, 3), np.float32))
+        assert out.shape == (1, 10)
+        n_convs = sum(1 for n in net.conf.nodes
+                      if n.kind == "layer" and isinstance(n.layer, nn.ConvolutionLayer))
+        assert n_convs == 53
+
+    def test_resnet50_param_count_imagenet(self):
+        net = models.ResNet50(num_classes=1000, input_shape=(32, 32, 3)).init()
+        n = net.num_params()
+        # reference ResNet-50: ~25.6M with BN params
+        assert 23_000_000 < n < 28_000_000, n
+
+    def test_unet(self):
+        net = models.UNet(input_shape=(32, 32, 1), base=4).init()
+        out = net.output_single(np.zeros((1, 32, 32, 1), np.float32))
+        assert out.shape == (1, 32, 32, 1)
+        assert (out >= 0).all() and (out <= 1).all()
+
+    def test_darknet19_tiny(self):
+        net = models.Darknet19(num_classes=10, input_shape=(32, 32, 3)).init()
+        out = net.output(np.zeros((1, 32, 32, 3), np.float32))
+        assert out.shape == (1, 10)
+
+    def test_text_generation_lstm(self):
+        net = models.TextGenerationLSTM(vocab_size=20, hidden=16).init()
+        out = net.output(np.zeros((2, 5, 20), np.float32))
+        assert out.shape == (2, 5, 20)
+
+    def test_resnet_trains(self):
+        """A tiny ResNet-50 graph takes a gradient step without error."""
+        net = models.ResNet50(num_classes=4, input_shape=(32, 32, 3),
+                              updater=nn.Sgd(learning_rate=0.01)).init()
+        x = np.random.RandomState(0).rand(4, 32, 32, 3).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[[0, 1, 2, 3]]
+        net.fit(x, y, epochs=2, batch_size=4)
+        assert np.isfinite(net.score())

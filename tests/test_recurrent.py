@@ -1,0 +1,148 @@
+"""Recurrent path tests — BASELINE config[2] (BiLSTM sequence tagging),
+tBPTT semantics, and stateful rnnTimeStep (reference
+MultiLayerNetwork.rnnTimeStep / doTruncatedBPTT, SURVEY §6.7)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+
+
+def tagging_data(n=64, t=12, f=6, classes=3, seed=0):
+    """Learnable sequence tagging: label depends on a sliding window sign."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, t, f).astype(np.float32)
+    w = rng.randn(f).astype(np.float32)
+    proj = x @ w
+    cum = np.cumsum(proj, axis=1)
+    y_id = np.clip((cum > 0).astype(int) + (proj > 0.5).astype(int), 0, classes - 1)
+    y = np.eye(classes, dtype=np.float32)[y_id]
+    return x, y, y_id
+
+
+class TestBiLSTMTagger:
+    """BASELINE config[2] exit gate."""
+
+    def test_bilstm_tagger_converges(self):
+        x, y, y_id = tagging_data(n=128, t=10)
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(42).updater(nn.Adam(learning_rate=5e-3))
+            .weight_init("xavier").list()
+            .layer(nn.Bidirectional.wrap(nn.LSTM(n_out=24, activation="tanh")))
+            .layer(nn.RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(6)).build()
+        ).init()
+        net.fit(x, y, epochs=60, batch_size=64)
+        pred = net.output(x).argmax(-1)
+        acc = (pred == y_id).mean()
+        assert acc > 0.85, acc
+
+
+class TestTbptt:
+    def test_tbptt_trains(self):
+        x, y, y_id = tagging_data(n=64, t=20)
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(7).updater(nn.Adam(learning_rate=5e-3))
+            .tbptt(5).list()
+            .layer(nn.LSTM(n_out=16, activation="tanh"))
+            .layer(nn.RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(6)).build()
+        ).init()
+        assert net.conf.backprop_type == "tbptt"
+        net.fit(x, y, epochs=30, batch_size=64)
+        acc = (net.output(x).argmax(-1) == y_id).mean()
+        assert acc > 0.7, acc
+
+    def test_tbptt_state_carries_across_segments(self):
+        """With state carry, segment 2 sees segment 1's history: a tBPTT
+        forward over [0:4]+[4:8] must equal the full forward at t>=4 — for a
+        stateless-equivalent net it wouldn't."""
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(3).tbptt(4).list()
+            .layer(nn.LSTM(n_out=5, activation="tanh"))
+            .layer(nn.RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(3)).build()
+        ).init()
+        x = np.random.RandomState(0).randn(2, 8, 3).astype(np.float32)
+        full = net.output(x)
+        # stateful two-segment forward
+        net.rnn_clear_previous_state()
+        seg1 = net.rnn_time_step(x[:, :4])
+        seg2 = net.rnn_time_step(x[:, 4:])
+        np.testing.assert_allclose(seg2, full[:, 4:], rtol=1e-4, atol=1e-5)
+
+
+class TestRnnTimeStep:
+    def test_streaming_equals_full(self):
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(11).list()
+            .layer(nn.LSTM(n_out=8, activation="tanh"))
+            .layer(nn.LSTM(n_out=6, activation="tanh"))
+            .layer(nn.RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(4)).build()
+        ).init()
+        x = np.random.RandomState(1).randn(3, 6, 4).astype(np.float32)
+        full = net.output(x)
+        net.rnn_clear_previous_state()
+        outs = [net.rnn_time_step(x[:, [t]]) for t in range(6)]
+        stream = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(stream, full, rtol=1e-4, atol=1e-5)
+
+    def test_single_step_2d_input(self):
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(2).list()
+            .layer(nn.SimpleRnn(n_out=4, activation="tanh"))
+            .layer(nn.RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(3)).build()
+        ).init()
+        out = net.rnn_time_step(np.ones((2, 3), np.float32))
+        assert out.shape == (2, 2)
+        # second call uses carried state → different output
+        out2 = net.rnn_time_step(np.ones((2, 3), np.float32))
+        assert not np.allclose(out, out2)
+
+    def test_clear_state_resets(self):
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(2).list()
+            .layer(nn.SimpleRnn(n_out=4, activation="tanh"))
+            .layer(nn.RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(3)).build()
+        ).init()
+        a = net.rnn_time_step(np.ones((1, 3), np.float32))
+        net.rnn_clear_previous_state()
+        b = net.rnn_time_step(np.ones((1, 3), np.float32))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_get_previous_state(self):
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(2).list()
+            .layer(nn.LSTM(n_out=4, activation="tanh"))
+            .layer(nn.RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(3)).build()
+        ).init()
+        net.rnn_time_step(np.ones((1, 3), np.float32))
+        h, c = net.rnn_get_previous_state(0)
+        assert h.shape == (1, 4) and c.shape == (1, 4)
+        assert np.abs(np.asarray(h)).sum() > 0
+
+
+class TestMaskedTraining:
+    def test_variable_length_sequences(self):
+        x, y, y_id = tagging_data(n=64, t=10)
+        mask = np.ones((64, 10), np.float32)
+        lengths = np.random.RandomState(5).randint(4, 11, 64)
+        for i, L in enumerate(lengths):
+            mask[i, L:] = 0
+        ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(9).updater(nn.Adam(learning_rate=5e-3)).list()
+            .layer(nn.LSTM(n_out=16, activation="tanh"))
+            .layer(nn.RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(6)).build()
+        ).init()
+        net.fit(ListDataSetIterator(ds, batch_size=64), epochs=20)
+        assert np.isfinite(net.score())
+        # masked positions don't affect evaluation
+        e = net.evaluate(ListDataSetIterator(ds, batch_size=64))
+        assert e.confusion.sum() == int(mask.sum())
