@@ -5,6 +5,23 @@ Architecture (SURVEY.md §8): whole-model training steps compile to single XLA
 computations via jax/pjit; the reference's per-op JNI dispatch, workspaces,
 and Aeron gradient mesh are replaced by XLA fusion, buffer donation, and
 ICI/DCN collectives emitted from sharding annotations.
+
+Package map (reference layer in parens — SURVEY §2):
+  ops/        tensor-op catalog + platform-helper table   (ND4J + libnd4j)
+  nn/         layer configs, MultiLayerNetwork, updaters,
+              listeners, serde, early stopping, transfer  (DL4J-nn/-core)
+  autodiff/   SameDiff-style graph engine + gradcheck     (nd4j autodiff)
+  models/     zoo (LeNet…ResNet-50, UNet) + BERT          (zoo + SameDiff-BERT)
+  parallel/   mesh DP/TP, ring attention, checkpoints,
+              multi-host bootstrap                        (scaleout + param-server)
+  datasets/   DataSet/iterators/normalizers, images       (nd4j dataset + datavec-image)
+  datavec/    schema'd transform DSL, CSV readers         (datavec-api)
+  nlp/        wordpiece/BERT pipeline, word2vec           (deeplearning4j-nlp)
+  rl/         DQN / actor-critic                          (rl4j)
+  eval/       Evaluation/ROC/regression                   (nd4j evaluation)
+  imports/    TF frozen-graph importer                    (samediff-import)
+  native_ops/ C++ host-side codecs via ctypes             (libnd4j native role)
+  utils/      profiling (chrome trace), UI stats shim     (OpProfiler/UI)
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,14 @@
+"""DataVec — ETL: schemas, transform DSL, record readers (SURVEY §3.4)."""
+
+from deeplearning4j_tpu.datavec.transform import (
+    Schema,
+    TransformProcess,
+    LocalTransformExecutor,
+    CSVRecordReader,
+    Condition,
+    ColumnCondition,
+    BooleanCondition,
+    NullWritableColumnCondition,
+    Reducer,
+    records_to_dataset,
+)

@@ -1,0 +1,612 @@
+"""DataVec — schema'd ETL transform DSL.
+
+Reference parity:
+  * org/datavec/api/transform/schema/Schema.java (typed columns, Builder)
+  * org/datavec/api/transform/TransformProcess.java (Builder chaining
+    transforms/filters; executable), org/datavec/api/transform/transform/*
+    (math ops, string ops, categorical↔integer/one-hot, remove/rename,
+    deduplicate...), condition/* (column conditions, boolean compositions),
+    filter/* (ConditionFilter), reduce/* (Reducer with per-column ops).
+  * org/datavec/api/records/reader/impl/csv/CSVRecordReader.java,
+    org/datavec/local/transforms/LocalTransformExecutor.java.
+
+Records are Python lists of values (the Writable-list analog); execution is
+host-side (ETL is host work feeding device batches, as in the reference where
+DataVec runs on the JVM/Spark side).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Schema
+# ---------------------------------------------------------------------------
+
+COLUMN_TYPES = ("string", "integer", "double", "categorical", "long", "time", "float")
+
+
+class Schema:
+    """Schema.java: ordered, typed columns."""
+
+    def __init__(self, columns: List[Dict[str, Any]]):
+        self.columns = columns
+
+    @property
+    def names(self) -> List[str]:
+        return [c["name"] for c in self.columns]
+
+    def type_of(self, name: str) -> str:
+        return self._col(name)["type"]
+
+    def _col(self, name: str) -> Dict[str, Any]:
+        for c in self.columns:
+            if c["name"] == name:
+                return c
+        raise KeyError(f"no column '{name}' in schema {self.names}")
+
+    def index_of(self, name: str) -> int:
+        return self.names.index(name)
+
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    class Builder:
+        def __init__(self):
+            self._cols: List[Dict[str, Any]] = []
+
+        def add_column_string(self, name: str):
+            self._cols.append({"name": name, "type": "string"})
+            return self
+
+        def add_column_integer(self, name: str):
+            self._cols.append({"name": name, "type": "integer"})
+            return self
+
+        def add_column_long(self, name: str):
+            self._cols.append({"name": name, "type": "long"})
+            return self
+
+        def add_column_double(self, name: str):
+            self._cols.append({"name": name, "type": "double"})
+            return self
+
+        def add_column_float(self, name: str):
+            self._cols.append({"name": name, "type": "float"})
+            return self
+
+        def add_column_categorical(self, name: str, *state_names: str):
+            self._cols.append({"name": name, "type": "categorical",
+                               "states": list(state_names)})
+            return self
+
+        def add_column_time(self, name: str):
+            self._cols.append({"name": name, "type": "time"})
+            return self
+
+        def build(self) -> "Schema":
+            return Schema(list(self._cols))
+
+    @staticmethod
+    def builder() -> "Schema.Builder":
+        return Schema.Builder()
+
+
+# ---------------------------------------------------------------------------
+# Conditions (condition/column/*) — predicates over one record
+# ---------------------------------------------------------------------------
+
+
+class Condition:
+    def check(self, record: List[Any], schema: Schema) -> bool:
+        raise NotImplementedError
+
+    def __and__(self, other):
+        return BooleanCondition("and", self, other)
+
+    def __or__(self, other):
+        return BooleanCondition("or", self, other)
+
+    def __invert__(self):
+        return BooleanCondition("not", self)
+
+
+class BooleanCondition(Condition):
+    """condition/BooleanCondition.java: AND/OR/NOT composition."""
+
+    def __init__(self, op: str, *conds: Condition):
+        self.op = op
+        self.conds = conds
+
+    def check(self, record, schema):
+        if self.op == "and":
+            return all(c.check(record, schema) for c in self.conds)
+        if self.op == "or":
+            return any(c.check(record, schema) for c in self.conds)
+        return not self.conds[0].check(record, schema)
+
+
+_OPS: Dict[str, Callable[[Any, Any], bool]] = {
+    "Equal": lambda a, b: a == b,
+    "NotEqual": lambda a, b: a != b,
+    "LessThan": lambda a, b: a < b,
+    "LessOrEqual": lambda a, b: a <= b,
+    "GreaterThan": lambda a, b: a > b,
+    "GreaterOrEqual": lambda a, b: a >= b,
+    "InSet": lambda a, b: a in b,
+    "NotInSet": lambda a, b: a not in b,
+}
+
+
+class ColumnCondition(Condition):
+    """DoubleColumnCondition / StringColumnCondition / etc. in one."""
+
+    def __init__(self, column: str, op: str, value: Any):
+        self.column = column
+        self.op = op
+        self.value = value
+
+    def check(self, record, schema):
+        v = record[schema.index_of(self.column)]
+        return _OPS[self.op](v, self.value)
+
+
+class NullWritableColumnCondition(Condition):
+    def __init__(self, column: str):
+        self.column = column
+
+    def check(self, record, schema):
+        v = record[schema.index_of(self.column)]
+        return v is None or v == ""
+
+
+# ---------------------------------------------------------------------------
+# Transform steps
+# ---------------------------------------------------------------------------
+
+
+class _Step:
+    """One step: transforms schema and/or records."""
+
+    def out_schema(self, schema: Schema) -> Schema:
+        return schema
+
+    def apply(self, records: List[List[Any]], schema: Schema) -> List[List[Any]]:
+        return records
+
+
+class _RemoveColumns(_Step):
+    def __init__(self, names):
+        self.names = set(names)
+
+    def out_schema(self, schema):
+        return Schema([c for c in schema.columns if c["name"] not in self.names])
+
+    def apply(self, records, schema):
+        keep = [i for i, n in enumerate(schema.names) if n not in self.names]
+        return [[r[i] for i in keep] for r in records]
+
+
+class _KeepColumns(_Step):
+    def __init__(self, names):
+        self.names = list(names)
+
+    def out_schema(self, schema):
+        return Schema([schema._col(n) for n in self.names])
+
+    def apply(self, records, schema):
+        idx = [schema.index_of(n) for n in self.names]
+        return [[r[i] for i in idx] for r in records]
+
+
+class _RenameColumn(_Step):
+    def __init__(self, old, new):
+        self.old, self.new = old, new
+
+    def out_schema(self, schema):
+        cols = [dict(c) for c in schema.columns]
+        for c in cols:
+            if c["name"] == self.old:
+                c["name"] = self.new
+        return Schema(cols)
+
+
+class _MathOp(_Step):
+    """transform/doubletransform/DoubleMathOpTransform + integer variant."""
+
+    _FNS = {"Add": lambda a, b: a + b, "Subtract": lambda a, b: a - b,
+            "Multiply": lambda a, b: a * b, "Divide": lambda a, b: a / b,
+            "Modulus": lambda a, b: a % b, "ReverseSubtract": lambda a, b: b - a,
+            "ReverseDivide": lambda a, b: b / a, "ScalarMax": max, "ScalarMin": min}
+
+    def __init__(self, column, op, scalar):
+        self.column, self.op, self.scalar = column, op, scalar
+
+    def apply(self, records, schema):
+        i = schema.index_of(self.column)
+        fn = self._FNS[self.op]
+        out = []
+        for r in records:
+            r = list(r)
+            r[i] = fn(r[i], self.scalar)
+            out.append(r)
+        return out
+
+
+class _MathFunction(_Step):
+    """DoubleMathFunctionTransform: log/sqrt/sin/abs/..."""
+
+    _FNS = {"LOG": math.log, "LOG10": math.log10, "EXP": math.exp,
+            "SQRT": math.sqrt, "ABS": abs, "SIN": math.sin, "COS": math.cos,
+            "TAN": math.tan, "FLOOR": math.floor, "CEIL": math.ceil,
+            "SIGNUM": lambda v: (v > 0) - (v < 0)}
+
+    def __init__(self, column, fn):
+        self.column, self.fn = column, fn
+
+    def apply(self, records, schema):
+        i = schema.index_of(self.column)
+        f = self._FNS[self.fn.upper()]
+        out = []
+        for r in records:
+            r = list(r)
+            r[i] = f(r[i])
+            out.append(r)
+        return out
+
+
+class _StringTransform(_Step):
+    """stringtransform/*: lower/upper/trim/replace/append/concat."""
+
+    def __init__(self, column, kind, *args):
+        self.column, self.kind, self.args = column, kind, args
+
+    def apply(self, records, schema):
+        i = schema.index_of(self.column)
+        out = []
+        for r in records:
+            r = list(r)
+            v = str(r[i])
+            if self.kind == "lower":
+                v = v.lower()
+            elif self.kind == "upper":
+                v = v.upper()
+            elif self.kind == "trim":
+                v = v.strip()
+            elif self.kind == "replace":
+                v = v.replace(self.args[0], self.args[1])
+            elif self.kind == "append":
+                v = v + self.args[0]
+            r[i] = v
+            out.append(r)
+        return out
+
+
+class _CategoricalToInteger(_Step):
+    def __init__(self, column):
+        self.column = column
+
+    def out_schema(self, schema):
+        cols = [dict(c) for c in schema.columns]
+        for c in cols:
+            if c["name"] == self.column:
+                self._states = c.get("states", [])
+                c["type"] = "integer"
+                c.pop("states", None)
+        return Schema(cols)
+
+    def apply(self, records, schema):
+        i = schema.index_of(self.column)
+        states = schema._col(self.column).get("states", [])
+        lut = {s: j for j, s in enumerate(states)}
+        out = []
+        for r in records:
+            r = list(r)
+            r[i] = lut[r[i]]
+            out.append(r)
+        return out
+
+
+class _CategoricalToOneHot(_Step):
+    def __init__(self, column):
+        self.column = column
+
+    def out_schema(self, schema):
+        cols = []
+        for c in schema.columns:
+            if c["name"] == self.column:
+                for s in c.get("states", []):
+                    cols.append({"name": f"{self.column}[{s}]", "type": "integer"})
+            else:
+                cols.append(dict(c))
+        return Schema(cols)
+
+    def apply(self, records, schema):
+        i = schema.index_of(self.column)
+        states = schema._col(self.column).get("states", [])
+        out = []
+        for r in records:
+            onehot = [1 if r[i] == s else 0 for s in states]
+            out.append(r[:i] + onehot + r[i + 1 :])
+        return out
+
+
+class _IntegerToCategorical(_Step):
+    def __init__(self, column, states):
+        self.column, self.states = column, list(states)
+
+    def out_schema(self, schema):
+        cols = [dict(c) for c in schema.columns]
+        for c in cols:
+            if c["name"] == self.column:
+                c["type"] = "categorical"
+                c["states"] = self.states
+        return Schema(cols)
+
+    def apply(self, records, schema):
+        i = schema.index_of(self.column)
+        out = []
+        for r in records:
+            r = list(r)
+            r[i] = self.states[int(r[i])]
+            out.append(r)
+        return out
+
+
+class _ConditionalReplace(_Step):
+    """transform/condition/ConditionalReplaceValueTransform."""
+
+    def __init__(self, column, new_value, condition: Condition):
+        self.column, self.new_value, self.condition = column, new_value, condition
+
+    def apply(self, records, schema):
+        i = schema.index_of(self.column)
+        out = []
+        for r in records:
+            r = list(r)
+            if self.condition.check(r, schema):
+                r[i] = self.new_value
+            out.append(r)
+        return out
+
+
+class _Filter(_Step):
+    """filter/ConditionFilter: REMOVE records matching the condition."""
+
+    def __init__(self, condition: Condition):
+        self.condition = condition
+
+    def apply(self, records, schema):
+        return [r for r in records if not self.condition.check(r, schema)]
+
+
+class _DuplicateColumns(_Step):
+    def __init__(self, names, new_names):
+        self.names, self.new_names = list(names), list(new_names)
+
+    def out_schema(self, schema):
+        cols = [dict(c) for c in schema.columns]
+        for n, nn in zip(self.names, self.new_names):
+            c = dict(schema._col(n))
+            c["name"] = nn
+            cols.append(c)
+        return Schema(cols)
+
+    def apply(self, records, schema):
+        idx = [schema.index_of(n) for n in self.names]
+        return [r + [r[i] for i in idx] for r in records]
+
+
+# ---------------------------------------------------------------------------
+# Reductions (reduce/Reducer.java)
+# ---------------------------------------------------------------------------
+
+_REDUCE_FNS = {
+    "SUM": lambda vs: sum(vs),
+    "MEAN": lambda vs: sum(vs) / len(vs),
+    "MIN": min,
+    "MAX": max,
+    "COUNT": len,
+    "RANGE": lambda vs: max(vs) - min(vs),
+    "STDEV": lambda vs: float(np.std(np.asarray(vs, float), ddof=1)) if len(vs) > 1 else 0.0,
+    "FIRST": lambda vs: vs[0],
+    "LAST": lambda vs: vs[-1],
+    "COUNT_UNIQUE": lambda vs: len(set(vs)),
+}
+
+
+class Reducer:
+    """Reducer.Builder: group by key column(s), reduce others."""
+
+    def __init__(self, key_columns: Sequence[str], ops: Dict[str, str]):
+        self.keys = list(key_columns)
+        self.ops = ops  # column -> op name
+
+    def reduce(self, records: List[List[Any]], schema: Schema):
+        key_idx = [schema.index_of(k) for k in self.keys]
+        groups: Dict[tuple, List[List[Any]]] = {}
+        for r in records:
+            groups.setdefault(tuple(r[i] for i in key_idx), []).append(r)
+        out_cols = [dict(schema._col(k)) for k in self.keys]
+        for col, op in self.ops.items():
+            t = "double" if op in ("MEAN", "STDEV") else schema.type_of(col)
+            out_cols.append({"name": f"{op.lower()}({col})", "type": t})
+        out_schema = Schema(out_cols)
+        out_records = []
+        for key, rows in groups.items():
+            rec = list(key)
+            for col, op in self.ops.items():
+                i = schema.index_of(col)
+                rec.append(_REDUCE_FNS[op]([r[i] for r in rows]))
+            out_records.append(rec)
+        return out_records, out_schema
+
+
+# ---------------------------------------------------------------------------
+# TransformProcess
+# ---------------------------------------------------------------------------
+
+
+class TransformProcess:
+    """TransformProcess.java: initial schema + ordered steps."""
+
+    def __init__(self, initial_schema: Schema, steps: List[_Step]):
+        self.initial_schema = initial_schema
+        self.steps = steps
+
+    def final_schema(self) -> Schema:
+        s = self.initial_schema
+        for st in self.steps:
+            s = st.out_schema(s)
+        return s
+
+    def execute(self, records: List[List[Any]]) -> List[List[Any]]:
+        s = self.initial_schema
+        for st in self.steps:
+            records = st.apply(records, s)
+            s = st.out_schema(s)
+        return records
+
+    class Builder:
+        def __init__(self, schema: Schema):
+            self.schema = schema
+            self.steps: List[_Step] = []
+
+        def remove_columns(self, *names):
+            self.steps.append(_RemoveColumns(names))
+            return self
+
+        def remove_all_columns_except_for(self, *names):
+            self.steps.append(_KeepColumns(names))
+            return self
+
+        def rename_column(self, old, new):
+            self.steps.append(_RenameColumn(old, new))
+            return self
+
+        def math_op(self, column, op, scalar):
+            self.steps.append(_MathOp(column, op, scalar))
+            return self
+
+        def math_function(self, column, fn):
+            self.steps.append(_MathFunction(column, fn))
+            return self
+
+        def string_to_lower(self, column):
+            self.steps.append(_StringTransform(column, "lower"))
+            return self
+
+        def string_to_upper(self, column):
+            self.steps.append(_StringTransform(column, "upper"))
+            return self
+
+        def trim(self, column):
+            self.steps.append(_StringTransform(column, "trim"))
+            return self
+
+        def replace_string(self, column, old, new):
+            self.steps.append(_StringTransform(column, "replace", old, new))
+            return self
+
+        def categorical_to_integer(self, column):
+            self.steps.append(_CategoricalToInteger(column))
+            return self
+
+        def categorical_to_one_hot(self, column):
+            self.steps.append(_CategoricalToOneHot(column))
+            return self
+
+        def integer_to_categorical(self, column, states):
+            self.steps.append(_IntegerToCategorical(column, states))
+            return self
+
+        def conditional_replace_value_transform(self, column, new_value, condition):
+            self.steps.append(_ConditionalReplace(column, new_value, condition))
+            return self
+
+        def filter(self, condition: Condition):
+            self.steps.append(_Filter(condition))
+            return self
+
+        def duplicate_columns(self, names, new_names):
+            self.steps.append(_DuplicateColumns(names, new_names))
+            return self
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self.schema, list(self.steps))
+
+    @staticmethod
+    def builder(schema: Schema) -> "TransformProcess.Builder":
+        return TransformProcess.Builder(schema)
+
+
+class LocalTransformExecutor:
+    """datavec-local LocalTransformExecutor.execute analog."""
+
+    @staticmethod
+    def execute(records: List[List[Any]], tp: TransformProcess) -> List[List[Any]]:
+        return tp.execute(records)
+
+
+# ---------------------------------------------------------------------------
+# Record readers (records/reader/impl/*)
+# ---------------------------------------------------------------------------
+
+
+class CSVRecordReader:
+    """CSVRecordReader.java: parse CSV into typed records per a Schema
+    (types coerced if a schema is given, else strings)."""
+
+    def __init__(self, skip_lines: int = 0, delimiter: str = ",",
+                 schema: Optional[Schema] = None):
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self.schema = schema
+
+    def _coerce(self, row: List[str]) -> List[Any]:
+        if self.schema is None:
+            return row
+        out = []
+        for v, c in zip(row, self.schema.columns):
+            t = c["type"]
+            if t in ("integer", "long"):
+                out.append(int(v))
+            elif t in ("double", "float"):
+                out.append(float(v))
+            else:
+                out.append(v)
+        return out
+
+    def read(self, source: Union[str, io.TextIOBase]) -> List[List[Any]]:
+        if isinstance(source, str) and "\n" not in source:
+            with open(source, newline="") as f:
+                rows = list(csv.reader(f, delimiter=self.delimiter))
+        else:
+            text = source if isinstance(source, str) else source.read()
+            rows = list(csv.reader(io.StringIO(text), delimiter=self.delimiter))
+        rows = rows[self.skip_lines :]
+        return [self._coerce(r) for r in rows if r]
+
+
+def records_to_dataset(records: List[List[Any]], schema: Schema,
+                       label_column: str, num_classes: Optional[int] = None):
+    """RecordReaderDataSetIterator bridging role: records → DataSet."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    li = schema.index_of(label_column)
+    feats, labels = [], []
+    for r in records:
+        feats.append([float(v) for i, v in enumerate(r) if i != li])
+        labels.append(r[li])
+    x = np.asarray(feats, np.float32)
+    if num_classes:
+        y = np.zeros((len(labels), num_classes), np.float32)
+        y[np.arange(len(labels)), [int(l) for l in labels]] = 1.0
+    else:
+        y = np.asarray(labels, np.float32).reshape(-1, 1)
+    return DataSet(x, y)

@@ -1,0 +1,9 @@
+"""Native (C++) host-side ops consumed via ctypes (libnd4j's surviving role)."""
+
+from deeplearning4j_tpu.native_ops.threshold import (
+    threshold_encode,
+    threshold_decode,
+    bitmap_encode,
+    bitmap_decode,
+    native_available,
+)

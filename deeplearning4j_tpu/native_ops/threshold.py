@@ -1,0 +1,169 @@
+"""ctypes binding for the native threshold codec.
+
+Reference parity: the nd4j Java side calls libnd4j's encode/decode threshold
+ops over JNI; here the host-side codec is a C++ shared lib consumed via
+ctypes (SURVEY §8.1: native work = host-side codecs, not device kernels —
+the device path is XLA). Auto-builds with cmake on first use (cached under
+native/build); when no toolchain is available, numpy fallbacks in THIS module
+mirror the C ABI bit-for-bit (signed 1-based index format). These are
+distinct from ops/compression.py, whose jax ops use an in-graph
+(indices, values) format for use INSIDE compiled steps; this module is the
+host-side wire format for DCN gradient exchange.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    build_dir = os.path.join(_NATIVE_DIR, "build")
+    so = os.path.join(build_dir, "libdl4j_tpu_native.so")
+    if not os.path.exists(so):
+        try:
+            subprocess.run(["cmake", "-S", _NATIVE_DIR, "-B", build_dir],
+                           check=True, capture_output=True, timeout=120)
+            subprocess.run(["cmake", "--build", build_dir, "-j"],
+                           check=True, capture_output=True, timeout=300)
+        except Exception:
+            return None
+    if not os.path.exists(so):
+        return None
+    lib = ctypes.CDLL(so)
+    lib.threshold_encode.restype = ctypes.c_int64
+    lib.threshold_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.threshold_decode.restype = None
+    lib.threshold_decode.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    lib.bitmap_encode.restype = ctypes.c_int64
+    lib.bitmap_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float)]
+    lib.bitmap_decode.restype = None
+    lib.bitmap_decode.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_float)]
+    return lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if not _TRIED:
+            _TRIED = True
+            _LIB = _build_and_load()
+    return _LIB
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def threshold_encode(grad: np.ndarray, threshold: float,
+                     capacity: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (signed int32 indices, residual). Native when available."""
+    grad = np.ascontiguousarray(grad, np.float32).reshape(-1)
+    capacity = capacity if capacity is not None else grad.size
+    lib = _get_lib()
+    if lib is None:
+        return _py_encode(grad, threshold, capacity)
+    idx = np.empty(capacity, np.int32)
+    residual = np.empty_like(grad)
+    n = lib.threshold_encode(_fptr(grad), grad.size, ctypes.c_float(threshold),
+                             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                             capacity, _fptr(residual))
+    return idx[:n].copy(), residual
+
+
+def threshold_decode(indices: np.ndarray, threshold: float, size: int) -> np.ndarray:
+    indices = np.ascontiguousarray(indices, np.int32)
+    lib = _get_lib()
+    out = np.zeros(size, np.float32)
+    if lib is None:
+        pos = indices[indices > 0] - 1
+        neg = -indices[indices < 0] - 1
+        np.add.at(out, pos, threshold)
+        np.add.at(out, neg, -threshold)
+        return out
+    lib.threshold_decode(indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                         indices.size, ctypes.c_float(threshold), _fptr(out), size)
+    return out
+
+
+def bitmap_encode(grad: np.ndarray, threshold: float) -> Tuple[np.ndarray, np.ndarray, int]:
+    grad = np.ascontiguousarray(grad, np.float32).reshape(-1)
+    lib = _get_lib()
+    bits = np.zeros((grad.size + 3) // 4, np.uint8)
+    residual = np.empty_like(grad)
+    if lib is None:
+        return _py_bitmap_encode(grad, threshold, bits, residual)
+    nz = lib.bitmap_encode(_fptr(grad), grad.size, ctypes.c_float(threshold),
+                           bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                           _fptr(residual))
+    return bits, residual, int(nz)
+
+
+def bitmap_decode(bits: np.ndarray, threshold: float, size: int) -> np.ndarray:
+    lib = _get_lib()
+    out = np.zeros(size, np.float32)
+    bits = np.ascontiguousarray(bits, np.uint8)
+    if lib is None:
+        for i in range(size):
+            code = (bits[i // 4] >> (2 * (i % 4))) & 0x3
+            if code == 1:
+                out[i] += threshold
+            elif code == 2:
+                out[i] -= threshold
+        return out
+    lib.bitmap_decode(bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                      size, ctypes.c_float(threshold), _fptr(out))
+    return out
+
+
+# ---- numpy fallbacks (identical semantics) --------------------------------
+
+
+def _py_encode(grad, threshold, capacity):
+    residual = grad.copy()
+    hits = np.where(np.abs(grad) > threshold)[0][:capacity]
+    signs = np.sign(grad[hits])
+    idx = ((hits + 1) * signs).astype(np.int32)
+    residual[hits] -= signs.astype(np.float32) * threshold
+    return idx, residual
+
+
+def _py_bitmap_encode(grad, threshold, bits, residual):
+    residual[:] = grad
+    nz = 0
+    for i, g in enumerate(grad):
+        code = 0
+        if g > threshold:
+            code = 1
+            residual[i] = g - threshold
+            nz += 1
+        elif g < -threshold:
+            code = 2
+            residual[i] = g + threshold
+            nz += 1
+        bits[i // 4] |= code << (2 * (i % 4))
+    return bits, residual, nz

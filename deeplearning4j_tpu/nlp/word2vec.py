@@ -1,0 +1,189 @@
+"""Word2Vec — skip-gram with negative sampling.
+
+Reference parity:
+  * deeplearning4j-nlp models/word2vec/** — Word2Vec.Builder (minWordFrequency,
+    windowSize, layerSize, negativeSample, iterations/epochs, seed),
+    vocab building, `fit()`, `getWordVector`, `wordsNearest`, `similarity`;
+    ParagraphVectors sits on the same machinery.
+
+TPU-native realization: the reference trains with per-word Java threads doing
+tiny hogwild updates; here (center, context, negatives) triples are mined
+host-side into big batches and ONE jitted step does the batched dot-product
+sigmoid updates on-device — same objective, MXU-shaped.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Word2Vec:
+    """Skip-gram negative-sampling word embeddings."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, negative_samples: int = 5,
+                 learning_rate: float = 0.025, epochs: int = 1,
+                 batch_size: int = 512, seed: int = 42,
+                 subsample: float = 0.0):
+        self.layer_size = layer_size
+        self.window = window_size
+        self.min_count = min_word_frequency
+        self.negative = negative_samples
+        self.lr = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.subsample = subsample
+        self.vocab: Dict[str, int] = {}
+        self.inv_vocab: List[str] = []
+        self.counts: Optional[np.ndarray] = None
+        self.syn0: Optional[jnp.ndarray] = None  # input vectors
+        self.syn1: Optional[jnp.ndarray] = None  # output vectors
+        self._step_fn = None
+
+    # ---------------------------------------------------------------- vocab
+    def build_vocab(self, sentences: Iterable[Sequence[str]]) -> None:
+        counter = Counter()
+        for s in sentences:
+            counter.update(w.lower() for w in s)
+        items = [(w, c) for w, c in counter.most_common() if c >= self.min_count]
+        self.vocab = {w: i for i, (w, c) in enumerate(items)}
+        self.inv_vocab = [w for w, _ in items]
+        self.counts = np.array([c for _, c in items], np.float64)
+
+    # ------------------------------------------------------------------ fit
+    def _make_step(self):
+        neg = self.negative
+
+        def step(syn0, syn1, centers, contexts, negatives, lr):
+            """Batched SGNS update: maximize log σ(v·u⁺) + Σ log σ(-v·u⁻)."""
+            v = syn0[centers]                      # (B, D)
+            u_pos = syn1[contexts]                 # (B, D)
+            u_neg = syn1[negatives]                # (B, K, D)
+            pos_score = jnp.sum(v * u_pos, axis=-1)            # (B,)
+            neg_score = jnp.einsum("bd,bkd->bk", v, u_neg)     # (B, K)
+            g_pos = jax.nn.sigmoid(pos_score) - 1.0            # dL/d(pos_score)
+            g_neg = jax.nn.sigmoid(neg_score)                  # dL/d(neg_score)
+            grad_v = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+            grad_upos = g_pos[:, None] * v
+            grad_uneg = g_neg[..., None] * v[:, None, :]
+            loss = -(jnp.mean(jax.nn.log_sigmoid(pos_score))
+                     + jnp.mean(jnp.sum(jax.nn.log_sigmoid(-neg_score), axis=-1)))
+            # per-word MEAN gradient: normalize the scatter-add by how many
+            # times each index occurs in the batch, so small vocabularies
+            # (many collisions per batch) don't get a multiplied step size
+            V = syn0.shape[0]
+            acc0 = jnp.zeros_like(syn0).at[centers].add(grad_v)
+            cnt0 = jnp.zeros((V,), grad_v.dtype).at[centers].add(1.0)
+            syn0 = syn0 - lr * acc0 / jnp.maximum(cnt0, 1.0)[:, None]
+            neg_flat = negatives.reshape(-1)
+            acc1 = (jnp.zeros_like(syn1).at[contexts].add(grad_upos)
+                    .at[neg_flat].add(grad_uneg.reshape(-1, grad_uneg.shape[-1])))
+            cnt1 = (jnp.zeros((V,), grad_v.dtype).at[contexts].add(1.0)
+                    .at[neg_flat].add(1.0))
+            syn1 = syn1 - lr * acc1 / jnp.maximum(cnt1, 1.0)[:, None]
+            return syn0, syn1, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _pairs(self, sentences: List[List[str]], rng: np.random.RandomState):
+        centers, contexts = [], []
+        keep_prob = None
+        if self.subsample > 0:
+            freq = self.counts / self.counts.sum()
+            keep_prob = np.minimum(1.0, np.sqrt(self.subsample / freq)
+                                   + self.subsample / freq)
+        for s in sentences:
+            ids = [self.vocab[w.lower()] for w in s if w.lower() in self.vocab]
+            if keep_prob is not None:
+                ids = [i for i in ids if rng.rand() < keep_prob[i]]
+            for pos, c in enumerate(ids):
+                w = rng.randint(1, self.window + 1)
+                for off in range(-w, w + 1):
+                    j = pos + off
+                    if off != 0 and 0 <= j < len(ids):
+                        centers.append(c)
+                        contexts.append(ids[j])
+        return np.asarray(centers, np.int32), np.asarray(contexts, np.int32)
+
+    def fit(self, sentences: Iterable[Sequence[str]]) -> List[float]:
+        sentences = [list(s) for s in sentences]
+        if not self.vocab:
+            self.build_vocab(sentences)
+        V, D = len(self.vocab), self.layer_size
+        if self.syn0 is None or self.syn0.shape != (V, D):
+            # fresh init only when untrained (a loaded/partially-trained model
+            # continues from its existing vectors, reference semantics)
+            key = jax.random.key(self.seed)
+            self.syn0 = (jax.random.uniform(key, (V, D)) - 0.5) / D
+            self.syn1 = jnp.zeros((V, D))
+        if self._step_fn is None:
+            self._step_fn = self._make_step()
+        # unigram^0.75 negative-sampling table (reference's table approach)
+        probs = self.counts ** 0.75
+        probs = probs / probs.sum()
+        rng = np.random.RandomState(self.seed)
+        history = []
+        for ep in range(self.epochs):
+            centers, contexts = self._pairs(sentences, rng)
+            order = rng.permutation(len(centers))
+            losses = []
+            lr = self.lr * max(0.0001, 1.0 - ep / max(self.epochs, 1))
+            for i in range(0, len(order), self.batch_size):
+                idx = order[i : i + self.batch_size]
+                if len(idx) < 2:
+                    continue
+                negs = rng.choice(len(probs), size=(len(idx), self.negative), p=probs)
+                self.syn0, self.syn1, loss = self._step_fn(
+                    self.syn0, self.syn1, jnp.asarray(centers[idx]),
+                    jnp.asarray(contexts[idx]), jnp.asarray(negs, jnp.int32),
+                    jnp.asarray(lr, jnp.float32))
+                losses.append(loss)
+            history.append(float(jnp.mean(jnp.stack(losses))) if losses else float("nan"))
+        return history
+
+    # ------------------------------------------------------------- queries
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.get(word.lower())
+        return None if i is None else np.asarray(self.syn0[i])
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.get_word_vector(word)
+        if v is None:
+            return []
+        mat = np.asarray(self.syn0)
+        sims = mat @ v / (np.linalg.norm(mat, axis=1) * np.linalg.norm(v) + 1e-12)
+        order = np.argsort(-sims)
+        out = [self.inv_vocab[i] for i in order if self.inv_vocab[i] != word.lower()]
+        return out[:n]
+
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    # --------------------------------------------------------------- serde
+    def save(self, path: str) -> None:
+        np.savez(path, syn0=np.asarray(self.syn0), syn1=np.asarray(self.syn1),
+                 vocab=np.array(self.inv_vocab, dtype=object),
+                 counts=self.counts)
+
+    @staticmethod
+    def load(path: str) -> "Word2Vec":
+        data = np.load(path, allow_pickle=True)
+        w = Word2Vec(layer_size=int(data["syn0"].shape[1]))
+        w.inv_vocab = list(data["vocab"])
+        w.vocab = {v: i for i, v in enumerate(w.inv_vocab)}
+        w.counts = data["counts"]
+        w.syn0 = jnp.asarray(data["syn0"])
+        w.syn1 = jnp.asarray(data["syn1"])
+        return w

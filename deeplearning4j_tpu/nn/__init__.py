@@ -35,7 +35,8 @@ from deeplearning4j_tpu.nn.conf import (
     SelfAttentionLayer,
 )
 from deeplearning4j_tpu.nn.updater import (
-    Sgd, Adam, AdaMax, Nadam, AmsGrad, AdaGrad, AdaDelta, RmsProp, Nesterovs, NoOp,
+    Sgd, Adam, AdaMax, Nadam, AmsGrad, AdaGrad, AdaDelta, RmsProp, Nesterovs,
+    NoOp, Frozen,
     Schedule, StepSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
     SigmoidSchedule, CycleSchedule, MapSchedule, get_updater,
 )

@@ -1,0 +1,221 @@
+"""Early stopping — deeplearning4j-core earlystopping parity.
+
+Reference parity:
+  * org/deeplearning4j/earlystopping/EarlyStoppingConfiguration.java,
+    trainer/EarlyStoppingTrainer.java, termination conditions
+    (MaxEpochsTerminationCondition, MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition, MaxScoreIterationTermination),
+    saver/{LocalFileModelSaver, InMemoryModelSaver}, EarlyStoppingResult.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class EpochTerminationCondition:
+    def terminate(self, epoch: int, score: float, history: List[float]) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score, history):
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after N epochs without (min_improvement) improvement."""
+
+    def __init__(self, max_epochs_without_improvement: int, min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+
+    def terminate(self, epoch, score, history):
+        if len(history) <= self.patience:
+            return False
+        best_before = min(history[: -self.patience])
+        recent_best = min(history[-self.patience :])
+        # no strict improvement of at least min_improvement in `patience` epochs
+        return recent_best >= best_before - self.min_improvement
+
+
+class IterationTerminationCondition:
+    def terminate(self, score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Abort if score explodes past a bound (divergence guard)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, score):
+        return score > self.max_score or not np.isfinite(score)
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start = None
+
+    def terminate(self, score):
+        if self._start is None:
+            self._start = time.time()
+            return False
+        return time.time() - self._start > self.max_seconds
+
+
+class InMemoryModelSaver:
+    """saver/InMemoryModelSaver.java."""
+
+    def __init__(self):
+        self.best = None
+        self.latest = None
+
+    def save_best(self, net):
+        self.best = {"params": copy.deepcopy(net.params),
+                     "net_state": copy.deepcopy(net.net_state)}
+
+    def save_latest(self, net):
+        self.latest = {"params": copy.deepcopy(net.params),
+                       "net_state": copy.deepcopy(net.net_state)}
+
+    def restore_best(self, net):
+        if self.best is not None:
+            net.params = self.best["params"]
+            net.net_state = self.best["net_state"]
+        return net
+
+
+class LocalFileModelSaver:
+    """saver/LocalFileModelSaver.java: bestModel.zip / latestModel.zip."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def save_best(self, net):
+        from deeplearning4j_tpu.nn.serde import save_model
+
+        save_model(net, os.path.join(self.dir, "bestModel.zip"))
+
+    def save_latest(self, net):
+        from deeplearning4j_tpu.nn.serde import save_model
+
+        save_model(net, os.path.join(self.dir, "latestModel.zip"))
+
+    def restore_best(self, net):
+        from deeplearning4j_tpu.nn.serde import restore_model
+
+        return restore_model(os.path.join(self.dir, "bestModel.zip"))
+
+
+class EarlyStoppingConfiguration:
+    """EarlyStoppingConfiguration.Builder analog (kwargs instead of builder)."""
+
+    def __init__(self,
+                 epoch_termination_conditions: Optional[List[EpochTerminationCondition]] = None,
+                 iteration_termination_conditions: Optional[List[IterationTerminationCondition]] = None,
+                 score_calculator: Optional[Callable[[Any], float]] = None,
+                 model_saver=None,
+                 evaluate_every_n_epochs: int = 1,
+                 save_last_model: bool = False):
+        self.epoch_conditions = epoch_termination_conditions or []
+        self.iteration_conditions = iteration_termination_conditions or []
+        self.score_calculator = score_calculator
+        self.saver = model_saver if model_saver is not None else InMemoryModelSaver()
+        self.every_n = max(1, evaluate_every_n_epochs)
+        self.save_last_model = save_last_model
+
+
+class EarlyStoppingResult:
+    """EarlyStoppingResult.java: reason, best epoch/score, score history."""
+
+    def __init__(self, termination_reason: str, termination_details: str,
+                 best_epoch: int, best_score: float,
+                 total_epochs: int, score_history: Dict[int, float], best_model):
+        self.termination_reason = termination_reason
+        self.termination_details = termination_details
+        self.best_epoch = best_epoch
+        self.best_score = best_score
+        self.total_epochs = total_epochs
+        self.score_history = score_history
+        self.best_model = best_model
+
+
+class EarlyStoppingTrainer:
+    """trainer/EarlyStoppingTrainer.java for MultiLayerNetwork (and graphs)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iterator,
+                 test_iterator=None):
+        self.cfg = config
+        self.net = net
+        self.train_iter = train_iterator
+        self.test_iter = test_iterator
+
+    def _score(self) -> float:
+        if self.cfg.score_calculator is not None:
+            return float(self.cfg.score_calculator(self.net))
+        if self.test_iter is not None:
+            # default: loss on the test set (DataSetLossCalculator analog)
+            scores = []
+            for ds in self.test_iter:
+                scores.append(self.net.score(ds))
+            return float(np.mean(scores))
+        return self.net.score()
+
+    def fit(self) -> EarlyStoppingResult:
+        best_score = float("inf")
+        best_epoch = -1
+        history: Dict[int, float] = {}
+        epoch_scores: List[float] = []
+        epoch = 0
+        reason, details = "EpochTerminationCondition", "exhausted"
+        while True:
+            self.net.fit(self.train_iter, epochs=1)
+            # iteration-condition check on the training score
+            train_score = self.net.score()
+            stop_iter = False
+            for c in self.cfg.iteration_conditions:
+                if c.terminate(train_score):
+                    reason = "IterationTerminationCondition"
+                    details = f"{type(c).__name__} at epoch {epoch}"
+                    stop_iter = True
+                    break
+            if stop_iter:
+                break
+            if epoch % self.cfg.every_n == 0:
+                score = self._score()
+                history[epoch] = score
+                epoch_scores.append(score)
+                if score < best_score:
+                    best_score = score
+                    best_epoch = epoch
+                    self.cfg.saver.save_best(self.net)
+                if self.cfg.save_last_model:
+                    self.cfg.saver.save_latest(self.net)
+            # termination checks run EVERY epoch (reference semantics); score
+            # conditions see the most recent calculated score
+            last = epoch_scores[-1] if epoch_scores else float("inf")
+            stop_epoch = False
+            for c in self.cfg.epoch_conditions:
+                if c.terminate(epoch, last, epoch_scores):
+                    reason = "EpochTerminationCondition"
+                    details = f"{type(c).__name__} at epoch {epoch}"
+                    stop_epoch = True
+                    break
+            if stop_epoch:
+                break
+            epoch += 1
+        best_model = self.cfg.saver.restore_best(self.net)
+        return EarlyStoppingResult(reason, details, best_epoch, best_score,
+                                   epoch + 1, history, best_model)

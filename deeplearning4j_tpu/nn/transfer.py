@@ -1,0 +1,211 @@
+"""Transfer learning — graft/freeze/replace layers on an existing model.
+
+Reference parity:
+  * org/deeplearning4j/nn/transferlearning/TransferLearning.java (Builder:
+    fineTuneConfiguration, setFeatureExtractor (freeze up to layer),
+    removeOutputLayer/removeLayersFromOutput, addLayer,
+    nOutReplace), FineTuneConfiguration.java, TransferLearningHelper.java
+    (featurize: run frozen part once, train only the head).
+
+TPU-native realization: frozen layers get a Frozen updater (zero update) (their params stay
+bit-identical — the FrozenLayer effect) while remaining in the single jitted
+step; TransferLearningHelper precomputes frozen-prefix activations with a
+jitted forward so head-only epochs skip the backbone entirely.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Frozen, get_updater
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """FineTuneConfiguration.java: overrides applied to non-frozen layers."""
+
+    updater: Any = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    weight_decay: Optional[float] = None
+    seed: Optional[int] = None
+
+
+class TransferLearningBuilder:
+    """TransferLearning.Builder analog for MultiLayerNetwork."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        self._src = net
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_until: Optional[int] = None
+        self._remove_from: Optional[int] = None
+        self._added: List[C.LayerConf] = []
+        self._n_out_replace: dict = {}
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, layer_idx: int):
+        """Freeze layers [0..layer_idx] (inclusive) — setFeatureExtractor."""
+        self._freeze_until = layer_idx
+        return self
+
+    def remove_output_layer(self):
+        self._remove_from = len(self._src.conf.layers) - 1
+        return self
+
+    def remove_layers_from_output(self, n: int):
+        self._remove_from = len(self._src.conf.layers) - n
+        return self
+
+    def n_out_replace(self, layer_idx: int, n_out: int, weight_init: str = "xavier"):
+        """Replace layer's n_out (re-initializing it and the next layer's
+        n_in) — nOutReplace."""
+        self._n_out_replace[layer_idx] = (n_out, weight_init)
+        return self
+
+    def add_layer(self, lc: C.LayerConf):
+        self._added.append(lc)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        src = self._src
+        old_conf = src.conf
+        keep_n = self._remove_from if self._remove_from is not None else len(old_conf.layers)
+        new_layers = [copy.deepcopy(lc) for lc in old_conf.layers[:keep_n]]
+        reinit = set()  # layer indices whose params must be re-initialized
+
+        # n_out replacement (and downstream n_in fix-up)
+        for idx, (n_out, winit) in self._n_out_replace.items():
+            new_layers[idx] = dataclasses.replace(new_layers[idx], n_out=n_out,
+                                                  weight_init=winit)
+            reinit.add(idx)
+            if idx + 1 < len(new_layers) and hasattr(new_layers[idx + 1], "n_in"):
+                new_layers[idx + 1] = dataclasses.replace(new_layers[idx + 1], n_in=n_out)
+                reinit.add(idx + 1)
+
+        # frozen layers: Frozen updater (zero update — the FrozenLayer effect)
+        if self._freeze_until is not None:
+            for i in range(min(self._freeze_until + 1, len(new_layers))):
+                new_layers[i] = dataclasses.replace(new_layers[i], updater=Frozen())
+
+        # fine-tune overrides on non-frozen kept layers
+        new_conf = copy.deepcopy(old_conf)
+        if self._fine_tune is not None:
+            ftc = self._fine_tune
+            if ftc.updater is not None:
+                new_conf.updater = get_updater(ftc.updater)
+            if ftc.l1 is not None:
+                new_conf.l1 = ftc.l1
+            if ftc.l2 is not None:
+                new_conf.l2 = ftc.l2
+            if ftc.weight_decay is not None:
+                new_conf.weight_decay = ftc.weight_decay
+            if ftc.seed is not None:
+                new_conf.seed = ftc.seed
+
+        # appended layers: infer n_in from the previous output type
+        for lc in self._added:
+            if hasattr(lc, "n_in") and getattr(lc, "n_in") == 0 and new_layers:
+                itype = None
+                # recompute shapes through the kept stack
+                it = new_conf.input_type
+                for i, kept in enumerate(new_layers):
+                    pre = new_conf.preprocessors.get(i)
+                    if pre is not None and isinstance(pre, C.FeedForwardToCnnPreProcessor):
+                        it = C.InputType.convolutional(pre.height, pre.width, pre.channels)
+                    elif pre is not None and isinstance(pre, C.CnnToFeedForwardPreProcessor):
+                        it = C.InputType.feed_forward(pre.height * pre.width * pre.channels)
+                    it = kept.output_type(it)
+                size = it.size if it.kind in ("feedforward", "recurrent") else it.flat_size()
+                lc = dataclasses.replace(lc, n_in=size)
+            new_layers.append(lc)
+            reinit.add(len(new_layers) - 1)
+
+        new_conf.layers = new_layers
+        # drop preprocessors beyond the kept stack
+        new_conf.preprocessors = {i: p for i, p in new_conf.preprocessors.items()
+                                  if i < len(new_layers)}
+        out = MultiLayerNetwork(new_conf).init()
+        # copy params for kept, non-reinitialized layers
+        for i in range(len(new_layers)):
+            if i < keep_n and i not in reinit and i < len(src.params):
+                out.params[i] = copy.deepcopy(src.params[i])
+                out.net_state[i] = copy.deepcopy(src.net_state[i])
+        return out
+
+
+class TransferLearning:
+    """Entry point: TransferLearning.builder(net)...build()."""
+
+    @staticmethod
+    def builder(net: MultiLayerNetwork) -> TransferLearningBuilder:
+        return TransferLearningBuilder(net)
+
+
+class TransferLearningHelper:
+    """TransferLearningHelper.java: featurize-then-train-head.
+
+    Runs the frozen prefix ONCE per dataset (jitted forward) and trains only
+    the unfrozen tail on the cached activations — the big fine-tune speedup
+    when the backbone dominates compute.
+    """
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: int):
+        self.net = net
+        self.frozen_until = frozen_until
+        import jax
+
+        @jax.jit
+        def prefix_forward(params, net_state, x, mask):
+            from deeplearning4j_tpu.nn.layers import apply_preprocessor
+
+            for i, layer in enumerate(net.layers[: frozen_until + 1]):
+                x = apply_preprocessor(net.conf.preprocessors.get(i), x)
+                x, _, mask = layer.apply(params[i], x, net_state[i],
+                                         train=False, rng=None, mask=mask)
+            return x, mask
+
+        self._prefix = prefix_forward
+        # head net: layers after the frozen prefix
+        head_conf = copy.deepcopy(net.conf)
+        head_conf.layers = [copy.deepcopy(lc) for lc in net.conf.layers[frozen_until + 1 :]]
+        head_conf.preprocessors = {
+            i - (frozen_until + 1): p for i, p in net.conf.preprocessors.items()
+            if i > frozen_until}
+        self.head = MultiLayerNetwork(head_conf)
+        self.head.init(params=[copy.deepcopy(p) for p in net.params[frozen_until + 1 :]])
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        import numpy as np
+
+        fm = None if ds.features_mask is None else np.asarray(ds.features_mask,
+                                                              np.float32)
+        feats, out_mask = self._prefix(
+            self.net.params, self.net.net_state,
+            np.asarray(ds.features, np.float32), fm)
+        return DataSet(np.asarray(feats), ds.labels,
+                       None if out_mask is None else np.asarray(out_mask),
+                       ds.labels_mask)
+
+    def fit_featurized(self, ds_or_iter, epochs: int = 1, batch_size: int = 32):
+        if isinstance(ds_or_iter, DataSet):
+            self.head.fit(ListDataSetIterator(ds_or_iter, batch_size=batch_size),
+                          epochs=epochs)
+        else:
+            self.head.fit(ds_or_iter, epochs=epochs)
+        # sync head params AND state (BN running stats) back into the full net
+        for j, p in enumerate(self.head.params):
+            self.net.params[self.frozen_until + 1 + j] = p
+            self.net.net_state[self.frozen_until + 1 + j] = self.head.net_state[j]
+
+    def unfrozen_mln(self) -> MultiLayerNetwork:
+        return self.head

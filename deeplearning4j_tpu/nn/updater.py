@@ -253,6 +253,19 @@ class NoOp(Updater):
 
 
 @dataclasses.dataclass(frozen=True)
+class Frozen(Updater):
+    """The FrozenLayer effect at the updater level: update is exactly zero,
+    so the layer's params never move (reference FrozenLayer zeroes the
+    gradient in backprop; here the layer stays in the fused step but its
+    update is dropped)."""
+
+    learning_rate: Any = 0.0
+
+    def apply(self, grad, state, lr, step):
+        return jnp.zeros_like(grad), state
+
+
+@dataclasses.dataclass(frozen=True)
 class Nesterovs(Updater):
     """NesterovsUpdater (Nesterov momentum).
 
@@ -428,7 +441,8 @@ class AmsGrad(Updater):
 
 UPDATERS = {
     c.__name__: c
-    for c in [Sgd, NoOp, Nesterovs, AdaGrad, RmsProp, AdaDelta, Adam, AdaMax, Nadam, AmsGrad]
+    for c in [Sgd, NoOp, Frozen, Nesterovs, AdaGrad, RmsProp, AdaDelta, Adam,
+              AdaMax, Nadam, AmsGrad]
 }
 
 

@@ -1,0 +1,202 @@
+"""Pallas flash-attention — the cuDNN-platform-helper analog for attention.
+
+Reference parity: libnd4j exposes dot_product_attention as a materialized
+O(T²)-memory generic op (SURVEY §6.7 — the reference has NO flash/blockwise
+attention). This kernel is the TPU "platform helper" upgrade: blockwise
+online-softmax attention that never materializes the (T, T) score matrix,
+registered into the op registry's platform table exactly where a cuDNN
+helper would override the generic impl (registry.resolve — SURVEY §8.1).
+
+Kernel design (per pallas_guide.md):
+  * grid = (batch*heads, T_q/block_q); each program owns one q block in VMEM.
+  * inner fori_loop walks k/v blocks, carrying (acc, running max m, running
+    denom l) — the FlashAttention-2 recurrence; both matmuls per step hit
+    the MXU at (block_q × d) @ (d × block_k) and (block_q × block_k) @
+    (block_k × d).
+  * forward-only: backward falls back to the XLA generic op (jax.custom_vjp
+    recomputes with the generic path), so training still differentiates.
+
+Runs in interpret mode off-TPU so CPU tests exercise the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU-capable builds; interpret mode needs none
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
+                 causal: bool, block_q: int, kv_len: int):
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+    t_kv = k_ref.shape[1]
+    n_kb = t_kv // block_k
+    qi = pl.program_id(1)
+
+    def body(ki, carry):
+        acc, m, l = carry
+        kblk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = q @ kblk.T  # (block_q, block_k)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if kv_len < t_kv:  # zero-padded keys must not receive softmax mass
+            s = jnp.where(k_pos < kv_len, s, -1e30)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + p @ vblk
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((q.shape[0], v_ref.shape[-1]), jnp.float32)
+    m0 = jnp.full((q.shape[0], 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((q.shape[0], 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, scale: float, causal: bool,
+               block_q: int, block_k: int, interpret: bool):
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_kv)
+    pad_q = (-t_q) % block_q
+    pad_k = (-t_kv) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        # padded kv keys must never win the softmax: pad k with -inf-ish is
+        # unsafe for matmul; instead pad normally and mask via causal-style
+        # position check — simpler: pad and rely on explicit length masking
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    grid = (bh, (t_q + pad_q) // block_q)
+    kernel = functools.partial(
+        _attn_kernel, block_k=block_k, scale=scale, causal=causal,
+        block_q=block_q, kv_len=t_kv)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t_q + pad_q, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, k.shape[1], d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, v.shape[1], d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :t_q]
+
+
+def _reference_attention(q, k, v, *, scale: float, causal: bool):
+    """The generic O(T²) path (libnd4j dot_product_attention math) — used
+    for the backward pass and as the platform fallback."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, scale: Optional[float] = None, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Blockwise attention over (BH, T, D) tensors (fold batch×heads first).
+
+    Forward runs the Pallas kernel; backward re-computes through the XLA
+    generic path (standard flash-training trades FLOPs for HBM)."""
+    return _flash_call(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _resolve_interpret(interpret):
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _flash_call(q, k, v, scale, causal, block_q, block_k, interpret):
+    if causal and q.shape[1] != k.shape[1]:
+        # the kernel's causal mask is start-aligned on raw positions; the
+        # backward/reference path is end-aligned — they only agree for
+        # t_q == t_kv, so reject the ambiguous case instead of silently
+        # training against a different attention pattern
+        raise ValueError(
+            f"causal flash attention requires t_q == t_kv, got "
+            f"{q.shape[1]} vs {k.shape[1]}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                      block_k=block_k, interpret=_resolve_interpret(interpret))
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_call(q, k, v, scale, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+
+    def ref(q, k, v):
+        return _reference_attention(q, k, v, scale=s, causal=causal)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_mha(q, k, v, *, num_heads: int, causal: bool = False,
+              interpret: Optional[bool] = None):
+    """(N, T, H*dh) convenience wrapper: split heads, run flash, re-merge."""
+    n, t, d = q.shape
+    dh = d // num_heads
+
+    def split(a):
+        return a.reshape(n, a.shape[1], num_heads, dh).transpose(0, 2, 1, 3) \
+                .reshape(n * num_heads, a.shape[1], dh)
+
+    out = flash_attention(split(q), split(k), split(v), None, causal,
+                          128, 128, interpret)
+    return out.reshape(n, num_heads, t, dh).transpose(0, 2, 1, 3).reshape(n, t, d)
+
+
+def register_platform_attention() -> None:
+    """Install flash attention as the TPU platform override for the generic
+    dot_product_attention op (the cuDNN PlatformHelper pattern)."""
+    from deeplearning4j_tpu.ops.registry import registry
+
+    reg = registry()
+
+    def flash_dpa(q, k, v, mask=None, *, scaled: bool = True):
+        # usable() guarantees mask is None and q is 3-D (BH, T, D)
+        scale = (1.0 / math.sqrt(q.shape[-1])) if scaled else 1.0
+        return flash_attention(q, k, v, scale, False, 128, 128, None)
+
+    def usable(q, k, v, mask=None, **kw):
+        return mask is None and q.ndim == 3 and q.shape[-1] % 8 == 0
+
+    if "dot_product_attention" in reg:
+        reg.register_platform("dot_product_attention", "tpu", flash_dpa, usable)

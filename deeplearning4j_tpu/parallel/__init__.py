@@ -20,3 +20,7 @@ from deeplearning4j_tpu.parallel.launch import (
     host_shard,
     ShardedDataSetIterator,
 )
+from deeplearning4j_tpu.parallel.ring_attention import (
+    ring_attention,
+    RingSelfAttention,
+)

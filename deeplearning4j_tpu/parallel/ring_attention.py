@@ -1,0 +1,114 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+The reference has NO long-context mechanism beyond truncated BPTT
+(SURVEY §6.7); this is the TPU-first capability the rebuild adds as
+first-class: sequences sharded across a ``seq`` mesh axis, with K/V blocks
+rotating around the ring via ``jax.lax.ppermute`` while each device keeps an
+online-softmax accumulator (the FlashAttention recurrence distributed over
+ICI — Liu et al. ring attention; blockwise per-hop compute overlaps the
+neighbor transfer because XLA pipelines the permute with the matmuls).
+
+Memory per device: O(T/N · d) activations, O((T/N)²) scores per hop — a
+sequence N× longer than single-device HBM allows.
+
+Usage (inside shard_map or via the convenience wrapper):
+    out = ring_attention(q, k, v, mesh=mesh, axis='seq')   # q,k,v (BH, T, D)
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
+                          causal: bool = False):
+    """Per-shard body (runs under shard_map). q/k/v: (BH, T_local, D).
+
+    Each of the N hops computes attention of the LOCAL queries against the
+    visiting K/V shard, folded into (acc, m, l) online-softmax state, then
+    rotates K/V to the next ring neighbor.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    qf = q.astype(jnp.float32) * scale
+
+    def hop(h, carry):
+        acc, m, l, k_cur, v_cur = carry
+        # with the (i → i+1) rotation, at hop h device idx holds the kv
+        # shard that originated at (idx - h) mod n
+        src = jnp.mod(idx - h, n)
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            q_pos = idx * t_local + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            k_pos = src * t_local + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 2)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bqk,bkd->bqd", p, v_cur.astype(jnp.float32))
+        # rotate kv to the next neighbor (ring over ICI)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc_new, m_new, l_new, k_next, v_next
+
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((*q.shape[:2], 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((*q.shape[:2], 1), jnp.float32)
+    acc, m, l, _, _ = jax.lax.fori_loop(
+        0, n, hop, (acc0, m0, l0, k, v))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "seq",
+                   scale: Optional[float] = None, causal: bool = False):
+    """Sequence-parallel attention: shard the T axis of (BH, T, D) over
+    ``axis`` and run the ring. Returns the full (BH, T, D) output with the
+    same sharding."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, axis, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis, scale=scale,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)
+
+
+class RingSelfAttention:
+    """Model-facing wrapper: multi-head self-attention with the sequence
+    axis sharded over a mesh (the long-context building block)."""
+
+    def __init__(self, mesh: Mesh, num_heads: int, axis: str = "seq",
+                 causal: bool = False):
+        self.mesh = mesh
+        self.num_heads = num_heads
+        self.axis = axis
+        self.causal = causal
+
+    def __call__(self, x, wq, wk, wv, wo):
+        n, t, d = x.shape
+        h = self.num_heads
+        dh = d // h
+
+        def split(a):
+            return a.reshape(n, t, h, dh).transpose(0, 2, 1, 3).reshape(n * h, t, dh)
+
+        q, k, v = split(x @ wq), split(x @ wk), split(x @ wv)
+        out = ring_attention(q, k, v, mesh=self.mesh, axis=self.axis,
+                             causal=self.causal)
+        out = out.reshape(n, h, t, dh).transpose(0, 2, 1, 3).reshape(n, t, d)
+        return out @ wo

@@ -1,0 +1,12 @@
+"""RL — DQN/actor-critic (RL4J role, SURVEY §3.4)."""
+
+from deeplearning4j_tpu.rl.dqn import (
+    MDP,
+    EpsGreedy,
+    BoltzmannPolicy,
+    GreedyPolicy,
+    ExpReplay,
+    QLearningConfiguration,
+    QLearningDiscrete,
+    ActorCritic,
+)

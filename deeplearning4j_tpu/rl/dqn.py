@@ -1,0 +1,349 @@
+"""Reinforcement learning — the RL4J role.
+
+Reference parity (SURVEY §3.4):
+  * rl4j-core learning/sync/qlearning/discrete/QLearningDiscrete.java — DQN
+    with experience replay, target network, ε-greedy annealing, double-DQN
+    flag, reward clipping.
+  * policy/* — EpsGreedy, BoltzmannPolicy (policies over a Q-network).
+  * MDP interface (rl4j-api): reset/step/isDone/actionSpace.
+  * learning/async/a3c — async advantage actor-critic: realized here as a
+    SYNCHRONOUS batched advantage actor-critic (`ActorCritic`): hogwild
+    thread-async has no TPU analog; batched sync updates are the idiomatic
+    replacement (documented divergence, same objective).
+
+Q/policy networks are MultiLayerNetworks; the TD/AC update is its own fused
+jitted step over the network's params (replay minibatch in, params out).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from collections import deque
+from typing import Any, Callable, Deque, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class MDP:
+    """rl4j-api MDP interface."""
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool]:
+        """returns (observation, reward, done)."""
+        raise NotImplementedError
+
+    @property
+    def num_actions(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def obs_size(self) -> int:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Policies (rl4j policy/*)
+# ---------------------------------------------------------------------------
+
+
+class EpsGreedy:
+    """EpsGreedy.java: anneal ε from eps_start to eps_min over anneal_steps."""
+
+    def __init__(self, eps_start: float = 1.0, eps_min: float = 0.05,
+                 anneal_steps: int = 1000, seed: int = 0):
+        self.eps_start = eps_start
+        self.eps_min = eps_min
+        self.anneal = anneal_steps
+        self.rng = np.random.RandomState(seed)
+        self.step_count = 0
+
+    def epsilon(self) -> float:
+        f = min(1.0, self.step_count / max(1, self.anneal))
+        return self.eps_start + f * (self.eps_min - self.eps_start)
+
+    def next_action(self, q_values: np.ndarray) -> int:
+        self.step_count += 1
+        if self.rng.rand() < self.epsilon():
+            return int(self.rng.randint(len(q_values)))
+        return int(np.argmax(q_values))
+
+
+class BoltzmannPolicy:
+    """BoltzmannQPolicy.java: sample ∝ softmax(Q/T)."""
+
+    def __init__(self, temperature: float = 1.0, seed: int = 0):
+        self.temperature = temperature
+        self.rng = np.random.RandomState(seed)
+
+    def next_action(self, q_values: np.ndarray) -> int:
+        z = q_values / max(self.temperature, 1e-6)
+        z = z - z.max()
+        p = np.exp(z) / np.exp(z).sum()
+        return int(self.rng.choice(len(q_values), p=p))
+
+
+class GreedyPolicy:
+    def next_action(self, q_values: np.ndarray) -> int:
+        return int(np.argmax(q_values))
+
+
+# ---------------------------------------------------------------------------
+# Replay buffer (learning/sync/ExpReplay.java)
+# ---------------------------------------------------------------------------
+
+
+class ExpReplay:
+    def __init__(self, max_size: int = 10000, batch_size: int = 32, seed: int = 0):
+        self.buf: Deque = deque(maxlen=max_size)
+        self.batch_size = batch_size
+        self.rng = random.Random(seed)
+
+    def store(self, transition) -> None:
+        self.buf.append(transition)
+
+    def sample(self):
+        batch = self.rng.sample(list(self.buf), min(self.batch_size, len(self.buf)))
+        s, a, r, s2, d = zip(*batch)
+        return (np.stack(s).astype(np.float32), np.asarray(a, np.int32),
+                np.asarray(r, np.float32), np.stack(s2).astype(np.float32),
+                np.asarray(d, np.float32))
+
+    def __len__(self):
+        return len(self.buf)
+
+
+# ---------------------------------------------------------------------------
+# DQN (QLearningDiscrete)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class QLearningConfiguration:
+    """QLearning.QLConfiguration analog."""
+
+    gamma: float = 0.99
+    batch_size: int = 32
+    target_update_freq: int = 100
+    start_size: int = 64
+    max_replay: int = 10000
+    double_dqn: bool = True
+    reward_clip: Optional[float] = None
+    eps_start: float = 1.0
+    eps_min: float = 0.05
+    eps_anneal_steps: int = 1000
+    seed: int = 0
+
+
+class QLearningDiscrete:
+    """QLearningDiscrete.java: DQN trainer over an MDP."""
+
+    def __init__(self, mdp: MDP, net: MultiLayerNetwork,
+                 config: QLearningConfiguration = QLearningConfiguration()):
+        self.mdp = mdp
+        self.net = net
+        self.cfg = config
+        self.policy = EpsGreedy(config.eps_start, config.eps_min,
+                                config.eps_anneal_steps, config.seed)
+        self.replay = ExpReplay(config.max_replay, config.batch_size, config.seed)
+        self.target_params = jax.tree.map(jnp.asarray, net.params)
+        self._td_step = self._make_td_step()
+        self.total_steps = 0
+        self.episode_rewards: List[float] = []
+
+    def _make_td_step(self):
+        cfg = self.cfg
+        net = self.net
+
+        def td_step(params, target_params, opt_state, step, s, a, r, s2, d):
+            def loss_of(p):
+                q = net._forward(p, net.net_state, s, None, train=False, rng=None)[0]
+                q_sa = jnp.take_along_axis(q, a[:, None], axis=1)[:, 0]
+                q_next_target = net._forward(target_params, net.net_state, s2,
+                                             None, train=False, rng=None)[0]
+                if cfg.double_dqn:
+                    q_next_online = net._forward(p, net.net_state, s2, None,
+                                                 train=False, rng=None)[0]
+                    a_star = jnp.argmax(q_next_online, axis=1)
+                    q_next = jnp.take_along_axis(
+                        q_next_target, a_star[:, None], axis=1)[:, 0]
+                else:
+                    q_next = jnp.max(q_next_target, axis=1)
+                target = r + cfg.gamma * (1.0 - d) * jax.lax.stop_gradient(q_next)
+                return jnp.mean((q_sa - target) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            from deeplearning4j_tpu.nn.multilayer import apply_layer_updates
+
+            updated = apply_layer_updates(
+                net.conf, zip(params, grads, opt_state, net.updaters, net.conf.layers),
+                step, net._normalize_gradient)
+            return ([p for p, _ in updated], [s_ for _, s_ in updated], loss)
+
+        # no donation: params and target_params alias right after a target
+        # sync (donating an aliased buffer is an XLA error), and RL nets are
+        # small enough that the copy is irrelevant
+        return jax.jit(td_step)
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        return self.net.output(obs[None].astype(np.float32))[0]
+
+    def train_episode(self, max_steps: int = 200) -> float:
+        obs = self.mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            action = self.policy.next_action(self.q_values(obs))
+            obs2, reward, done = self.mdp.step(action)
+            total += reward
+            r = reward
+            if self.cfg.reward_clip:
+                r = float(np.clip(r, -self.cfg.reward_clip, self.cfg.reward_clip))
+            self.replay.store((obs, action, r, obs2, float(done)))
+            obs = obs2
+            self.total_steps += 1
+            if len(self.replay) >= self.cfg.start_size:
+                s, a, r_, s2, d = self.replay.sample()
+                self.net.params, self.net.opt_state, _ = self._td_step(
+                    self.net.params, self.target_params, self.net.opt_state,
+                    jnp.asarray(self.net.iteration_count, jnp.int32),
+                    jnp.asarray(s), jnp.asarray(a), jnp.asarray(r_),
+                    jnp.asarray(s2), jnp.asarray(d))
+                self.net.iteration_count += 1
+            if self.total_steps % self.cfg.target_update_freq == 0:
+                self.target_params = jax.tree.map(jnp.asarray, self.net.params)
+            if done:
+                break
+        self.episode_rewards.append(total)
+        return total
+
+    def train(self, episodes: int, max_steps: int = 200) -> List[float]:
+        return [self.train_episode(max_steps) for _ in range(episodes)]
+
+    def play(self, max_steps: int = 200) -> float:
+        """Greedy rollout (Policy.play)."""
+        policy = GreedyPolicy()
+        obs = self.mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done = self.mdp.step(policy.next_action(self.q_values(obs)))
+            total += r
+            if done:
+                break
+        return total
+
+
+# ---------------------------------------------------------------------------
+# Advantage actor-critic (the A3C-equivalent, synchronous)
+# ---------------------------------------------------------------------------
+
+
+class ActorCritic:
+    """Batched synchronous advantage actor-critic (A3CDiscrete-equivalent;
+    async hogwild replaced by batched sync updates — documented divergence)."""
+
+    def __init__(self, mdp: MDP, policy_net: MultiLayerNetwork,
+                 value_net: MultiLayerNetwork, gamma: float = 0.99,
+                 n_steps: int = 16, entropy_coef: float = 0.01, seed: int = 0):
+        self.mdp = mdp
+        self.policy_net = policy_net
+        self.value_net = value_net
+        self.gamma = gamma
+        self.n_steps = n_steps
+        self.entropy_coef = entropy_coef
+        self.rng = np.random.RandomState(seed)
+        self._step = self._make_step()
+        self.episode_rewards: List[float] = []
+        self._obs = None
+        self._ep_reward = 0.0
+
+    def _make_step(self):
+        pnet, vnet = self.policy_net, self.value_net
+        ent_c = self.entropy_coef
+
+        def step_fn(p_params, v_params, p_opt, v_opt, step, s, a, ret):
+            def v_loss(vp):
+                v = vnet._forward(vp, vnet.net_state, s, None, train=False, rng=None)[0][:, 0]
+                return jnp.mean((ret - v) ** 2)
+
+            v_l, v_grads = jax.value_and_grad(v_loss)(v_params)
+            v_now = vnet._forward(v_params, vnet.net_state, s, None,
+                                  train=False, rng=None)[0][:, 0]
+            adv = jax.lax.stop_gradient(ret - v_now)
+
+            def p_loss(pp):
+                probs = pnet._forward(pp, pnet.net_state, s, None, train=False, rng=None)[0]
+                logp = jnp.log(probs + 1e-8)
+                chosen = jnp.take_along_axis(logp, a[:, None], axis=1)[:, 0]
+                entropy = -jnp.sum(probs * logp, axis=1)
+                return -jnp.mean(chosen * adv + ent_c * entropy)
+
+            p_l, p_grads = jax.value_and_grad(p_loss)(p_params)
+            from deeplearning4j_tpu.nn.multilayer import apply_layer_updates
+
+            pu = apply_layer_updates(pnet.conf, zip(p_params, p_grads, p_opt,
+                                                    pnet.updaters, pnet.conf.layers),
+                                     step, pnet._normalize_gradient)
+            vu = apply_layer_updates(vnet.conf, zip(v_params, v_grads, v_opt,
+                                                    vnet.updaters, vnet.conf.layers),
+                                     step, vnet._normalize_gradient)
+            return ([p for p, _ in pu], [s_ for _, s_ in pu],
+                    [p for p, _ in vu], [s_ for _, s_ in vu], p_l + v_l)
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
+
+    def _action(self, obs) -> int:
+        probs = self.policy_net.output(obs[None].astype(np.float32))[0]
+        probs = np.clip(probs, 1e-8, 1.0)
+        probs = probs / probs.sum()
+        return int(self.rng.choice(len(probs), p=probs))
+
+    def train_steps(self, total_steps: int, max_episode_steps: int = 200) -> None:
+        if self._obs is None:
+            self._obs = self.mdp.reset()
+        steps_done = 0
+        ep_steps = 0
+        while steps_done < total_steps:
+            states, actions, rewards, cuts = [], [], [], []
+            for _ in range(self.n_steps):
+                a = self._action(self._obs)
+                obs2, r, done = self.mdp.step(a)
+                states.append(self._obs)
+                actions.append(a)
+                rewards.append(r)
+                self._ep_reward += r
+                self._obs = obs2
+                steps_done += 1
+                ep_steps += 1
+                truncated = ep_steps >= max_episode_steps
+                # a truncation reset must also CUT the return recurrence, or
+                # the new episode's rewards leak into the old one's targets
+                cuts.append(done or truncated)
+                if done or truncated:
+                    self.episode_rewards.append(self._ep_reward)
+                    self._ep_reward = 0.0
+                    ep_steps = 0
+                    self._obs = self.mdp.reset()
+            # n-step returns (bootstrap with V(s_T) unless the chain was cut)
+            v_last = float(self.value_net.output(
+                self._obs[None].astype(np.float32))[0, 0])
+            ret = v_last if not cuts[-1] else 0.0
+            returns = []
+            for r, c in zip(reversed(rewards), reversed(cuts)):
+                ret = r + self.gamma * ret * (1.0 - float(c))
+                returns.append(ret)
+            returns.reverse()
+            (self.policy_net.params, self.policy_net.opt_state,
+             self.value_net.params, self.value_net.opt_state, _) = self._step(
+                self.policy_net.params, self.value_net.params,
+                self.policy_net.opt_state, self.value_net.opt_state,
+                jnp.asarray(self.policy_net.iteration_count, jnp.int32),
+                jnp.asarray(np.stack(states).astype(np.float32)),
+                jnp.asarray(np.asarray(actions, np.int32)),
+                jnp.asarray(np.asarray(returns, np.float32)))
+            self.policy_net.iteration_count += 1

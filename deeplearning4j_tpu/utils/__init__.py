@@ -1,0 +1,14 @@
+"""Cross-cutting utilities — profiling/tracing + UI stats (SURVEY §6.1, §6.5)."""
+
+from deeplearning4j_tpu.utils.profiling import (
+    OpProfiler,
+    ChromeTraceWriter,
+    ProfilingListener,
+    ProfileAnalyzer,
+    device_trace,
+)
+from deeplearning4j_tpu.utils.stats import (
+    StatsStorage,
+    FileStatsStorage,
+    StatsListener,
+)

@@ -1,0 +1,168 @@
+"""Tracing / profiling — SURVEY §6.1 parity.
+
+Reference parity:
+  * ND4J OpProfiler (org/nd4j/linalg/profiler/OpProfiler.java): per-op-name
+    invocation counts + timings, NaN/Inf panic modes.
+  * SameDiff ProfilingListener (autodiff/listeners/profiler/): Chrome
+    trace-event JSON; ProfileAnalyzer diffs two traces.
+  * DL4J PerformanceListener: samples/sec + memory (in nn/listeners.py).
+
+TPU-native realization: ops fuse into one XLA program, so per-op WALL times
+don't exist at runtime — the op-level profile is collected at TRACE time
+(registry exec counts) and the runtime profile is per-STEP plus the jax
+profiler (XPlane, viewable in tensorboard) for intra-step breakdown.
+Chrome-trace JSON output is kept as the user-facing parity artifact.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+
+class OpProfiler:
+    """Op invocation counting — OpProfiler.java analog (trace-time).
+
+    Enable with ``OpProfiler.instance().start()``; the op registry reports
+    each exec. ``stats()`` pretty-prints counts like the reference's
+    printOutDashboard.
+    """
+
+    _instance: Optional["OpProfiler"] = None
+
+    def __init__(self):
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.times: Dict[str, float] = defaultdict(float)
+        self.enabled = False
+
+    @classmethod
+    def instance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = OpProfiler()
+        return cls._instance
+
+    def start(self):
+        self.enabled = True
+        return self
+
+    def stop(self):
+        self.enabled = False
+        return self
+
+    def reset(self):
+        self.counts.clear()
+        self.times.clear()
+
+    def record(self, op_name: str, seconds: float = 0.0):
+        if self.enabled:
+            self.counts[op_name] += 1
+            self.times[op_name] += seconds
+
+    def stats(self) -> str:
+        lines = ["Op profile (trace-time invocations):"]
+        for name, c in sorted(self.counts.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {name:<40} {c:>8}  {1000*self.times[name]:.2f} ms")
+        return "\n".join(lines)
+
+
+class ChromeTraceWriter:
+    """Chrome trace-event JSON accumulation (ProfilingListener's format)."""
+
+    def __init__(self):
+        self.events: List[Dict[str, Any]] = []
+        self._t0 = time.perf_counter()
+
+    def _us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, category: str = "step", **args):
+        start = self._us()
+        yield
+        self.events.append({
+            "name": name, "cat": category, "ph": "X", "ts": start,
+            "dur": self._us() - start, "pid": 0, "tid": 0,
+            "args": args,
+        })
+
+    def instant(self, name: str, **args):
+        self.events.append({"name": name, "cat": "marker", "ph": "i",
+                            "ts": self._us(), "pid": 0, "tid": 0, "s": "g",
+                            "args": args})
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events,
+                       "displayTimeUnit": "ms"}, f)
+
+
+class ProfilingListener:
+    """Per-iteration profiling → chrome trace (ProfilingListener.java).
+
+    Attach via net.set_listeners(ProfilingListener(out="trace.json")).
+    Records one complete-event per training iteration with the score; on
+    epoch end (or .close()) writes chrome://tracing-compatible JSON.
+    """
+
+    def __init__(self, output_path: str):
+        self.output_path = output_path
+        self.trace = ChromeTraceWriter()
+        self._iter_start: Optional[float] = None
+
+    def on_epoch_start(self, model):
+        self.trace.instant("epoch_start", epoch=getattr(model, "epoch_count", -1))
+
+    def iteration_done(self, model, iteration, epoch, score):
+        now = self.trace._us()
+        if self._iter_start is not None:
+            self.trace.events.append({
+                "name": f"iteration_{iteration}", "cat": "train_step", "ph": "X",
+                "ts": self._iter_start, "dur": now - self._iter_start,
+                "pid": 0, "tid": 0, "args": {"iteration": iteration}})
+        self._iter_start = now
+
+    def on_epoch_end(self, model):
+        self.trace.instant("epoch_end", epoch=getattr(model, "epoch_count", -1))
+        self.close()
+
+    def close(self):
+        self.trace.write(self.output_path)
+
+
+class ProfileAnalyzer:
+    """comparison/ProfileAnalyzer analog: aggregate + diff chrome traces."""
+
+    @staticmethod
+    def load(path: str) -> Dict[str, float]:
+        with open(path) as f:
+            data = json.load(f)
+        agg: Dict[str, float] = defaultdict(float)
+        for e in data.get("traceEvents", []):
+            if e.get("ph") == "X":
+                agg[e.get("cat", e["name"])] += e.get("dur", 0.0)
+        return dict(agg)
+
+    @staticmethod
+    def compare(path_a: str, path_b: str) -> Dict[str, Dict[str, float]]:
+        a, b = ProfileAnalyzer.load(path_a), ProfileAnalyzer.load(path_b)
+        out = {}
+        for k in set(a) | set(b):
+            out[k] = {"a_us": a.get(k, 0.0), "b_us": b.get(k, 0.0),
+                      "ratio": (a.get(k, 0.0) / b[k]) if b.get(k) else float("inf")}
+        return out
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """jax profiler (XPlane/tensorboard) wrapper — the intra-step breakdown
+    the reference gets from per-op native timers."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

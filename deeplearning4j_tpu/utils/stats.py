@@ -1,0 +1,157 @@
+"""Training UI stats pipeline — SURVEY §6.5 parity.
+
+Reference parity:
+  * deeplearning4j-ui-model StatsListener.java → StatsStorage (in-memory or
+    MapDB file) → VertxUIServer charts (score, param/update ratios,
+    histograms, system metrics); RemoteUIStatsStorageRouter posts over HTTP.
+
+TPU-native realization: StatsListener collects the same per-iteration
+quantities (score, per-layer param/gradient/update norms + mean-magnitude
+ratios — the signature dead-LR debugging chart); storage is in-memory or
+JSON-lines file. A tensorboard scalar writer rides alongside (tensorboardX
+role); the web server itself is out of scope (tensorboard covers it), but
+the listener→storage protocol is the parity surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class StatsStorage:
+    """In-memory StatsStorage.java analog."""
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+
+    def put(self, record: Dict[str, Any]) -> None:
+        self.records.append(record)
+
+    def session_scores(self) -> List[float]:
+        return [r["score"] for r in self.records if "score" in r]
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        return self.records[-1] if self.records else None
+
+
+class FileStatsStorage(StatsStorage):
+    """MapDB FileStatsStorage analog: JSON-lines file."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    if line.strip():
+                        self.records.append(json.loads(line))
+
+    def put(self, record):
+        super().put(record)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+class StatsListener:
+    """StatsListener.java analog: per-iteration stats into a StatsStorage."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 collect_histograms: bool = False):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.collect_histograms = collect_histograms
+        self._prev_params: Optional[List[Dict[str, np.ndarray]]] = None
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency != 0:
+            return
+        rec: Dict[str, Any] = {
+            "iteration": iteration, "epoch": epoch, "score": float(score),
+            "timestamp": time.time(),
+        }
+        params = model.params
+        layer_stats = {}
+        # params may be a list (MLN) or dict (ComputationGraph)
+        items = (enumerate(params) if isinstance(params, list)
+                 else params.items())
+        prev = self._prev_params
+        for key, p in items:
+            for pname, arr in _leaves(p):
+                a = np.asarray(arr)
+                name = f"{key}_{pname}"
+                st = {"mean_magnitude": float(np.abs(a).mean()),
+                      "norm2": float(np.linalg.norm(a))}
+                if prev is not None:
+                    prev_arr = _lookup(prev, key, pname)
+                    if prev_arr is not None and prev_arr.shape == a.shape:
+                        upd = a - prev_arr
+                        st["update_mean_magnitude"] = float(np.abs(upd).mean())
+                        # THE ratio chart: mean|update| / mean|param|
+                        st["update_ratio"] = float(
+                            np.abs(upd).mean() / max(np.abs(a).mean(), 1e-12))
+                if self.collect_histograms:
+                    hist, edges = np.histogram(a, bins=20)
+                    st["histogram"] = {"counts": hist.tolist(),
+                                       "edges": edges.tolist()}
+                layer_stats[name] = st
+        rec["layers"] = layer_stats
+        self.storage.put(rec)
+        self._prev_params = _snapshot(params)
+
+
+def _leaves(tree, prefix=""):
+    out = []
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out.extend(_leaves(v, f"{prefix}{k}/"))
+            else:
+                out.append((f"{prefix}{k}", v))
+    return out
+
+
+def _snapshot(params):
+    if isinstance(params, list):
+        return [{k: np.asarray(v).copy() for k, v in _leaves(p)} for p in params]
+    return {key: {k: np.asarray(v).copy() for k, v in _leaves(p)}
+            for key, p in params.items()}
+
+
+def _lookup(prev, key, pname):
+    try:
+        if isinstance(prev, list):
+            return prev[key].get(pname)
+        return prev[key].get(pname)
+    except (KeyError, IndexError, TypeError):
+        return None
+
+
+class TensorboardStatsWriter:
+    """Scalar export to tensorboard event files (rides on the in-env
+    tensorboard; the reference's UI-server charts equivalent view)."""
+
+    def __init__(self, log_dir: str):
+        from torch.utils.tensorboard import SummaryWriter  # torch-cpu in env
+
+        self.writer = SummaryWriter(log_dir)
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        self.writer.flush()
+
+    def iteration_done(self, model, iteration, epoch, score):
+        self.writer.add_scalar("score", float(score), iteration)

@@ -1,0 +1,59 @@
+// Native tests for the threshold codec (the reference's native-test role,
+// SURVEY §5.3 — layers_tests/*.cpp pattern, assert-based).
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+extern "C" {
+int64_t threshold_encode(const float*, int64_t, float, int32_t*, int64_t, float*);
+void threshold_decode(const int32_t*, int64_t, float, float*, int64_t);
+int64_t bitmap_encode(const float*, int64_t, float, uint8_t*, float*);
+void bitmap_decode(const uint8_t*, int64_t, float, float*);
+int32_t codec_abi_version();
+}
+
+static bool feq(float a, float b) { return std::fabs(a - b) < 1e-6f; }
+
+int main() {
+  assert(codec_abi_version() == 1);
+
+  // encode/decode round trip: decoded + residual == original
+  std::vector<float> g = {0.5f, -0.2f, 1.5f, -2.0f, 0.0f, 0.9f};
+  std::vector<int32_t> idx(16);
+  std::vector<float> residual(g.size());
+  int64_t n = threshold_encode(g.data(), g.size(), 1.0f, idx.data(), 16,
+                               residual.data());
+  assert(n == 2);  // 1.5 and -2.0
+  assert(idx[0] == 3 && idx[1] == -4);
+  std::vector<float> decoded(g.size(), 0.0f);
+  threshold_decode(idx.data(), n, 1.0f, decoded.data(), g.size());
+  for (size_t i = 0; i < g.size(); ++i) {
+    assert(feq(decoded[i] + residual[i], g[i]));
+  }
+
+  // capacity bound: first-N kept, rest left in residual
+  std::vector<float> big(100, 2.0f);
+  std::vector<int32_t> idx2(10);
+  std::vector<float> res2(big.size());
+  int64_t n2 = threshold_encode(big.data(), big.size(), 1.0f, idx2.data(), 10,
+                                res2.data());
+  assert(n2 == 10);
+  assert(feq(res2[0], 1.0f));   // encoded: residual reduced
+  assert(feq(res2[50], 2.0f));  // past capacity: untouched
+
+  // bitmap round trip
+  std::vector<uint8_t> bits((g.size() + 3) / 4, 0);
+  std::vector<float> res3(g.size());
+  int64_t nz = bitmap_encode(g.data(), g.size(), 1.0f, bits.data(), res3.data());
+  assert(nz == 2);
+  std::vector<float> dec3(g.size(), 0.0f);
+  bitmap_decode(bits.data(), g.size(), 1.0f, dec3.data());
+  for (size_t i = 0; i < g.size(); ++i) {
+    assert(feq(dec3[i] + res3[i], g[i]));
+  }
+
+  std::printf("codec_test OK\n");
+  return 0;
+}

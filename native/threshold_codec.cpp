@@ -1,0 +1,120 @@
+// Threshold gradient codec — native core of the DCN gradient compressor.
+//
+// Reference parity: libnd4j's threshold encoding ops
+// (ops/declarable/generic/compression/threshold.cpp and the bitmap variant),
+// used by EncodedGradientsAccumulator for Strom-2015-style sparse gradient
+// exchange. On-pod ICI all-reduce needs no compression (SURVEY §6.8); this
+// codec is the optional DCN-crossing compressor, and doing it in native code
+// keeps the host-side encode off the Python critical path.
+//
+// Format (matches deeplearning4j_tpu/ops/compression.py):
+//   encode: indices[int32] of |g| > threshold (capacity-bounded), values
+//           replaced by ±threshold sign; residual = g - decoded.
+//   bitmap: 2-bit stream: 00 skip, 01 +threshold, 10 -threshold.
+//
+// Build: cmake -S native -B native/build && cmake --build native/build
+// Exposed C ABI (ctypes-consumed, see deeplearning4j_tpu/native_ops/).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Encode: writes up to `capacity` indices of |g|>threshold into out_idx,
+// subtracts ±threshold from residual (callers pass residual=copy of g).
+// Returns the number of encoded entries.
+int64_t threshold_encode(const float* grad, int64_t n, float threshold,
+                         int32_t* out_idx, int64_t capacity, float* residual) {
+  // single serial pass: first-N capacity semantics match the reference's
+  // encoder; everything not encoded (incl. past-capacity entries) stays in
+  // the residual unchanged.
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    if (count < capacity && g > threshold) {
+      out_idx[count++] = static_cast<int32_t>(i + 1);  // +1: sign carries direction
+      residual[i] = g - threshold;
+    } else if (count < capacity && g < -threshold) {
+      out_idx[count++] = static_cast<int32_t>(-(i + 1));
+      residual[i] = g + threshold;
+    } else {
+      residual[i] = g;
+    }
+  }
+  return count;
+}
+
+// Decode: adds ±threshold at the encoded indices into `out` (size n).
+void threshold_decode(const int32_t* idx, int64_t count, float threshold,
+                      float* out, int64_t n) {
+#if defined(_OPENMP)
+#pragma omp parallel for
+#endif
+  for (int64_t k = 0; k < count; ++k) {
+    int32_t v = idx[k];
+    int64_t i = (v > 0 ? v : -v) - 1;
+    if (i >= 0 && i < n) {
+      out[i] += (v > 0 ? threshold : -threshold);
+    }
+  }
+}
+
+// Bitmap encode: 2 bits per element packed into uint8 (4 elements/byte).
+// Returns number of non-zero entries encoded.
+int64_t bitmap_encode(const float* grad, int64_t n, float threshold,
+                      uint8_t* out_bits, float* residual) {
+  std::atomic<int64_t> nz{0};
+#if defined(_OPENMP)
+#pragma omp parallel for
+#endif
+  for (int64_t b = 0; b < (n + 3) / 4; ++b) {
+    uint8_t byte = 0;
+    for (int64_t j = 0; j < 4; ++j) {
+      int64_t i = b * 4 + j;
+      if (i >= n) break;
+      float g = grad[i];
+      uint8_t code = 0;
+      if (g > threshold) {
+        code = 1;
+        residual[i] = g - threshold;
+        nz.fetch_add(1, std::memory_order_relaxed);
+      } else if (g < -threshold) {
+        code = 2;
+        residual[i] = g + threshold;
+        nz.fetch_add(1, std::memory_order_relaxed);
+      } else {
+        residual[i] = g;
+      }
+      byte |= (code << (2 * j));
+    }
+    out_bits[b] = byte;
+  }
+  return nz.load();
+}
+
+void bitmap_decode(const uint8_t* bits, int64_t n, float threshold, float* out) {
+#if defined(_OPENMP)
+#pragma omp parallel for
+#endif
+  for (int64_t b = 0; b < (n + 3) / 4; ++b) {
+    uint8_t byte = bits[b];
+    for (int64_t j = 0; j < 4; ++j) {
+      int64_t i = b * 4 + j;
+      if (i >= n) break;
+      uint8_t code = (byte >> (2 * j)) & 0x3;
+      if (code == 1) out[i] += threshold;
+      else if (code == 2) out[i] -= threshold;
+    }
+  }
+}
+
+// Version/capability probe for the binding layer.
+int32_t codec_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,224 @@
+"""DataVec transform DSL + EarlyStopping + TransferLearning tests
+(reference datavec-api transform tests, EarlyStoppingTrainer tests,
+TransferLearning tests — SURVEY §3.3/§3.4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec import (
+    Schema, TransformProcess, CSVRecordReader, ColumnCondition, Reducer,
+    LocalTransformExecutor, records_to_dataset,
+)
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    MaxScoreIterationTerminationCondition, InMemoryModelSaver, LocalFileModelSaver,
+)
+from deeplearning4j_tpu.nn.transfer import (
+    TransferLearning, FineTuneConfiguration, TransferLearningHelper,
+)
+
+
+CSV = """alice,25,engineer,50000
+bob,31,doctor,90000
+carol,17,student,0
+dave,45,engineer,80000
+"""
+
+
+def schema():
+    return (Schema.builder()
+            .add_column_string("name")
+            .add_column_integer("age")
+            .add_column_categorical("job", "engineer", "doctor", "student")
+            .add_column_double("salary")
+            .build())
+
+
+class TestTransformDSL:
+    def test_csv_read_typed(self):
+        records = CSVRecordReader(schema=schema()).read(CSV)
+        assert records[0] == ["alice", 25, "engineer", 50000.0]
+
+    def test_remove_rename_math(self):
+        tp = (TransformProcess.builder(schema())
+              .remove_columns("name")
+              .rename_column("salary", "pay")
+              .math_op("pay", "Divide", 1000.0)
+              .build())
+        recs = tp.execute(CSVRecordReader(schema=schema()).read(CSV))
+        assert tp.final_schema().names == ["age", "job", "pay"]
+        assert recs[1] == [31, "doctor", 90.0]
+
+    def test_categorical_to_one_hot(self):
+        tp = (TransformProcess.builder(schema())
+              .remove_columns("name")
+              .categorical_to_one_hot("job")
+              .build())
+        recs = tp.execute(CSVRecordReader(schema=schema()).read(CSV))
+        fs = tp.final_schema()
+        assert "job[engineer]" in fs.names
+        assert recs[0][fs.index_of("job[engineer]")] == 1
+        assert recs[1][fs.index_of("job[doctor]")] == 1
+
+    def test_filter_condition(self):
+        tp = (TransformProcess.builder(schema())
+              .filter(ColumnCondition("age", "LessThan", 18))
+              .build())
+        recs = tp.execute(CSVRecordReader(schema=schema()).read(CSV))
+        assert len(recs) == 3
+        assert all(r[1] >= 18 for r in recs)
+
+    def test_conditional_replace_and_boolean_conditions(self):
+        cond = (ColumnCondition("salary", "Equal", 0.0)
+                | ColumnCondition("age", "LessThan", 18))
+        tp = (TransformProcess.builder(schema())
+              .conditional_replace_value_transform("salary", 1000.0, cond)
+              .build())
+        recs = tp.execute(CSVRecordReader(schema=schema()).read(CSV))
+        assert recs[2][3] == 1000.0  # carol replaced
+
+    def test_math_function_and_string_ops(self):
+        tp = (TransformProcess.builder(schema())
+              .string_to_upper("name")
+              .math_function("salary", "SQRT")
+              .build())
+        recs = tp.execute(CSVRecordReader(schema=schema()).read(CSV))
+        assert recs[0][0] == "ALICE"
+        assert recs[1][3] == pytest.approx(300.0)
+
+    def test_reducer_group_by(self):
+        records = CSVRecordReader(schema=schema()).read(CSV)
+        red = Reducer(["job"], {"salary": "MEAN", "age": "COUNT"})
+        out, out_schema = red.reduce(records, schema())
+        by_job = {r[0]: r for r in out}
+        assert by_job["engineer"][1] == pytest.approx(65000.0)
+        assert by_job["engineer"][2] == 2
+
+    def test_records_to_dataset_and_train(self):
+        tp = (TransformProcess.builder(schema())
+              .remove_columns("name")
+              .categorical_to_integer("job")
+              .build())
+        recs = LocalTransformExecutor.execute(
+            CSVRecordReader(schema=schema()).read(CSV), tp)
+        ds = records_to_dataset(recs, tp.final_schema(), "job", num_classes=3)
+        assert ds.features.shape == (4, 2)
+        assert ds.labels.shape == (4, 3)
+
+
+def make_net(seed=1, lr=0.05):
+    return nn.MultiLayerNetwork(
+        nn.builder().seed(seed).updater(nn.Adam(learning_rate=lr)).list()
+        .layer(nn.DenseLayer(n_out=16, activation="tanh"))
+        .layer(nn.DenseLayer(n_out=8, activation="tanh"))
+        .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(nn.InputType.feed_forward(2)).build()
+    ).init()
+
+
+def xor():
+    rng = np.random.RandomState(0)
+    x = rng.rand(256, 2).astype(np.float32)
+    y_id = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(int)
+    return x, np.eye(2, dtype=np.float32)[y_id], y_id
+
+
+class TestEarlyStopping:
+    def test_max_epochs_stops(self):
+        x, y, _ = xor()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)])
+        trainer = EarlyStoppingTrainer(
+            cfg, make_net(), ListDataSetIterator(DataSet(x, y), batch_size=128),
+            ListDataSetIterator(DataSet(x, y), batch_size=128))
+        result = trainer.fit()
+        assert result.total_epochs == 3
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert result.best_epoch >= 0
+
+    def test_score_improvement_patience(self):
+        x, y, _ = xor()
+        # lr=0 → score never improves → patience triggers quickly
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[
+                ScoreImprovementEpochTerminationCondition(2),
+                MaxEpochsTerminationCondition(50)])
+        trainer = EarlyStoppingTrainer(
+            cfg, make_net(lr=0.0), ListDataSetIterator(DataSet(x, y), batch_size=256),
+            ListDataSetIterator(DataSet(x, y), batch_size=256))
+        result = trainer.fit()
+        assert result.total_epochs < 50
+
+    def test_divergence_guard(self):
+        x, y, _ = xor()
+        cfg = EarlyStoppingConfiguration(
+            iteration_termination_conditions=[MaxScoreIterationTerminationCondition(1e-9)],
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(10)])
+        trainer = EarlyStoppingTrainer(
+            cfg, make_net(), ListDataSetIterator(DataSet(x, y), batch_size=256))
+        result = trainer.fit()
+        assert result.termination_reason == "IterationTerminationCondition"
+
+    def test_best_model_restored(self, tmp_path):
+        x, y, _ = xor()
+        saver = LocalFileModelSaver(str(tmp_path))
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+            model_saver=saver)
+        trainer = EarlyStoppingTrainer(
+            cfg, make_net(), ListDataSetIterator(DataSet(x, y), batch_size=128),
+            ListDataSetIterator(DataSet(x, y), batch_size=128))
+        result = trainer.fit()
+        assert result.best_model is not None
+        assert (tmp_path / "bestModel.zip").exists()
+
+
+class TestTransferLearning:
+    def test_freeze_keeps_params_fixed(self):
+        x, y, _ = xor()
+        base = make_net()
+        base.fit(x, y, epochs=3, batch_size=128)
+        tl = (TransferLearning.builder(base)
+              .set_feature_extractor(0)  # freeze layer 0
+              .build())
+        frozen_before = np.asarray(tl.params[0]["W"]).copy()
+        head_before = np.asarray(tl.params[2]["W"]).copy()
+        tl.fit(x, y, epochs=3, batch_size=128)
+        np.testing.assert_allclose(np.asarray(tl.params[0]["W"]), frozen_before)
+        assert not np.allclose(np.asarray(tl.params[2]["W"]), head_before)
+
+    def test_replace_output_layer(self):
+        base = make_net()
+        tl = (TransferLearning.builder(base)
+              .fine_tune_configuration(FineTuneConfiguration(updater=nn.Sgd(learning_rate=0.1)))
+              .remove_output_layer()
+              .add_layer(nn.OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+              .build())
+        out = tl.output(np.zeros((2, 2), np.float32))
+        assert out.shape == (2, 5)
+        # kept layers share the source weights
+        np.testing.assert_allclose(np.asarray(tl.params[0]["W"]),
+                                   np.asarray(base.params[0]["W"]))
+
+    def test_n_out_replace(self):
+        base = make_net()
+        tl = (TransferLearning.builder(base)
+              .n_out_replace(1, 12)
+              .build())
+        assert tl.conf.layers[1].n_out == 12
+        assert tl.conf.layers[2].n_in == 12
+        assert tl.output(np.zeros((1, 2), np.float32)).shape == (1, 2)
+
+    def test_helper_featurize_train_head(self):
+        x, y, y_id = xor()
+        base = make_net()
+        helper = TransferLearningHelper(base, frozen_until=1)
+        feat = helper.featurize(DataSet(x, y))
+        assert feat.features.shape == (256, 8)
+        helper.fit_featurized(feat, epochs=30, batch_size=128)
+        # head trained; full net output reflects it
+        acc = (base.output(x).argmax(-1) == y_id).mean()
+        assert np.isfinite(acc)

@@ -1,0 +1,166 @@
+"""Word2Vec + RL tests (reference word2vec tests + rl4j QLearning tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.rl import (
+    MDP, EpsGreedy, BoltzmannPolicy, ExpReplay, QLearningConfiguration,
+    QLearningDiscrete, ActorCritic,
+)
+from deeplearning4j_tpu import nn
+
+
+def toy_corpus():
+    """Two topic clusters: numbers co-occur with numbers, animals with animals."""
+    rng = np.random.RandomState(0)
+    numbers = ["one", "two", "three", "four", "five"]
+    animals = ["cat", "dog", "bird", "fish", "horse"]
+    sents = []
+    for _ in range(300):
+        pool = numbers if rng.rand() < 0.5 else animals
+        sents.append([pool[rng.randint(5)] for _ in range(8)])
+    return sents
+
+
+class TestWord2Vec:
+    def test_vocab_and_vectors(self):
+        w2v = Word2Vec(layer_size=16, epochs=1, seed=1)
+        w2v.fit(toy_corpus())
+        assert w2v.vocab_size() == 10
+        assert w2v.get_word_vector("cat").shape == (16,)
+        assert w2v.get_word_vector("unknown-word") is None
+
+    def test_topic_clusters_learned(self):
+        w2v = Word2Vec(layer_size=32, epochs=5, learning_rate=0.05, seed=2,
+                       negative_samples=5)
+        hist = w2v.fit(toy_corpus())
+        assert hist[-1] < hist[0]
+        # within-cluster similarity beats cross-cluster
+        within = w2v.similarity("cat", "dog")
+        across = w2v.similarity("cat", "two")
+        assert within > across, (within, across)
+
+    def test_words_nearest(self):
+        w2v = Word2Vec(layer_size=32, epochs=5, learning_rate=0.05, seed=3)
+        w2v.fit(toy_corpus())
+        nearest = w2v.words_nearest("one", n=4)
+        animals = {"cat", "dog", "bird", "fish", "horse"}
+        # majority of nearest neighbours of a number are numbers
+        hits = sum(1 for w in nearest if w not in animals)
+        assert hits >= 3, nearest
+
+    def test_serde(self, tmp_path):
+        w2v = Word2Vec(layer_size=8, epochs=1, seed=4)
+        w2v.fit(toy_corpus())
+        p = str(tmp_path / "w2v.npz")
+        w2v.save(p)
+        w2 = Word2Vec.load(p)
+        np.testing.assert_allclose(w2.get_word_vector("cat"),
+                                   w2v.get_word_vector("cat"))
+
+
+class ChainMDP(MDP):
+    """5-state chain: action 1 moves right (+1 at the end), action 0 resets.
+    Optimal return from start = 1.0 reaching the end."""
+
+    def __init__(self, length=5):
+        self.length = length
+        self.pos = 0
+
+    def reset(self):
+        self.pos = 0
+        return self._obs()
+
+    def _obs(self):
+        o = np.zeros(self.length, np.float32)
+        o[self.pos] = 1.0
+        return o
+
+    def step(self, action):
+        if action == 1:
+            self.pos += 1
+            if self.pos >= self.length - 1:
+                return self._obs(), 1.0, True
+            return self._obs(), 0.0, False
+        self.pos = 0
+        return self._obs(), 0.01, False  # small distractor reward
+
+    @property
+    def num_actions(self):
+        return 2
+
+    @property
+    def obs_size(self):
+        return self.length
+
+
+def q_net(obs_size, n_actions, seed=0):
+    return nn.MultiLayerNetwork(
+        nn.builder().seed(seed).updater(nn.Adam(learning_rate=5e-3)).list()
+        .layer(nn.DenseLayer(n_out=32, activation="relu"))
+        .layer(nn.OutputLayer(n_out=n_actions, activation="identity", loss="mse"))
+        .set_input_type(nn.InputType.feed_forward(obs_size)).build()
+    ).init()
+
+
+class TestPolicies:
+    def test_eps_greedy_anneals(self):
+        p = EpsGreedy(eps_start=1.0, eps_min=0.1, anneal_steps=10)
+        assert p.epsilon() == 1.0
+        for _ in range(20):
+            p.next_action(np.array([0.0, 1.0]))
+        assert p.epsilon() == pytest.approx(0.1)
+
+    def test_boltzmann_prefers_high_q(self):
+        p = BoltzmannPolicy(temperature=0.1, seed=0)
+        picks = [p.next_action(np.array([0.0, 2.0])) for _ in range(100)]
+        assert np.mean(picks) > 0.9
+
+    def test_replay_buffer(self):
+        r = ExpReplay(max_size=5, batch_size=3, seed=0)
+        for i in range(10):
+            r.store((np.zeros(2), 0, float(i), np.zeros(2), False))
+        assert len(r) == 5
+        s, a, rew, s2, d = r.sample()
+        assert s.shape == (3, 2)
+
+
+class TestDQN:
+    def test_dqn_learns_chain(self):
+        mdp = ChainMDP()
+        net = q_net(mdp.obs_size, mdp.num_actions, seed=7)
+        dqn = QLearningDiscrete(mdp, net, QLearningConfiguration(
+            gamma=0.95, batch_size=32, target_update_freq=50, start_size=32,
+            eps_anneal_steps=300, seed=7))
+        dqn.train(episodes=60, max_steps=30)
+        score = dqn.play(max_steps=30)
+        assert score == pytest.approx(1.0), score  # reaches the goal greedily
+
+    def test_double_dqn_flag(self):
+        mdp = ChainMDP()
+        net = q_net(mdp.obs_size, mdp.num_actions)
+        dqn = QLearningDiscrete(mdp, net, QLearningConfiguration(
+            double_dqn=False, start_size=8, batch_size=8))
+        dqn.train(episodes=3, max_steps=10)
+        assert len(dqn.episode_rewards) == 3
+
+
+class TestActorCritic:
+    def test_ac_learns_chain(self):
+        mdp = ChainMDP()
+        pnet = nn.MultiLayerNetwork(
+            nn.builder().seed(3).updater(nn.Adam(learning_rate=5e-3)).list()
+            .layer(nn.DenseLayer(n_out=32, activation="relu"))
+            .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(mdp.obs_size)).build()).init()
+        vnet = nn.MultiLayerNetwork(
+            nn.builder().seed(4).updater(nn.Adam(learning_rate=5e-3)).list()
+            .layer(nn.DenseLayer(n_out=32, activation="relu"))
+            .layer(nn.OutputLayer(n_out=1, activation="identity", loss="mse"))
+            .set_input_type(nn.InputType.feed_forward(mdp.obs_size)).build()).init()
+        ac = ActorCritic(mdp, pnet, vnet, gamma=0.95, n_steps=16, seed=5)
+        ac.train_steps(3000, max_episode_steps=30)
+        # policy strongly prefers moving right at the start state
+        probs = pnet.output(mdp.reset()[None])[0]
+        assert probs[1] > 0.8, probs

@@ -1,0 +1,80 @@
+"""Flash-attention kernel tests — the two-backends-one-answer pattern
+(SURVEY §5.2): Pallas kernel vs the generic XLA attention oracle."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.pallas_attention import (
+    flash_attention, flash_mha, _reference_attention, register_platform_attention,
+)
+
+
+def rand_qkv(bh=4, t=64, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(bh, t, d).astype(np.float32)),
+            jnp.asarray(rng.randn(bh, t, d).astype(np.float32)),
+            jnp.asarray(rng.randn(bh, t, d).astype(np.float32)))
+
+
+class TestFlashAttention:
+    def test_matches_reference(self):
+        q, k, v = rand_qkv()
+        out = flash_attention(q, k, v, None, False, 16, 16, True)
+        ref = _reference_attention(q, k, v, scale=1.0 / np.sqrt(32), causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_causal_matches_reference(self):
+        q, k, v = rand_qkv(t=32)
+        out = flash_attention(q, k, v, None, True, 16, 16, True)
+        ref = _reference_attention(q, k, v, scale=1.0 / np.sqrt(32), causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_non_divisible_seq_len(self):
+        q, k, v = rand_qkv(t=50)  # not a multiple of block
+        out = flash_attention(q, k, v, None, False, 16, 16, True)
+        ref = _reference_attention(q, k, v, scale=1.0 / np.sqrt(32), causal=False)
+        # zero-padded keys contribute exp(s) mass — guard: compare unpadded
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_gradients_flow(self):
+        q, k, v = rand_qkv(bh=2, t=16, d=16)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, None, False, 8, 8, True) ** 2)
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(_reference_attention(
+                q, k, v, scale=1.0 / np.sqrt(16), causal=False) ** 2)
+
+        rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), rtol=1e-3, atol=1e-4)
+
+    def test_flash_mha_wrapper(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(2, 24, 32).astype(np.float32))
+        out = flash_mha(x, x, x, num_heads=4, interpret=True)
+        assert out.shape == (2, 24, 32)
+
+    def test_long_sequence_blocks(self):
+        q, k, v = rand_qkv(bh=1, t=256, d=16, seed=3)
+        out = flash_attention(q, k, v, None, False, 64, 64, True)
+        ref = _reference_attention(q, k, v, scale=1.0 / np.sqrt(16), causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_platform_registration(self):
+        from deeplearning4j_tpu.ops.registry import registry
+
+        register_platform_attention()
+        desc = registry().get("dot_product_attention")
+        assert "tpu" in desc.platform_impls

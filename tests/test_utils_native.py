@@ -1,0 +1,156 @@
+"""Profiling, stats-UI shim, and native codec tests (SURVEY §6.1, §6.5,
+§5.3 — OpProfiler/ProfilingListener/StatsListener + native-lib patterns)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.utils.profiling import (
+    OpProfiler, ChromeTraceWriter, ProfilingListener, ProfileAnalyzer,
+)
+from deeplearning4j_tpu.utils.stats import (
+    StatsStorage, FileStatsStorage, StatsListener,
+)
+from deeplearning4j_tpu import native_ops
+
+
+def xor():
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 2).astype(np.float32)
+    y_id = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(int)
+    return x, np.eye(2, dtype=np.float32)[y_id]
+
+
+def small_net():
+    return nn.MultiLayerNetwork(
+        nn.builder().seed(1).updater(nn.Adam(learning_rate=0.02)).list()
+        .layer(nn.DenseLayer(n_out=8, activation="tanh"))
+        .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(nn.InputType.feed_forward(2)).build()
+    ).init()
+
+
+class TestProfiling:
+    def test_op_profiler_counts(self):
+        p = OpProfiler.instance()
+        p.reset()
+        p.start()
+        p.record("conv2d", 0.001)
+        p.record("conv2d", 0.002)
+        p.record("matmul")
+        p.stop()
+        assert p.counts["conv2d"] == 2
+        assert "conv2d" in p.stats()
+
+    def test_chrome_trace_writer(self, tmp_path):
+        w = ChromeTraceWriter()
+        with w.span("step1", iteration=1):
+            pass
+        w.instant("epoch_end")
+        path = str(tmp_path / "trace.json")
+        w.write(path)
+        data = json.load(open(path))
+        assert len(data["traceEvents"]) == 2
+        assert data["traceEvents"][0]["ph"] == "X"
+
+    def test_profiling_listener_writes_trace(self, tmp_path):
+        x, y = xor()
+        net = small_net()
+        path = str(tmp_path / "train_trace.json")
+        net.set_listeners(ProfilingListener(path))
+        net.fit(x, y, epochs=1, batch_size=32)
+        data = json.load(open(path))
+        steps = [e for e in data["traceEvents"] if e.get("cat") == "train_step"]
+        assert len(steps) == 3  # 4 batches → 3 complete inter-iteration spans
+
+    def test_profile_analyzer_compare(self, tmp_path):
+        a, b = ChromeTraceWriter(), ChromeTraceWriter()
+        with a.span("x", category="step"):
+            pass
+        with b.span("x", category="step"):
+            pass
+        pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        a.write(pa)
+        b.write(pb)
+        cmp = ProfileAnalyzer.compare(pa, pb)
+        assert "step" in cmp and "ratio" in cmp["step"]
+
+
+class TestStatsListener:
+    def test_collects_scores_and_ratios(self):
+        x, y = xor()
+        net = small_net()
+        storage = StatsStorage()
+        net.set_listeners(StatsListener(storage))
+        net.fit(x, y, epochs=2, batch_size=64)
+        assert len(storage.session_scores()) == 4
+        latest = storage.latest()
+        key = "0_W"
+        assert key in latest["layers"]
+        assert "update_ratio" in latest["layers"][key]  # the dead-LR chart
+        assert latest["layers"][key]["update_ratio"] > 0
+
+    def test_file_storage_round_trip(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        s = FileStatsStorage(path)
+        s.put({"score": 1.0, "iteration": 1})
+        s2 = FileStatsStorage(path)
+        assert s2.session_scores() == [1.0]
+
+    def test_histograms(self):
+        x, y = xor()
+        net = small_net()
+        storage = StatsStorage()
+        net.set_listeners(StatsListener(storage, collect_histograms=True))
+        net.fit(x, y, epochs=1, batch_size=128)
+        assert "histogram" in storage.latest()["layers"]["0_W"]
+
+
+class TestNativeCodec:
+    def test_native_lib_builds(self):
+        assert native_ops.native_available(), "cmake build of native codec failed"
+
+    def test_encode_decode_round_trip(self):
+        g = np.array([0.5, -0.2, 1.5, -2.0, 0.0, 0.9], np.float32)
+        idx, residual = native_ops.threshold_encode(g, 1.0)
+        assert list(idx) == [3, -4]
+        decoded = native_ops.threshold_decode(idx, 1.0, g.size)
+        np.testing.assert_allclose(decoded + residual, g, rtol=1e-6)
+
+    def test_capacity_bound(self):
+        g = np.full(100, 2.0, np.float32)
+        idx, residual = native_ops.threshold_encode(g, 1.0, capacity=10)
+        assert idx.size == 10
+        assert residual[0] == pytest.approx(1.0)
+        assert residual[50] == pytest.approx(2.0)  # untouched past capacity
+
+    def test_bitmap_round_trip(self):
+        g = np.array([0.5, -1.5, 2.5, 0.0], np.float32)
+        bits, residual, nz = native_ops.bitmap_encode(g, 1.0)
+        assert nz == 2
+        decoded = native_ops.bitmap_decode(bits, 1.0, g.size)
+        np.testing.assert_allclose(decoded + residual, g, rtol=1e-6)
+
+    def test_compression_ratio_semantics(self):
+        """Sparse gradient → few indices: the Strom-2015 bandwidth win."""
+        rng = np.random.RandomState(0)
+        g = np.zeros(10000, np.float32)
+        hot = rng.choice(10000, 50, replace=False)
+        g[hot] = rng.randn(50) * 10
+        idx, _ = native_ops.threshold_encode(g, 1.0)
+        assert idx.size <= 50
+        assert idx.size >= 40
+
+    def test_matches_python_fallback(self):
+        from deeplearning4j_tpu.native_ops.threshold import _py_encode
+
+        rng = np.random.RandomState(1)
+        g = rng.randn(512).astype(np.float32)
+        idx_n, res_n = native_ops.threshold_encode(g, 0.8)
+        idx_p, res_p = _py_encode(g.copy(), 0.8, 512)
+        np.testing.assert_array_equal(idx_n, idx_p)
+        np.testing.assert_allclose(res_n, res_p, rtol=1e-6)
