@@ -731,7 +731,8 @@ class SameDiff:
             iterator = ListDataSetIterator(iterator, batch_size=32)
 
         history = []
-        for _ in range(epochs):
+        listeners = getattr(self, "_listeners", [])
+        for ep in range(epochs):
             losses = []
             for ds in iterator:
                 feeds = {}
@@ -749,8 +750,54 @@ class SameDiff:
                 self._arrays.update(new_vars)
                 self._step += 1
                 losses.append(loss)
+                for lst in listeners:
+                    lst.iteration_done(self, self._step, ep, loss)
             history.append(float(jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))))
         return history
+
+    # ---------------------------------------------------------- control flow
+    def scan(self, fn, init, xs_var: "SDVariable") -> "SDVariable":
+        """Recorded lax.scan over axis 0 of xs (the TF-frames / Enter-Exit
+        control-flow analog — SURVEY §4.3 maps frames to lax loops).
+
+        fn: (carry, x_slice) -> (new_carry, y_slice), built from jnp ops
+        (traced at execution time, NOT recorded node-by-node)."""
+        name = self._fresh("scan")
+
+        def scan_op(xs, init_val=init):
+            carry, ys = jax.lax.scan(fn, init_val, xs)
+            return ys
+
+        GRAPH_OPS[name + "_impl"] = scan_op
+        return self._record(name + "_impl", [xs_var])
+
+    def while_loop(self, cond_fn, body_fn, init_var: "SDVariable") -> "SDVariable":
+        """Recorded lax.while_loop (TF While-frame analog)."""
+        name = self._fresh("while")
+
+        def while_op(x):
+            return jax.lax.while_loop(cond_fn, body_fn, x)
+
+        GRAPH_OPS[name + "_impl"] = while_op
+        return self._record(name + "_impl", [init_var])
+
+    def cond(self, pred_var: "SDVariable", true_fn, false_fn,
+             operand: "SDVariable") -> "SDVariable":
+        """Recorded lax.cond (TF Switch/Merge analog)."""
+        name = self._fresh("cond")
+
+        def cond_op(pred, x):
+            return jax.lax.cond(pred.astype(bool).reshape(()), true_fn, false_fn, x)
+
+        GRAPH_OPS[name + "_impl"] = cond_op
+        return self._record(name + "_impl", [pred_var, operand])
+
+    # --------------------------------------------------------------- listeners
+    def set_listeners(self, *listeners) -> None:
+        """SameDiff listener family (autodiff/listeners/** — ScoreListener,
+        HistoryListener, CheckpointListener). Listeners receive
+        iteration_done(self, iteration, epoch, loss) during fit()."""
+        self._listeners = list(listeners)
 
     # ------------------------------------------------------------------ serde
     def to_dict(self) -> Dict[str, Any]:

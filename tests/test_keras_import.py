@@ -1,0 +1,111 @@
+"""Keras import golden-file tests — the reference modelimport pattern
+(SURVEY §5.4): build with in-env keras, import, compare outputs elementwise."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.imports.keras_import import (
+    import_keras_model, import_keras_sequential_model_and_weights,
+)
+
+
+def assert_outputs_match(model, net, x, rtol=1e-4, atol=1e-5):
+    golden = model(x, training=False).numpy()
+    got = net.output(x)
+    np.testing.assert_allclose(got, golden, rtol=rtol, atol=atol)
+
+
+class TestKerasImport:
+    def test_mlp(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(12, activation="relu"),
+            tf.keras.layers.Dense(3, activation="softmax"),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_cnn_with_flatten(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((12, 12, 3)),
+            tf.keras.layers.Conv2D(8, 3, activation="relu", padding="same"),
+            tf.keras.layers.MaxPooling2D(2),
+            tf.keras.layers.Conv2D(4, 3, activation="relu"),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(5, activation="softmax"),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(1).rand(2, 12, 12, 3).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_batchnorm_inference(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((5,)),
+            tf.keras.layers.Dense(8),
+            tf.keras.layers.BatchNormalization(),
+            tf.keras.layers.Activation("relu"),
+            tf.keras.layers.Dense(2, activation="softmax"),
+        ])
+        # train briefly so BN stats are non-trivial
+        model.compile(optimizer="sgd", loss="categorical_crossentropy")
+        rng = np.random.RandomState(2)
+        model.fit(rng.randn(64, 5).astype(np.float32),
+                  np.eye(2, dtype=np.float32)[rng.randint(0, 2, 64)],
+                  epochs=1, verbose=0)
+        net = import_keras_model(model)
+        x = rng.randn(4, 5).astype(np.float32)
+        assert_outputs_match(model, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_dropout_imported_as_eval_identity(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((4,)),
+            tf.keras.layers.Dense(6, activation="tanh"),
+            tf.keras.layers.Dropout(0.5),
+            tf.keras.layers.Dense(2, activation="softmax"),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(3).randn(3, 4).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_lstm(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((7, 4)),
+            tf.keras.layers.LSTM(6, return_sequences=True),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(4).randn(2, 7, 4).astype(np.float32)
+        assert_outputs_match(model, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_global_average_pooling(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((8, 8, 2)),
+            tf.keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(3, activation="softmax"),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(5).rand(2, 8, 8, 2).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_h5_file_round_trip(self, tmp_path):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(4, activation="relu"),
+            tf.keras.layers.Dense(2, activation="softmax"),
+        ])
+        path = str(tmp_path / "model.keras")
+        model.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        x = np.random.RandomState(6).randn(3, 6).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_unsupported_layer_raises(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 4)),
+            tf.keras.layers.Conv1D(2, 2),
+        ])
+        with pytest.raises(NotImplementedError, match="Conv1D"):
+            import_keras_model(model)
