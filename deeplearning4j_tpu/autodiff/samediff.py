@@ -291,7 +291,13 @@ def _huber(pred, labels, delta):
     return jnp.mean(0.5 * quad**2 + delta * (err - quad))
 
 
-def resolve_graph_op(name: str) -> Callable[..., Any]:
+def resolve_graph_op(name: str, local_ops: Optional[Dict[str, Callable]] = None
+                     ) -> Callable[..., Any]:
+    """Resolve an op name: instance-local control-flow impls first (so two
+    SameDiff instances with the same counter names never collide), then the
+    global catalog, then the declarable-op registry."""
+    if local_ops and name in local_ops:
+        return local_ops[name]
     if name in GRAPH_OPS:
         return GRAPH_OPS[name]
     reg = op_registry()
@@ -499,6 +505,9 @@ class SameDiff:
         self._vars: Dict[str, SDVariable] = {}
         self._arrays: Dict[str, jnp.ndarray] = {}  # VARIABLE + CONSTANT values
         self._nodes: List[_Node] = []
+        # instance-local op impls (control-flow closures from scan/while/cond);
+        # kept off the module-global GRAPH_OPS so instances cannot collide
+        self._local_ops: Dict[str, Callable[..., Any]] = {}
         self._name_counter = 0
         self.math = SDMath(self)
         self.nn = SDNN(self)
@@ -578,7 +587,7 @@ class SameDiff:
     # -------------------------------------------------------------- recording
     def _record(self, op: str, inputs: List[SDVariable],
                 kwargs: Optional[Dict[str, Any]] = None, n_out: int = 1):
-        resolve_graph_op(op)  # fail fast on unknown op
+        resolve_graph_op(op, self._local_ops)  # fail fast on unknown op
         out_names = [self._fresh(op) for _ in range(n_out)]
         self._nodes.append(_Node(op, [v.name for v in inputs], dict(kwargs or {}), out_names))
         outs = []
@@ -610,7 +619,7 @@ class SameDiff:
                 raise KeyError(
                     f"op '{node.op}' needs {missing}; placeholders not fed or "
                     f"graph out of order")
-            fn = resolve_graph_op(node.op)
+            fn = resolve_graph_op(node.op, self._local_ops)
             res = fn(*[env[i] for i in node.inputs], **node.kwargs)
             if len(node.outputs) == 1:
                 env[node.outputs[0]] = res
@@ -768,7 +777,7 @@ class SameDiff:
             carry, ys = jax.lax.scan(fn, init_val, xs)
             return ys
 
-        GRAPH_OPS[name + "_impl"] = scan_op
+        self._local_ops[name + "_impl"] = scan_op
         return self._record(name + "_impl", [xs_var])
 
     def while_loop(self, cond_fn, body_fn, init_var: "SDVariable") -> "SDVariable":
@@ -778,7 +787,7 @@ class SameDiff:
         def while_op(x):
             return jax.lax.while_loop(cond_fn, body_fn, x)
 
-        GRAPH_OPS[name + "_impl"] = while_op
+        self._local_ops[name + "_impl"] = while_op
         return self._record(name + "_impl", [init_var])
 
     def cond(self, pred_var: "SDVariable", true_fn, false_fn,
@@ -789,7 +798,7 @@ class SameDiff:
         def cond_op(pred, x):
             return jax.lax.cond(pred.astype(bool).reshape(()), true_fn, false_fn, x)
 
-        GRAPH_OPS[name + "_impl"] = cond_op
+        self._local_ops[name + "_impl"] = cond_op
         return self._record(name + "_impl", [pred_var, operand])
 
     # --------------------------------------------------------------- listeners
@@ -818,9 +827,18 @@ class SameDiff:
 
     def save(self, path: str, save_updater_state: bool = False) -> None:
         """sd.save(file) — zip of graph JSON + variable arrays
-        (FlatBuffers-file analog)."""
+        (FlatBuffers-file analog). Persists the training step so a resumed
+        fit() keeps Adam bias-correction and LR schedules aligned (matches
+        nn/serde.py's meta.json iteration_count)."""
+        unsaveable = sorted({n.op for n in self._nodes if n.op in self._local_ops})
+        if unsaveable:
+            raise ValueError(
+                "graph contains control-flow ops whose Python closures cannot "
+                f"be serialized: {unsaveable}; rebuild the graph after load() "
+                "or express the loop body as recorded ops")
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
             z.writestr("graph.json", json.dumps(self.to_dict(), indent=2))
+            z.writestr("meta.json", json.dumps({"step": self._step}))
             import io
 
             buf = io.BytesIO()
@@ -854,6 +872,8 @@ class SameDiff:
                 sd._nodes.append(_Node(nd["op"], list(nd["inputs"]),
                                        dict(nd["kwargs"]), list(nd["outputs"])))
             sd._name_counter = d.get("name_counter", len(sd._vars))
+            if "meta.json" in z.namelist():
+                sd._step = int(json.loads(z.read("meta.json").decode()).get("step", 0))
             if "updater.npz" in z.namelist():
                 upd = np.load(io.BytesIO(z.read("updater.npz")))
                 state: Dict[str, Dict[str, Any]] = {}

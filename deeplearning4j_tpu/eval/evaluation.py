@@ -44,6 +44,17 @@ class Evaluation:
                 m = np.ones(labels.shape[0] * labels.shape[1], dtype=bool)
             labels = labels.reshape(-1, labels.shape[-1])[m]
             predictions = predictions.reshape(-1, predictions.shape[-1])[m]
+        elif mask is not None:
+            # per-example mask on (N, C) input — reference drops masked rows
+            m = np.asarray(mask)
+            if m.size != labels.shape[0]:
+                raise ValueError(
+                    f"per-output masks are not supported by Evaluation "
+                    f"(mask shape {m.shape} vs {labels.shape[0]} examples); "
+                    "use EvaluationBinary for per-output masking")
+            m = m.astype(bool).reshape(-1)
+            labels = labels[m]
+            predictions = predictions[m]
         self._ensure(labels.shape[-1])
         actual = labels.argmax(-1)
         pred = predictions.argmax(-1)

@@ -33,6 +33,7 @@ from deeplearning4j_tpu.nn.conf import (
     RnnOutputLayer,
     LastTimeStep,
     SelfAttentionLayer,
+    dl4j_drop_out,
 )
 from deeplearning4j_tpu.nn.updater import (
     Sgd, Adam, AdaMax, Nadam, AmsGrad, AdaGrad, AdaDelta, RmsProp, Nesterovs,

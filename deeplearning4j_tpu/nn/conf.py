@@ -93,6 +93,17 @@ def _pair(v) -> Tuple[int, int]:
     return (int(v), int(v))
 
 
+def dl4j_drop_out(retain_prob: float) -> float:
+    """Convert the reference's ``dropOut(x)`` retain-probability argument
+    (conf/layers/Layer.java — x = probability an activation is KEPT) to this
+    framework's ``dropout`` drop rate. dropOut(0.8) → dropout=0.2."""
+    if retain_prob == 0.0:
+        return 0.0  # reference sentinel: dropOut(0.0) means dropout disabled
+    if not 0.0 < retain_prob <= 1.0:
+        raise ValueError(f"retain probability must be in [0, 1], got {retain_prob}")
+    return 1.0 - retain_prob
+
+
 @dataclasses.dataclass(frozen=True)
 class LayerConf:
     """Base layer config (conf/layers/Layer.java analog).
@@ -107,7 +118,10 @@ class LayerConf:
     l1: Optional[float] = None
     l2: Optional[float] = None
     weight_decay: Optional[float] = None
-    dropout: Optional[float] = None  # retain-prob semantics NOT used; this is drop rate
+    # DROP RATE (fraction zeroed), NOT the reference's dropOut(x) retain
+    # probability. Porting a DL4J config? Use dl4j_drop_out(retain_prob) to
+    # convert — dropOut(0.8) in the reference means keep-80%, i.e. dropout=0.2.
+    dropout: Optional[float] = None
     updater: Optional[Any] = None
 
     # --- overridden by subclasses ---

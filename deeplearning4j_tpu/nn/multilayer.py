@@ -356,10 +356,11 @@ class MultiLayerNetwork:
                 f"{ds.labels.shape} — use standard backprop for per-sequence labels")
         T = ds.features.shape[1]
         rnn_states = self._zero_rnn_states(ds.features.shape[0])
-        for t0 in range(0, T, fwd):
+        segments = list(range(0, T, fwd))
+        for i, t0 in enumerate(segments):
             t1 = min(t0 + fwd, T)
             seg_x = jnp.asarray(ds.features[:, t0:t1])
-            seg_y = jnp.asarray(ds.labels[:, t0:t1]) if ds.labels.ndim >= 3 else jnp.asarray(ds.labels)
+            seg_y = jnp.asarray(ds.labels[:, t0:t1])
             seg_fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask[:, t0:t1])
             seg_lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask[:, t0:t1])
             self._key, sub = jax.random.split(self._key)
@@ -367,6 +368,11 @@ class MultiLayerNetwork:
                 self.params, self.opt_state, self.net_state, rnn_states,
                 jnp.asarray(self.iteration_count, jnp.int32), sub,
                 seg_x, seg_y, seg_fm, seg_lm)
+            # the reference advances the iteration once per optimize call, i.e.
+            # per tBPTT segment (Adam bias-correction t, LR schedules); fit()
+            # adds the final +1 covering the last segment
+            if i < len(segments) - 1:
+                self.iteration_count += 1
         return loss
 
     def _normalize_gradient(self, g):
