@@ -6,9 +6,10 @@ under the driver). The reference published no numbers
 (``BASELINE.json.published == {}``), so ``vs_baseline`` ratchets against the
 last recorded value in BENCH_HISTORY.json (1.0 on first run).
 
-Env knobs: BENCH_BATCH (default 64), BENCH_ITERS (default 20),
+Env knobs: BENCH_BATCH (default 128), BENCH_ITERS (default 20),
 BENCH_MODEL (resnet50 | lenet), BENCH_IMAGE (default 224; resnet50 only —
-LeNet is fixed 28×28 MNIST).
+LeNet is fixed 28×28 MNIST), BENCH_DTYPE (default "mixed": bf16 compute /
+f32 params — the TPU-native policy; "float32" for the f32 baseline).
 """
 
 from __future__ import annotations
@@ -20,7 +21,7 @@ import time
 import numpy as np
 
 
-def _bench_resnet50(batch: int, iters: int, image: int):
+def _bench_resnet50(batch: int, iters: int, image: int, dtype: str):
     import jax
     import jax.numpy as jnp
 
@@ -28,31 +29,53 @@ def _bench_resnet50(batch: int, iters: int, image: int):
     from deeplearning4j_tpu.datasets.image import synthetic_image_batch
 
     net = models.ResNet50(num_classes=1000, input_shape=(image, image, 3),
-                          updater=nn.Nesterovs(learning_rate=0.1, momentum=0.9)).init()
+                          updater=nn.Nesterovs(learning_rate=0.1, momentum=0.9),
+                          dtype=dtype).init()
     imgs, labels = synthetic_image_batch(batch, image, image, 3, 1000, seed=0)
     y = np.zeros((batch, 1000), np.float32)
     y[np.arange(batch), labels] = 1.0
     x = jnp.asarray(imgs)
     yj = jnp.asarray(y)
-    in_name = net.conf.network_inputs[0]
-    out_name = net.conf.network_outputs[0]
 
-    step_fn = net._make_train_step()
-    params, opt_state, net_state = net.params, net.opt_state, net.net_state
-    key = jax.random.key(0)
-
-    def one(i, p, o, s):
-        return step_fn(p, o, s, jnp.asarray(i, jnp.int32), key,
-                       {in_name: x}, {out_name: yj}, None, None)
-
-    params, opt_state, net_state, loss = one(0, params, opt_state, net_state)
-    loss.block_until_ready()  # compile + warmup
+    # fused multi-step loop: lax.scan over the whole train step — zero host
+    # dispatch between iterations (fit_scanned). Warm up with the SAME step
+    # count so the timed call reuses the compiled executable.
+    losses = net.fit_scanned(x, yj, steps=iters)
+    assert np.isfinite(losses[-1])
     t0 = time.perf_counter()
-    for i in range(1, iters + 1):
-        params, opt_state, net_state, loss = one(i, params, opt_state, net_state)
-    loss.block_until_ready()
+    losses = net.fit_scanned(x, yj, steps=iters)
     dt = time.perf_counter() - t0
+    assert np.isfinite(losses[-1])
     return batch * iters / dt, "resnet50_imagenet_train_images_per_sec"
+
+
+def _bench_bert(batch: int, iters: int, dtype: str):
+    """BERT-base MLM train step, seq 512 — the attention-bound workload where
+    the Pallas flash platform helper carries the win (BENCH_MODEL=bert)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.bert import BertConfig, BertModel
+
+    seq = int(os.environ.get("BENCH_SEQ", "512"))
+    cfg = BertConfig.base(dropout=0.0)  # prob-dropout off → flash helper fires
+    model = BertModel(cfg, seed=0,
+                      dtype=jnp.bfloat16 if dtype != "float32" else jnp.float32)
+    rng = np.random.RandomState(0)
+    batch_data = {
+        "ids": rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+        "segments": np.zeros((batch, seq), np.int32),
+        "mask": (rng.rand(batch, seq) > 0.1).astype(np.int32),
+        "mlm_labels": rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+        "mlm_mask": (rng.rand(batch, seq) < 0.15).astype(np.float32),
+    }
+    losses = model.fit_mlm_scanned(batch_data, iters)  # compile + warmup
+    assert np.isfinite(losses[-1])
+    t0 = time.perf_counter()
+    losses = model.fit_mlm_scanned(batch_data, iters)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(losses[-1])
+    return batch * seq * iters / dt, "bert_base_mlm_train_tokens_per_sec"
 
 
 def _bench_lenet(batch: int, iters: int):
@@ -68,33 +91,29 @@ def _bench_lenet(batch: int, iters: int):
     y[np.arange(batch), labels] = 1.0
     x = jnp.asarray(feats)
     yj = jnp.asarray(y)
-    step_fn = net._make_train_step()
-    params, opt_state, net_state = net.params, net.opt_state, net.net_state
-    key = jax.random.key(0)
-
-    def one(i, p, o, s):
-        return step_fn(p, o, s, jnp.asarray(i, jnp.int32), key, x, yj, None, None)
-
-    params, opt_state, net_state, loss = one(0, params, opt_state, net_state)
-    loss.block_until_ready()
+    losses = net.fit_scanned(x, yj, steps=iters)
+    assert np.isfinite(losses[-1])
     t0 = time.perf_counter()
-    for i in range(1, iters + 1):
-        params, opt_state, net_state, loss = one(i, params, opt_state, net_state)
-    loss.block_until_ready()
+    losses = net.fit_scanned(x, yj, steps=iters)
     dt = time.perf_counter() - t0
+    assert np.isfinite(losses[-1])
     return batch * iters / dt, "lenet5_mnist_train_images_per_sec"
 
 
 def main() -> None:
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     model = os.environ.get("BENCH_MODEL", "resnet50")
+    dtype = os.environ.get("BENCH_DTYPE", "mixed")
 
     if model == "lenet":
         value, metric = _bench_lenet(batch, iters)
+    elif model == "bert":
+        value, metric = _bench_bert(int(os.environ.get("BENCH_BERT_BATCH", "16")),
+                                    iters, dtype)
     else:
-        value, metric = _bench_resnet50(batch, iters, image)
+        value, metric = _bench_resnet50(batch, iters, image, dtype)
 
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.json")
     hist = {}

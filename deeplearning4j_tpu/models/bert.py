@@ -117,13 +117,21 @@ def _attention(p, x, attn_mask, cfg: BertConfig, *, train, rng):
     q = split(x @ p["Wq"] + p["bq"])
     k = split(x @ p["Wk"] + p["bk"])
     v = split(x @ p["Wv"] + p["bv"])
+    if not (train and cfg.dropout > 0 and rng is not None):
+        # no attention-prob dropout → route through the op registry so the
+        # Pallas flash platform helper fires on TPU (cuDNN-helper analog)
+        from deeplearning4j_tpu.ops import exec_op
+
+        m = None if attn_mask is None else attn_mask[:, None, None, :]
+        out = exec_op("dot_product_attention", q, k, v, m, scaled=True)
+        out = out.transpose(0, 2, 1, 3).reshape(n, t, d)
+        return out @ p["Wo"] + p["bo"]
     scores = (q @ jnp.swapaxes(k, -1, -2)) / jnp.sqrt(jnp.asarray(dh, x.dtype))
     if attn_mask is not None:
         scores = jnp.where(attn_mask[:, None, None, :] > 0, scores, -1e9)
     attn = jax.nn.softmax(scores, axis=-1)
-    if train and cfg.dropout > 0 and rng is not None:
-        keep = jax.random.bernoulli(rng, 1 - cfg.dropout, attn.shape)
-        attn = jnp.where(keep, attn / (1 - cfg.dropout), 0.0)
+    keep = jax.random.bernoulli(rng, 1 - cfg.dropout, attn.shape)
+    attn = jnp.where(keep, attn / (1 - cfg.dropout), 0.0)
     out = (attn @ v).transpose(0, 2, 1, 3).reshape(n, t, d)
     return out @ p["Wo"] + p["bo"]
 
@@ -203,7 +211,7 @@ class BertModel:
             new_p, new_s = [], []
             for pw, gw, sw in zip(flat_p, flat_g, flat_s):
                 u, ns = upd.apply(gw, sw, lr, step)
-                new_p.append(pw - u)
+                new_p.append((pw - u).astype(pw.dtype))
                 new_s.append(ns)
             return treedef.unflatten(new_p), treedef.unflatten(new_s), loss
 
@@ -245,7 +253,7 @@ class BertModel:
             new_p, new_s = [], []
             for pw, gw, sw in zip(flat_p, flat_g, flat_s):
                 u, ns = upd.apply(gw, sw, lr, step)
-                new_p.append(pw - u)
+                new_p.append((pw - u).astype(pw.dtype))
                 new_s.append(ns)
             return treedef.unflatten(new_p), treedef.unflatten(new_s), loss
 
@@ -267,6 +275,39 @@ class BertModel:
                 losses.append(loss)
             history.append(float(jnp.mean(jnp.stack(losses))))
         return history
+
+    def fit_mlm_scanned(self, batch: Dict[str, Any], steps: int) -> np.ndarray:
+        """``steps`` fused MLM train steps in ONE XLA call (lax.scan over the
+        step; see MultiLayerNetwork.fit_scanned) on a fixed device-resident
+        batch. Returns per-step losses."""
+        import functools
+
+        step_fn = self._jit.setdefault("mlm", self._mlm_step())
+        key = ("mlm_scanned", steps)
+        many = self._jit.get(key)
+        if many is None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def many(params, opt_state, start, rng, ids, segments, mask,
+                     mlm_labels, mlm_mask):
+                def body(carry, i):
+                    p, o = carry
+                    p, o, loss = step_fn(p, o, i, jax.random.fold_in(rng, i),
+                                         ids, segments, mask, mlm_labels, mlm_mask)
+                    return (p, o), loss
+                (p, o), losses = jax.lax.scan(
+                    body, (params, opt_state),
+                    start + jnp.arange(steps, dtype=jnp.int32))
+                return p, o, losses
+
+            self._jit[key] = many
+        self._key, sub = jax.random.split(self._key)
+        self.params, self.opt_state, losses = many(
+            self.params, self.opt_state, jnp.asarray(self.step, jnp.int32), sub,
+            jnp.asarray(batch["ids"]), jnp.asarray(batch["segments"]),
+            jnp.asarray(batch["mask"]), jnp.asarray(batch["mlm_labels"]),
+            jnp.asarray(batch["mlm_mask"]))
+        self.step += steps
+        return np.asarray(losses)
 
     # -------------------------------------------------------------- inference
     def predict(self, ids, segments=None, mask=None) -> np.ndarray:

@@ -180,11 +180,13 @@ class ResNet50(ZooModel):
     """
 
     def __init__(self, num_classes: int = 1000, seed: int = 123, updater=None,
-                 input_shape: Tuple[int, int, int] = (224, 224, 3)):
+                 input_shape: Tuple[int, int, int] = (224, 224, 3),
+                 dtype: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.updater = updater or nn.Nesterovs(learning_rate=1e-1, momentum=0.9)
         self.input_shape = input_shape
+        self.dtype = dtype
 
     def _bottleneck(self, b: GraphBuilder, name: str, inp: str, filters: int,
                     stride: int, project: bool) -> str:
@@ -218,7 +220,7 @@ class ResNet50(ZooModel):
     def init(self) -> ComputationGraph:
         h, w, c = self.input_shape
         b = (graph_builder().seed(self.seed).updater(self.updater)
-             .weight_init("relu")
+             .weight_init("relu").dtype(self.dtype)
              .add_inputs("input")
              .set_input_types(input=nn.InputType.convolutional(h, w, c)))
         b.add_layer("conv1", nn.ConvolutionLayer(

@@ -1,0 +1,56 @@
+"""Dtype policy — mixed precision the TPU way.
+
+Reference parity: the reference's global data-type switch
+(org.nd4j.linalg.api.buffer.DataType + NeuralNetConfiguration.dataType),
+which flips every buffer to FLOAT/HALF/DOUBLE. On TPU the profitable policy
+is finer: keep parameters, optimizer state, and loss math in float32 while
+running layer compute (conv/matmul activations) in bfloat16 so the MXU gets
+bf16 operands and HBM traffic halves — the jmp/flax "mixed_bfloat16" recipe.
+
+Policies (MultiLayerConfiguration.dtype / GraphBuilder.dtype):
+  * "float32" / "float64"  — everything in one dtype (reference semantics)
+  * "bfloat16" / "float16" — params AND compute in the low dtype
+  * "mixed" (alias "mixed_bfloat16") — f32 params/updater/loss, bf16 compute
+
+Casting happens at ONE chokepoint per network (the top of ``_forward``), so
+gradients flow through the cast back to the f32 master weights — the
+standard master-weights scheme, without a loss-scale knob because bf16
+shares float32's exponent range (unlike fp16, no underflow cliff).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+_MIXED = ("mixed", "mixed_bfloat16")
+
+
+def param_dtype(policy: str) -> jnp.dtype:
+    """Storage dtype for parameters/optimizer state under the policy."""
+    if policy in _MIXED:
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(policy)
+
+
+def compute_dtype(policy: str) -> jnp.dtype:
+    """Dtype layer compute runs in under the policy."""
+    if policy in _MIXED:
+        return jnp.dtype(jnp.bfloat16)
+    return jnp.dtype(policy)
+
+
+def needs_cast(policy: str) -> bool:
+    return policy in _MIXED
+
+
+def cast_floats(tree: Any, dtype) -> Any:
+    """Cast every inexact-dtype leaf to ``dtype``; ints/bools untouched."""
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree.map(cast, tree)

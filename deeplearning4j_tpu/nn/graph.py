@@ -483,6 +483,14 @@ class ComputationGraph:
     # --------------------------------------------------------------- forward
     def _forward(self, params, net_state, inputs: Dict[str, Any], masks,
                  *, train: bool, rng):
+        from deeplearning4j_tpu.nn import dtype as DT
+
+        if DT.needs_cast(self.conf.dtype):
+            # mixed policy: bf16 compute against f32 master params — ONE cast
+            # chokepoint so grads flow back to the f32 masters
+            cd = DT.compute_dtype(self.conf.dtype)
+            params = DT.cast_floats(params, cd)
+            inputs = DT.cast_floats(inputs, cd)
         acts: Dict[str, Any] = dict(inputs)
         act_masks: Dict[str, Any] = dict(masks or {})
         new_state: Dict[str, Any] = {}
@@ -508,6 +516,9 @@ class ComputationGraph:
                 acts[node.name] = y
                 act_masks[node.name] = m2
                 new_state[node.name] = st
+        if DT.needs_cast(self.conf.dtype):
+            for o in self.conf.network_outputs:  # loss/eval math stays f32
+                acts[o] = DT.cast_floats(acts[o], jnp.float32)
         return acts, new_state
 
     def output(self, *inputs, masks=None) -> List[np.ndarray]:
@@ -605,6 +616,55 @@ class ComputationGraph:
             self.epoch_count += 1
             for lst in self.listeners:
                 lst.on_epoch_end(self)
+
+    def fit_scanned(self, features, labels, steps: Optional[int] = None) -> np.ndarray:
+        """Many fused train steps in ONE XLA call — lax.scan over the train
+        step with donated carry (see MultiLayerNetwork.fit_scanned; same two
+        modes). ``features``/``labels``: single-input/-output arrays, or
+        dicts keyed by input/output name for multi-IO graphs."""
+        import functools
+
+        step_fn = self._jit_cache.get("train_step")
+        if step_fn is None:
+            step_fn = self._make_train_step()
+            self._jit_cache["train_step"] = step_fn
+        if not isinstance(features, dict):
+            features = {self.conf.network_inputs[0]: features}
+        if not isinstance(labels, dict):
+            labels = {self.conf.network_outputs[0]: labels}
+        feeds = {k: jnp.asarray(v) for k, v in features.items()}
+        labs = {k: jnp.asarray(v) for k, v in labels.items()}
+        per_step_data = steps is None
+        n_steps = (int(next(iter(feeds.values())).shape[0]) if per_step_data
+                   else int(steps))
+
+        cache_key = ("fit_scanned", per_step_data, n_steps)
+        many = self._jit_cache.get(cache_key)
+        if many is None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+            def many(params, opt_state, net_state, start, key, feeds, labs):
+                def body(carry, it):
+                    p, o, s = carry
+                    if per_step_data:
+                        i, f, y = it
+                    else:
+                        i, f, y = it, feeds, labs
+                    p, o, s, loss = step_fn(p, o, s, i, jax.random.fold_in(key, i),
+                                            f, y, None, None)
+                    return (p, o, s), loss
+                idx = start + jnp.arange(n_steps, dtype=jnp.int32)
+                sc = (idx, feeds, labs) if per_step_data else idx
+                (p, o, s), losses = jax.lax.scan(body, (params, opt_state, net_state), sc)
+                return p, o, s, losses
+
+            self._jit_cache[cache_key] = many
+        self._key, sub = jax.random.split(self._key)
+        self.params, self.opt_state, self.net_state, losses = many(
+            self.params, self.opt_state, self.net_state,
+            jnp.asarray(self.iteration_count, jnp.int32), sub, feeds, labs)
+        self.iteration_count += n_steps
+        self._score = losses[-1]
+        return np.asarray(losses)
 
     def score(self) -> float:
         return float(getattr(self, "_score", float("nan")))

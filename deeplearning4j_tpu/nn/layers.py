@@ -44,7 +44,9 @@ class Layer:
         self.otype = lc.output_type(itype)
         self.activation = get_activation(net_conf.layer_activation(lc))
         self.winit = net_conf.layer_weight_init(lc)
-        self.dtype = jnp.dtype(net_conf.dtype)
+        from deeplearning4j_tpu.nn.dtype import param_dtype
+
+        self.dtype = param_dtype(net_conf.dtype)
 
     # -- override points ----------------------------------------------------
     def init(self, key) -> Params:

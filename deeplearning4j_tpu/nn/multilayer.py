@@ -189,6 +189,16 @@ class MultiLayerNetwork:
         non-recurrent layers), (out, new_net_state, new_rnn_states): the
         tBPTT / rnnTimeStep state-threading path
         (rnnActivateUsingStoredState in the reference)."""
+        from deeplearning4j_tpu.nn import dtype as DT
+
+        if DT.needs_cast(self.conf.dtype):
+            # mixed policy: bf16 compute against f32 master params — ONE cast
+            # chokepoint so grads flow back to the f32 masters
+            cd = DT.compute_dtype(self.conf.dtype)
+            params = DT.cast_floats(params, cd)
+            x = DT.cast_floats(x, cd)
+            if rnn_states is not None:
+                rnn_states = DT.cast_floats(rnn_states, cd)
         new_state = []
         new_rnn = [] if rnn_states is not None else None
         rngs = jax.random.split(rng, max(len(self.layers), 1)) if rng is not None else [None] * len(self.layers)
@@ -206,6 +216,8 @@ class MultiLayerNetwork:
                 new_state.append(st)
                 if new_rnn is not None:
                     new_rnn.append(None)
+        if DT.needs_cast(self.conf.dtype):
+            x = DT.cast_floats(x, jnp.float32)  # loss/eval math stays f32
         if rnn_states is not None:
             return x, new_state, new_rnn
         return x, new_state
@@ -444,6 +456,59 @@ class MultiLayerNetwork:
             self.epoch_count += 1
             for lst in self.listeners:
                 lst.on_epoch_end(self)
+
+    def fit_scanned(self, features, labels, steps: Optional[int] = None) -> np.ndarray:
+        """Run many fused train steps in ONE XLA call (lax.scan over the
+        train step) — the TPU-native inner loop: zero host dispatch between
+        steps, donated carry, schedules/iteration advancing on-device.
+
+        Two modes:
+          * ``steps`` given — train repeatedly on the single device-resident
+            batch (throughput/benchmark mode).
+          * ``steps`` None — ``features``/``labels`` carry a leading
+            [steps, batch, ...] axis of per-step minibatches (the
+            device-resident-epoch pattern: stage the epoch to HBM once, scan).
+
+        Masks are not supported on this path (use fit()). Returns the
+        per-step loss array. Reference analog: there is none — the per-op
+        JNI dispatch makes a fused multi-step loop impossible there; this is
+        the whole-graph-compile dividend (SURVEY §8.1)."""
+        step_fn = self._jit_cache.get("train_step")
+        if step_fn is None:
+            step_fn = self._make_train_step()
+            self._jit_cache["train_step"] = step_fn
+        per_step_data = steps is None
+        xs = jnp.asarray(features)
+        ys = jnp.asarray(labels)
+        n_steps = int(xs.shape[0]) if per_step_data else int(steps)
+
+        cache_key = ("fit_scanned", per_step_data, n_steps)
+        many = self._jit_cache.get(cache_key)
+        if many is None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+            def many(params, opt_state, net_state, start, key, xs, ys):
+                def body(carry, it):
+                    p, o, s = carry
+                    if per_step_data:
+                        i, x, y = it
+                    else:
+                        i, x, y = it, xs, ys
+                    p, o, s, loss = step_fn(p, o, s, i, jax.random.fold_in(key, i),
+                                            x, y, None, None)
+                    return (p, o, s), loss
+                idx = start + jnp.arange(n_steps, dtype=jnp.int32)
+                sc_xs = (idx, xs, ys) if per_step_data else idx
+                (p, o, s), losses = jax.lax.scan(body, (params, opt_state, net_state), sc_xs)
+                return p, o, s, losses
+
+            self._jit_cache[cache_key] = many
+        self._key, sub = jax.random.split(self._key)
+        self.params, self.opt_state, self.net_state, losses = many(
+            self.params, self.opt_state, self.net_state,
+            jnp.asarray(self.iteration_count, jnp.int32), sub, xs, ys)
+        self.iteration_count += n_steps
+        self._score = losses[-1]
+        return np.asarray(losses)
 
     def score(self, ds: Optional[DataSet] = None) -> float:
         """Loss on a dataset, or last training score (MultiLayerNetwork.score)."""

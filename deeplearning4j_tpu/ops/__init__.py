@@ -11,6 +11,13 @@ from deeplearning4j_tpu.ops.activations import get_activation, ACTIVATIONS
 from deeplearning4j_tpu.ops.losses import get_loss, LOSSES
 from deeplearning4j_tpu.ops.weight_init import init_weights
 
+# Install the Pallas platform helpers (the cuDNN-helper-registration analog:
+# the reference registers platform overrides at library load — libnd4j
+# OpRegistrator static init). Deferred import keeps pallas optional.
+from deeplearning4j_tpu.ops.pallas_attention import register_platform_attention
+
+register_platform_attention()
+
 __all__ = [
     "registry", "op", "exec_op", "OpRegistry",
     "nn_ops", "activations", "losses", "random", "compression", "weight_init",

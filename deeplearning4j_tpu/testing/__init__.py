@@ -1,0 +1,3 @@
+"""Testing utilities — cross-backend consistency (SURVEY §5.2)."""
+
+from deeplearning4j_tpu.testing.consistency import run_all as run_consistency

@@ -1,0 +1,206 @@
+"""CPU-vs-TPU consistency suite — SURVEY §5.2's "single most reusable test
+idea": the reference cross-checks cuDNN helpers against built-in CPU impls
+(CuDNNGradientChecks, ValidateCuDNN); here every case runs on the CPU
+backend (the de-facto reference implementation) and on the TPU chip, and the
+results must agree at bf16-MXU-aware tolerances.
+
+Run standalone (`python -m deeplearning4j_tpu.testing.consistency`) on a
+host with a TPU attached, or via tests/test_tpu_consistency.py (which spawns
+this in a subprocess so the unit suite's CPU pin doesn't apply).
+
+Both backends live in one process: JAX registers cpu alongside the TPU
+plugin, and ``jax.default_device`` scopes each run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Case:
+    name: str
+    make: Callable[[], Any]  # () -> (fn, args); fn pure, jit-able
+    rtol: float = 2e-2  # bf16 MXU default
+    atol: float = 1e-2
+    grad: bool = False  # also compare jax.grad wrt float args
+
+
+def _cases() -> List[Case]:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import nn_ops, losses as loss_lib, exec_op
+    from deeplearning4j_tpu.ops.activations import get_activation
+
+    r = np.random.RandomState(0)
+
+    def arr(*shape):
+        return jnp.asarray(r.randn(*shape).astype(np.float32))
+
+    cases: List[Case] = []
+
+    def add(name, fn, *args, rtol=2e-2, atol=1e-2, grad=False):
+        cases.append(Case(name, lambda fn=fn, args=args: (fn, args),
+                          rtol=rtol, atol=atol, grad=grad))
+
+    x4 = arr(4, 16, 16, 8)
+    w = arr(3, 3, 8, 16)
+    add("conv2d", lambda x, w: nn_ops.conv2d.fn(x, w, stride=1, padding="same"),
+        x4, w, grad=True)
+    add("conv2d_strided", lambda x, w: nn_ops.conv2d.fn(x, w, stride=2,
+                                                        padding="valid"), x4, w)
+    add("depthwise_conv2d",
+        lambda x, w: nn_ops.depthwise_conv2d.fn(x, w), x4, arr(3, 3, 8, 1))
+    add("deconv2d", lambda x, w: nn_ops.deconv2d.fn(x, w, stride=2),
+        arr(2, 8, 8, 4), arr(2, 2, 4, 8))
+    add("maxpool2d", lambda x: nn_ops.maxpool2d.fn(x, kernel=2, stride=2), x4,
+        grad=True)
+    add("avgpool2d", lambda x: nn_ops.avgpool2d.fn(x, kernel=2, stride=2), x4)
+    add("batchnorm_infer",
+        lambda x, m, v, g, b: nn_ops.batchnorm.fn(x, m, v, g, b),
+        x4, arr(8), jnp.abs(arr(8)) + 0.5, arr(8), arr(8))
+    add("batchnorm_train",
+        lambda x, g, b: nn_ops.batch_norm_train(
+            x, g, b, jnp.zeros(8), jnp.ones(8), axis=(0, 1, 2))[0],
+        x4, arr(8), arr(8), grad=True)
+    add("layer_norm", lambda x, g, b: nn_ops.layer_norm.fn(x, g, b),
+        arr(4, 32), arr(32), arr(32), grad=True)
+    add("lrn", lambda x: nn_ops.local_response_normalization.fn(x), x4)
+    add("dense_gelu", lambda x, w, b: get_activation("gelu")(x @ w + b),
+        arr(8, 32), arr(32, 16), arr(16), grad=True)
+    add("lstm_cell", lambda x, h, c, wi, wh, b: nn_ops.lstm_cell.fn(
+        x, h, c, wi, wh, b)[0],
+        arr(4, 8), arr(4, 16), arr(4, 16), arr(8, 64), arr(16, 64), arr(64),
+        grad=True)
+    add("gru_cell", lambda x, h, wi, wh, bi, bh: nn_ops.gru_cell.fn(
+        x, h, wi, wh, bi, bh),
+        arr(4, 8), arr(4, 16), arr(8, 48), arr(16, 48), arr(48), arr(48))
+    add("softmax", lambda x: jax.nn.softmax(x, axis=-1), arr(8, 64))
+    add("log_softmax", lambda x: jax.nn.log_softmax(x, axis=-1), arr(8, 64))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[r.randint(0, 10, 8)])
+    add("mcxent", lambda p, y: loss_lib.softmax_cross_entropy_with_logits(p, y),
+        arr(8, 10), y, grad=True)
+    add("mse", lambda p, y: loss_lib.mse(p, y), arr(8, 10), arr(8, 10))
+    add("sigmoid_xent",
+        lambda p, y: loss_lib.sigmoid_cross_entropy_with_logits(p, y),
+        arr(8, 10), jnp.abs(y))
+    add("matmul_big", lambda a, b: a @ b, arr(64, 128), arr(128, 64), grad=True)
+    add("erf", lambda x: jax.lax.erf(x), arr(4, 64))
+    add("tanh", lambda x: jnp.tanh(x), arr(4, 64))
+    add("attention_generic",
+        lambda q, k, v: exec_op("dot_product_attention", q, k, v),
+        arr(4, 32, 16), arr(4, 32, 16), arr(4, 32, 16), grad=True)
+    add("reduce_stats", lambda x: jnp.stack([jnp.mean(x), jnp.var(x),
+                                             jnp.max(x), jnp.min(x)]),
+        arr(32, 32))
+    add("cumsum", lambda x: jnp.cumsum(x, axis=1), arr(8, 32))
+
+    # Pallas flash vs itself across backends (interpret on CPU, Mosaic on TPU)
+    from deeplearning4j_tpu.ops.pallas_attention import flash_attention
+
+    add("flash_attention",
+        lambda q, k, v: flash_attention(q, k, v, None, None, True, 64, 64, None),
+        arr(4, 128, 32), arr(4, 128, 32), arr(4, 128, 32), grad=True)
+
+    # full-layer forward: LeNet-sized conv net output
+    def lenet_fwd():
+        from deeplearning4j_tpu import models
+
+        net = models.LeNet(num_classes=10).init()
+
+        def fn(x):
+            return net._forward(net.params, net.net_state, x, None,
+                                train=False, rng=None)[0]
+
+        return fn, (jnp.asarray(r.rand(4, 784).astype(np.float32)),)
+
+    cases.append(Case("lenet_forward", lenet_fwd, rtol=2e-2, atol=1e-2))
+    return cases
+
+
+def _run_case(case: Case, cpu_dev, tpu_dev) -> List[str]:
+    import jax
+    import jax.numpy as jnp
+
+    failures: List[str] = []
+    fn, args = case.make()
+
+    def run_on(dev, f, args):
+        with jax.default_device(dev):
+            args_d = jax.tree.map(lambda a: jax.device_put(a, dev), args)
+            return jax.tree.map(np.asarray, jax.jit(f)(*args_d))
+
+    ref = run_on(cpu_dev, fn, args)
+    got = run_on(tpu_dev, fn, args)
+    try:
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            a, b, rtol=case.rtol, atol=case.atol), ref, got)
+    except AssertionError as e:
+        failures.append(f"{case.name}: FORWARD mismatch: {str(e)[:300]}")
+
+    if case.grad:
+        float_idx = tuple(i for i, a in enumerate(args)
+                          if hasattr(a, "dtype") and
+                          jnp.issubdtype(a.dtype, jnp.inexact))
+
+        def scalar(f):
+            def g(*a):
+                out = f(*a)
+                leaves = jax.tree.leaves(out)
+                return sum(jnp.sum(jnp.cos(l.astype(jnp.float32))) for l in leaves)
+            return g
+
+        gfn = jax.grad(scalar(fn), argnums=float_idx)
+        gref = run_on(cpu_dev, gfn, args)
+        ggot = run_on(tpu_dev, gfn, args)
+        try:
+            jax.tree.map(lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=max(case.rtol, 3e-2), atol=max(case.atol, 2e-2)),
+                gref, ggot)
+        except AssertionError as e:
+            failures.append(f"{case.name}: GRADIENT mismatch: {str(e)[:300]}")
+    return failures
+
+
+def run_all(verbose: bool = True) -> Dict[str, Any]:
+    """Run every case on CPU and TPU; returns a summary dict and raises
+    AssertionError listing all mismatches if any case disagrees."""
+    import jax
+
+    tpu_devs = [d for d in jax.devices() if d.platform == "tpu"]
+    if not tpu_devs:
+        raise RuntimeError("no TPU device visible — consistency suite needs "
+                           "the real chip (run without the CPU test pin)")
+    cpu_devs = jax.devices("cpu")
+    cpu_dev, tpu_dev = cpu_devs[0], tpu_devs[0]
+
+    cases = _cases()
+    failures: List[str] = []
+    passed = 0
+    for case in cases:
+        errs = _run_case(case, cpu_dev, tpu_dev)
+        if errs:
+            failures.extend(errs)
+            if verbose:
+                print(f"  FAIL {case.name}")
+        else:
+            passed += 1
+            if verbose:
+                print(f"  ok   {case.name}" + ("  (+grad)" if case.grad else ""))
+    summary = {"cases": len(cases), "passed": passed, "failed": len(failures)}
+    if verbose:
+        print(f"consistency: {passed}/{len(cases)} cases agree CPU-vs-TPU")
+    if failures:
+        raise AssertionError("CPU-vs-TPU mismatches:\n" + "\n".join(failures))
+    return summary
+
+
+if __name__ == "__main__":
+    import json
+
+    s = run_all()
+    print(json.dumps(s))

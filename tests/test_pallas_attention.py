@@ -22,21 +22,21 @@ def rand_qkv(bh=4, t=64, d=32, seed=0):
 class TestFlashAttention:
     def test_matches_reference(self):
         q, k, v = rand_qkv()
-        out = flash_attention(q, k, v, None, False, 16, 16, True)
+        out = flash_attention(q, k, v, None, None, False, 16, 16, True)
         ref = _reference_attention(q, k, v, scale=1.0 / np.sqrt(32), causal=False)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
 
     def test_causal_matches_reference(self):
         q, k, v = rand_qkv(t=32)
-        out = flash_attention(q, k, v, None, True, 16, 16, True)
+        out = flash_attention(q, k, v, None, None, True, 16, 16, True)
         ref = _reference_attention(q, k, v, scale=1.0 / np.sqrt(32), causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
 
     def test_non_divisible_seq_len(self):
         q, k, v = rand_qkv(t=50)  # not a multiple of block
-        out = flash_attention(q, k, v, None, False, 16, 16, True)
+        out = flash_attention(q, k, v, None, None, False, 16, 16, True)
         ref = _reference_attention(q, k, v, scale=1.0 / np.sqrt(32), causal=False)
         # zero-padded keys contribute exp(s) mass — guard: compare unpadded
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -46,7 +46,7 @@ class TestFlashAttention:
         q, k, v = rand_qkv(bh=2, t=16, d=16)
 
         def loss(q, k, v):
-            return jnp.sum(flash_attention(q, k, v, None, False, 8, 8, True) ** 2)
+            return jnp.sum(flash_attention(q, k, v, None, None, False, 8, 8, True) ** 2)
 
         gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
@@ -67,7 +67,7 @@ class TestFlashAttention:
 
     def test_long_sequence_blocks(self):
         q, k, v = rand_qkv(bh=1, t=256, d=16, seed=3)
-        out = flash_attention(q, k, v, None, False, 64, 64, True)
+        out = flash_attention(q, k, v, None, None, False, 64, 64, True)
         ref = _reference_attention(q, k, v, scale=1.0 / np.sqrt(16), causal=False)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
@@ -78,3 +78,34 @@ class TestFlashAttention:
         register_platform_attention()
         desc = registry().get("dot_product_attention")
         assert "tpu" in desc.platform_impls
+
+
+    def test_key_padding_mask_matches_reference(self):
+        q, k, v = rand_qkv(bh=3, t=40, d=16, seed=5)
+        rng = np.random.RandomState(7)
+        mask = jnp.asarray((rng.rand(3, 40) > 0.3).astype(np.float32))
+        out = flash_attention(q, k, v, mask, None, False, 16, 16, True)
+        ref = _reference_attention(q, k, v, scale=1.0 / np.sqrt(16),
+                                   causal=False, kv_mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_masked_gradients_match_reference(self):
+        q, k, v = rand_qkv(bh=2, t=24, d=16, seed=9)
+        mask = jnp.asarray((np.arange(24)[None, :] < np.array([[20], [16]]))
+                           .astype(np.float32))
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, mask, None, False, 8, 8,
+                                           True) ** 2)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(_reference_attention(
+                q, k, v, scale=1.0 / np.sqrt(16), causal=False,
+                kv_mask=mask) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        r = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)

@@ -1,0 +1,36 @@
+"""CPU-vs-TPU consistency gate (SURVEY §5.2 — the ValidateCuDNN analog).
+
+The unit suite pins the CPU backend (conftest), so the cross-backend run
+happens in a SUBPROCESS with a clean environment where the ambient TPU
+plugin loads; skipped when no TPU is reachable."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _tpu_available() -> bool:
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=120, env=env)
+        return r.stdout.strip().endswith("tpu")
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="no TPU device reachable")
+def test_cpu_vs_tpu_consistency():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    r = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.testing.consistency"],
+        capture_output=True, text=True, timeout=1800, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, (
+        f"consistency suite failed:\n{r.stdout[-3000:]}\n{r.stderr[-3000:]}")
