@@ -244,10 +244,12 @@ def global_max_pool(x):
 def batchnorm(x, mean, var, gamma=None, beta=None, *, eps: float = 1e-5):
     """Normalize with given statistics (inference form of reference batchnorm).
 
-    Dtype-stable under mixed precision: the scale/shift are folded in float32
-    and cast to x.dtype, so a bfloat16 activation stream stays bfloat16 while
-    the statistics math keeps f32 accuracy."""
-    f32 = jnp.float32
+    Dtype-stable under mixed precision: the scale/shift are folded in (at
+    least) float32 and cast to x.dtype, so a bfloat16 activation stream stays
+    bfloat16 while the statistics math keeps f32 accuracy. Under x64 (gradient
+    checks) the stats stay f64 — a hard f32 cast would quantize the
+    finite-difference perturbations of the parameters."""
+    f32 = jnp.promote_types(x.dtype, jnp.float32)
     scale = lax.rsqrt(var.astype(f32) + eps)
     if gamma is not None:
         scale = scale * gamma.astype(f32)
@@ -273,13 +275,13 @@ def _bn_core(x, gamma, beta, eps):
 
 
 def _bn_fwd_math(x, gamma, beta, eps):
-    f32 = jnp.float32
+    f32 = jnp.promote_types(x.dtype, jnp.float32)
     axes = tuple(range(x.ndim - 1))
     xf = x.astype(f32)
-    # one-pass statistics: E[x] and E[x²] fuse into a single read of x
+    # two-pass statistics: E[(x-E[x])²] — the one-pass E[x²]−E[x]² form is
+    # catastrophic-cancellation-prone in f32 and broke gradient checks
     mean = jnp.mean(xf, axis=axes)
-    m2 = jnp.mean(xf * xf, axis=axes)
-    var = jnp.maximum(m2 - mean * mean, 0.0)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes)
     inv = lax.rsqrt(var + eps)
     scale = inv if gamma is None else inv * gamma.astype(f32)
     shift = -mean * scale
@@ -297,7 +299,7 @@ def _bn_core_fwd(x, gamma, beta, eps):
 def _bn_core_bwd(eps, res, cts):
     dy = cts[0]  # stats cotangents ignored: running buffers are non-diff
     x, gamma, beta, mean, inv = res
-    f32 = jnp.float32
+    f32 = jnp.promote_types(x.dtype, jnp.float32)
     axes = tuple(range(x.ndim - 1))
     n = x.size // x.shape[-1]
     dyf = dy.astype(f32)
@@ -327,7 +329,7 @@ def batch_norm_train(x, gamma, beta, running_mean, running_var, *,
     if tuple(axis) == tuple(range(x.ndim - 1)):
         out, mean, var = _bn_core(x, gamma, beta, eps)
     else:
-        xf = x.astype(jnp.float32)
+        xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
         mean = jnp.mean(xf, axis=axis)
         var = jnp.var(xf, axis=axis)
         out = batchnorm.fn(x, mean, var, gamma, beta, eps=eps)
