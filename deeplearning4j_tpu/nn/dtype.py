@@ -20,12 +20,49 @@ shares float32's exponent range (unlike fp16, no underflow cliff).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
 _MIXED = ("mixed", "mixed_bfloat16")
+_LOW = ("bfloat16", "float16")
+
+
+def matmul_precision(policy: str) -> str:
+    """XLA dot/conv precision implied by the dtype policy.
+
+    Reference parity: DL4J's DataType.FLOAT means float32 math everywhere
+    (CUDA fp32 kernels). The TPU MXU natively multiplies bf16, so a float32
+    network must request 'highest' (multi-pass f32 emulation) to honor that
+    contract — otherwise f32 matmuls silently run at bf16-class precision,
+    which is exactly what sank the CPU-vs-TPU consistency suite. Low/mixed
+    policies keep 'default': their operands are already bf16/fp16 so the
+    knob costs nothing and buys nothing.
+    """
+    if policy in _MIXED or policy in _LOW:
+        return "default"
+    return "highest"
+
+
+def precision_scope(policy: str):
+    """Context manager pinning matmul/conv precision for traces under it.
+
+    Applied at the network _forward chokepoint (trace time), so every
+    dot_general/conv the layers emit inherits the policy's precision.
+    An explicit Environment.matmul_precision setting (the global knob,
+    pushed via apply_jax_config) wins over the policy-derived default —
+    a user who asked for fast f32 matmuls keeps them.
+    """
+    from deeplearning4j_tpu.environment import environment
+
+    if environment().matmul_precision != "default":
+        return contextlib.nullcontext()  # respect the explicit global knob
+    prec = matmul_precision(policy)
+    if prec == "default":
+        return contextlib.nullcontext()
+    return jax.default_matmul_precision(prec)
 
 
 def param_dtype(policy: str) -> jnp.dtype:

@@ -485,40 +485,41 @@ class ComputationGraph:
                  *, train: bool, rng):
         from deeplearning4j_tpu.nn import dtype as DT
 
-        if DT.needs_cast(self.conf.dtype):
-            # mixed policy: bf16 compute against f32 master params — ONE cast
-            # chokepoint so grads flow back to the f32 masters
-            cd = DT.compute_dtype(self.conf.dtype)
-            params = DT.cast_floats(params, cd)
-            inputs = DT.cast_floats(inputs, cd)
-        acts: Dict[str, Any] = dict(inputs)
-        act_masks: Dict[str, Any] = dict(masks or {})
-        new_state: Dict[str, Any] = {}
-        layer_names = [n.name for n in self._order if n.kind == "layer"]
-        rngs = (jax.random.split(rng, max(len(layer_names), 1))
-                if rng is not None else [None] * len(layer_names))
-        rng_map = dict(zip(layer_names, rngs))
-        for node in self._order:
-            xs = [acts[i] for i in node.inputs]
-            if node.kind == "vertex":
-                acts[node.name] = node.vertex.apply(xs)
-                ms = [act_masks.get(i) for i in node.inputs]
-                act_masks[node.name] = next((m for m in ms if m is not None), None)
-            else:
-                x = xs[0]
-                if getattr(node, "_flatten_input", False) and x.ndim == 4:
-                    x = x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
-                layer = self.layers[node.name]
-                mask = act_masks.get(node.inputs[0])
-                y, st, m2 = layer.apply(
-                    params[node.name], x, net_state[node.name],
-                    train=train, rng=rng_map[node.name], mask=mask)
-                acts[node.name] = y
-                act_masks[node.name] = m2
-                new_state[node.name] = st
-        if DT.needs_cast(self.conf.dtype):
-            for o in self.conf.network_outputs:  # loss/eval math stays f32
-                acts[o] = DT.cast_floats(acts[o], jnp.float32)
+        with DT.precision_scope(self.conf.dtype):
+            if DT.needs_cast(self.conf.dtype):
+                # mixed policy: bf16 compute against f32 master params — ONE cast
+                # chokepoint so grads flow back to the f32 masters
+                cd = DT.compute_dtype(self.conf.dtype)
+                params = DT.cast_floats(params, cd)
+                inputs = DT.cast_floats(inputs, cd)
+            acts: Dict[str, Any] = dict(inputs)
+            act_masks: Dict[str, Any] = dict(masks or {})
+            new_state: Dict[str, Any] = {}
+            layer_names = [n.name for n in self._order if n.kind == "layer"]
+            rngs = (jax.random.split(rng, max(len(layer_names), 1))
+                    if rng is not None else [None] * len(layer_names))
+            rng_map = dict(zip(layer_names, rngs))
+            for node in self._order:
+                xs = [acts[i] for i in node.inputs]
+                if node.kind == "vertex":
+                    acts[node.name] = node.vertex.apply(xs)
+                    ms = [act_masks.get(i) for i in node.inputs]
+                    act_masks[node.name] = next((m for m in ms if m is not None), None)
+                else:
+                    x = xs[0]
+                    if getattr(node, "_flatten_input", False) and x.ndim == 4:
+                        x = x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
+                    layer = self.layers[node.name]
+                    mask = act_masks.get(node.inputs[0])
+                    y, st, m2 = layer.apply(
+                        params[node.name], x, net_state[node.name],
+                        train=train, rng=rng_map[node.name], mask=mask)
+                    acts[node.name] = y
+                    act_masks[node.name] = m2
+                    new_state[node.name] = st
+            if DT.needs_cast(self.conf.dtype):
+                for o in self.conf.network_outputs:  # loss/eval math stays f32
+                    acts[o] = DT.cast_floats(acts[o], jnp.float32)
         return acts, new_state
 
     def output(self, *inputs, masks=None) -> List[np.ndarray]:

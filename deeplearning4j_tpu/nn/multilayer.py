@@ -191,33 +191,34 @@ class MultiLayerNetwork:
         (rnnActivateUsingStoredState in the reference)."""
         from deeplearning4j_tpu.nn import dtype as DT
 
-        if DT.needs_cast(self.conf.dtype):
-            # mixed policy: bf16 compute against f32 master params — ONE cast
-            # chokepoint so grads flow back to the f32 masters
-            cd = DT.compute_dtype(self.conf.dtype)
-            params = DT.cast_floats(params, cd)
-            x = DT.cast_floats(x, cd)
-            if rnn_states is not None:
-                rnn_states = DT.cast_floats(rnn_states, cd)
-        new_state = []
-        new_rnn = [] if rnn_states is not None else None
-        rngs = jax.random.split(rng, max(len(self.layers), 1)) if rng is not None else [None] * len(self.layers)
-        for i, layer in enumerate(self.layers):
-            x = apply_preprocessor(self.conf.preprocessors.get(i), x)
-            if rnn_states is not None and hasattr(layer, "apply_with_state"):
-                x = layer._maybe_dropout(x, train=train, rng=rngs[i])
-                x, last = layer.apply_with_state(
-                    params[i], x, mask=mask, initial=rnn_states[i])
-                new_rnn.append(last)
-                new_state.append(net_state[i])
-            else:
-                x, st, mask = layer.apply(
-                    params[i], x, net_state[i], train=train, rng=rngs[i], mask=mask)
-                new_state.append(st)
-                if new_rnn is not None:
-                    new_rnn.append(None)
-        if DT.needs_cast(self.conf.dtype):
-            x = DT.cast_floats(x, jnp.float32)  # loss/eval math stays f32
+        with DT.precision_scope(self.conf.dtype):
+            if DT.needs_cast(self.conf.dtype):
+                # mixed policy: bf16 compute against f32 master params — ONE cast
+                # chokepoint so grads flow back to the f32 masters
+                cd = DT.compute_dtype(self.conf.dtype)
+                params = DT.cast_floats(params, cd)
+                x = DT.cast_floats(x, cd)
+                if rnn_states is not None:
+                    rnn_states = DT.cast_floats(rnn_states, cd)
+            new_state = []
+            new_rnn = [] if rnn_states is not None else None
+            rngs = jax.random.split(rng, max(len(self.layers), 1)) if rng is not None else [None] * len(self.layers)
+            for i, layer in enumerate(self.layers):
+                x = apply_preprocessor(self.conf.preprocessors.get(i), x)
+                if rnn_states is not None and hasattr(layer, "apply_with_state"):
+                    x = layer._maybe_dropout(x, train=train, rng=rngs[i])
+                    x, last = layer.apply_with_state(
+                        params[i], x, mask=mask, initial=rnn_states[i])
+                    new_rnn.append(last)
+                    new_state.append(net_state[i])
+                else:
+                    x, st, mask = layer.apply(
+                        params[i], x, net_state[i], train=train, rng=rngs[i], mask=mask)
+                    new_state.append(st)
+                    if new_rnn is not None:
+                        new_rnn.append(None)
+            if DT.needs_cast(self.conf.dtype):
+                x = DT.cast_floats(x, jnp.float32)  # loss/eval math stays f32
         if rnn_states is not None:
             return x, new_state, new_rnn
         return x, new_state

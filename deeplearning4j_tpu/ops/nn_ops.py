@@ -422,14 +422,27 @@ def one_hot(indices, *, depth: int, on_value: float = 1.0, off_value: float = 0.
 
 
 @op("dot_product_attention")
-def dot_product_attention(q, k, v, mask=None, *, scaled: bool = True):
-    """q:[...,Lq,Dk] k:[...,Lk,Dk] v:[...,Lk,Dv] -> [...,Lq,Dv]."""
+def dot_product_attention(q, k, v, mask=None, *, scaled: bool = True,
+                          dropout_rate: float = 0.0, dropout_rng=None):
+    """q:[...,Lq,Dk] k:[...,Lk,Dk] v:[...,Lk,Dv] -> [...,Lq,Dv].
+
+    ``dropout_rate``/``dropout_rng``: post-softmax attention-prob dropout
+    (the reference's attention dropout order); the Pallas platform helper
+    implements the same semantics in-kernel."""
     scores = jnp.einsum("...qd,...kd->...qk", q, k)
     if scaled:
         scores = scores / jnp.sqrt(jnp.asarray(q.shape[-1], scores.dtype))
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
     weights = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError(
+                "dot_product_attention: dropout_rate > 0 requires dropout_rng "
+                "(pass None rate for eval mode)")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("...qk,...kd->...qd", weights, v)
 
 

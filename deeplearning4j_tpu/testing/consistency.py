@@ -103,7 +103,16 @@ def _cases() -> List[Case]:
     from deeplearning4j_tpu.ops.pallas_attention import flash_attention
 
     add("flash_attention",
-        lambda q, k, v: flash_attention(q, k, v, None, None, True, 64, 64, None),
+        lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=64,
+                                        block_k=64),
+        arr(4, 128, 32), arr(4, 128, 32), arr(4, 128, 32), grad=True)
+
+    # in-kernel dropout: the hash mask is deterministic in (seed, position),
+    # so CPU-interpret and TPU-Mosaic must produce IDENTICAL outputs
+    seed = jnp.asarray([[17]], jnp.int32)
+    add("flash_attention_dropout",
+        lambda q, k, v: flash_attention(q, k, v, None, seed, block_q=64,
+                                        block_k=64, dropout_rate=0.2),
         arr(4, 128, 32), arr(4, 128, 32), arr(4, 128, 32), grad=True)
 
     # full-layer forward: LeNet-sized conv net output
@@ -126,16 +135,24 @@ def _run_case(case: Case, cpu_dev, tpu_dev) -> List[str]:
     import jax
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu.nn import dtype as DT
+
     failures: List[str] = []
     fn, args = case.make()
 
     def run_on(dev, f, args):
-        with jax.default_device(dev):
+        # The cases are float32 — the reference FLOAT policy — so the run
+        # inherits that policy's matmul precision ('highest'): f32 math must
+        # be f32 math on the MXU, not silently bf16 (round-2 weak #2).
+        with jax.default_device(dev), DT.precision_scope("float32"):
             args_d = jax.tree.map(lambda a: jax.device_put(a, dev), args)
             return jax.tree.map(np.asarray, jax.jit(f)(*args_d))
 
-    ref = run_on(cpu_dev, fn, args)
-    got = run_on(tpu_dev, fn, args)
+    try:
+        ref = run_on(cpu_dev, fn, args)
+        got = run_on(tpu_dev, fn, args)
+    except Exception as e:  # a crash is a recorded failure, not an abort
+        return [f"{case.name}: FORWARD crash: {type(e).__name__}: {str(e)[:300]}"]
     try:
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
             a, b, rtol=case.rtol, atol=case.atol), ref, got)
@@ -155,8 +172,13 @@ def _run_case(case: Case, cpu_dev, tpu_dev) -> List[str]:
             return g
 
         gfn = jax.grad(scalar(fn), argnums=float_idx)
-        gref = run_on(cpu_dev, gfn, args)
-        ggot = run_on(tpu_dev, gfn, args)
+        try:
+            gref = run_on(cpu_dev, gfn, args)
+            ggot = run_on(tpu_dev, gfn, args)
+        except Exception as e:
+            failures.append(
+                f"{case.name}: GRADIENT crash: {type(e).__name__}: {str(e)[:300]}")
+            return failures
         try:
             jax.tree.map(lambda a, b: np.testing.assert_allclose(
                 a, b, rtol=max(case.rtol, 3e-2), atol=max(case.atol, 2e-2)),
@@ -182,7 +204,10 @@ def run_all(verbose: bool = True) -> Dict[str, Any]:
     failures: List[str] = []
     passed = 0
     for case in cases:
-        errs = _run_case(case, cpu_dev, tpu_dev)
+        try:
+            errs = _run_case(case, cpu_dev, tpu_dev)
+        except Exception as e:  # defense in depth: never abort the gate
+            errs = [f"{case.name}: CASE crash: {type(e).__name__}: {str(e)[:300]}"]
         if errs:
             failures.extend(errs)
             if verbose:
