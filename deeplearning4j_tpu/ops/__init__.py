@@ -7,6 +7,12 @@ populates the global op registry.
 
 from deeplearning4j_tpu.ops.registry import registry, op, exec_op, OpRegistry
 from deeplearning4j_tpu.ops import nn_ops, activations, losses, random, compression, weight_init
+# declarable-op catalog breadth (each module registers its family + a
+# numpy-oracle validation case per op — the OpValidation ratchet)
+from deeplearning4j_tpu.ops import (
+    transforms, reductions, shape_ops, scatter, linalg_ops, bitwise,
+    image_ops, misc_ops, validation,
+)
 from deeplearning4j_tpu.ops.activations import get_activation, ACTIVATIONS
 from deeplearning4j_tpu.ops.losses import get_loss, LOSSES
 from deeplearning4j_tpu.ops.weight_init import init_weights

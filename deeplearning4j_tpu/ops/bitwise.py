@@ -1,0 +1,137 @@
+"""Bitwise / integer ops.
+
+Reference parity: libnd4j bitwise DynamicCustomOps
+(include/ops/declarable/generic/bitwise/** — and.cpp, or.cpp, xor.cpp,
+shift.cpp, cyclic_shift.cpp, toggle_bits.cpp, bits_hamming_distance.cpp;
+Java surface org.nd4j.linalg.api.ops.impl.transforms.custom.*Bitwise*).
+Integer ops run on the VPU; XLA lowers them directly.
+
+Every op registers a numpy-oracle validation case.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import registry
+from deeplearning4j_tpu.ops import validation
+
+_REG = registry()
+
+_BINARY = {
+    "bitwise_and": (jnp.bitwise_and, np.bitwise_and),
+    "bitwise_or": (jnp.bitwise_or, np.bitwise_or),
+    "bitwise_xor": (jnp.bitwise_xor, np.bitwise_xor),
+}
+
+
+def _apply(jfn, x, y):
+    return jfn(x, y)
+
+
+def _check_binary(name, npfn):
+    r = np.random.RandomState(0)
+    x = r.randint(0, 1 << 16, (4, 9)).astype(np.int32)
+    y = r.randint(0, 1 << 16, (4, 9)).astype(np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec(name, jnp.asarray(x), jnp.asarray(y))),
+        npfn(x, y))
+
+
+for _name, (_jfn, _npfn) in _BINARY.items():
+    _REG.register(_name, functools.partial(_apply, _jfn),
+                  doc=f"{_name} (generic/bitwise family)")
+    validation.add_case(_name, functools.partial(_check_binary, _name, _npfn))
+
+
+def _toggle_bits(x):
+    """bitwise not (generic/bitwise/toggle_bits.cpp)."""
+    return jnp.bitwise_not(x)
+
+
+def _shift_bits(x, *, shift: int):
+    """left shift (generic/bitwise/shift.cpp)."""
+    return jnp.left_shift(x, shift)
+
+
+def _rshift_bits(x, *, shift: int):
+    """arithmetic right shift (generic/bitwise/shift.cpp)."""
+    return jnp.right_shift(x, shift)
+
+
+def _cyclic_shift_bits(x, *, shift: int):
+    """cyclic (rotate) left shift on 32-bit lanes
+    (generic/bitwise/cyclic_shift.cpp)."""
+    xu = x.astype(jnp.uint32)
+    rot = jnp.bitwise_or(jnp.left_shift(xu, shift),
+                         jnp.right_shift(xu, 32 - shift))
+    return rot.astype(x.dtype)
+
+
+def _cyclic_rshift_bits(x, *, shift: int):
+    """cyclic right shift on 32-bit lanes (generic/bitwise/cyclic_shift.cpp)."""
+    xu = x.astype(jnp.uint32)
+    rot = jnp.bitwise_or(jnp.right_shift(xu, shift),
+                         jnp.left_shift(xu, 32 - shift))
+    return rot.astype(x.dtype)
+
+
+def _bits_hamming_distance(x, y):
+    """total popcount of x^y (generic/bitwise/bits_hamming_distance.cpp)."""
+    return jnp.sum(jax.lax.population_count(jnp.bitwise_xor(x, y)))
+
+
+for _fn, _name in [(_toggle_bits, "toggle_bits"),
+                   (_shift_bits, "shift_bits"),
+                   (_rshift_bits, "rshift_bits"),
+                   (_cyclic_shift_bits, "cyclic_shift_bits"),
+                   (_cyclic_rshift_bits, "cyclic_rshift_bits"),
+                   (_bits_hamming_distance, "bits_hamming_distance")]:
+    _REG.register(_name, _fn, doc=_fn.__doc__)
+
+
+@validation.case("toggle_bits")
+def _check_toggle():
+    x = np.asarray([0, 1, -1, 7], np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("toggle_bits", jnp.asarray(x))), ~x)
+
+
+@validation.case("shift_bits")
+def _check_shift():
+    x = np.asarray([1, 2, 3], np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("shift_bits", jnp.asarray(x), shift=3)), x << 3)
+
+
+@validation.case("rshift_bits")
+def _check_rshift():
+    x = np.asarray([16, -16, 7], np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("rshift_bits", jnp.asarray(x), shift=2)), x >> 2)
+
+
+@validation.case("cyclic_shift_bits")
+def _check_cyclic():
+    x = np.asarray([0x80000001], np.uint32).astype(np.int32)
+    got = np.asarray(_REG.exec("cyclic_shift_bits", jnp.asarray(x), shift=1))
+    assert np.uint32(got[0]) == np.uint32(0x00000003)
+
+
+@validation.case("cyclic_rshift_bits")
+def _check_cyclic_r():
+    x = np.asarray([0x00000003], np.int32)
+    got = np.asarray(_REG.exec("cyclic_rshift_bits", jnp.asarray(x), shift=1))
+    assert np.uint32(got[0]) == np.uint32(0x80000001)
+
+
+@validation.case("bits_hamming_distance")
+def _check_hamming():
+    x = np.asarray([0b1010, 0b1111], np.int32)
+    y = np.asarray([0b0011, 0b1111], np.int32)
+    assert int(_REG.exec("bits_hamming_distance", jnp.asarray(x),
+                         jnp.asarray(y))) == 2

@@ -1,0 +1,357 @@
+"""Image ops.
+
+Reference parity: libnd4j image DynamicCustomOps
+(include/ops/declarable/generic/images/** and parity_ops —
+resize_bilinear.cpp, resize_neighbor.cpp, resize_bicubic.cpp,
+crop_and_resize.cpp, non_max_suppression.cpp, extract_image_patches.cpp,
+adjust_contrast.cpp, adjust_hue.cpp, adjust_saturation.cpp, rgb_to_hsv /
+hsv_to_rgb (color models); Java surface org.nd4j.linalg.api.ops.custom.*).
+
+TPU-native realization: resizes lower to jax.image (XLA gather/dot
+compositions); NMS runs a lax.fori_loop over the static max_output count —
+no dynamic shapes. Oracles: tensorflow's reference image kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import registry
+from deeplearning4j_tpu.ops import validation
+
+_REG = registry()
+
+
+def _op(name):
+    def deco(fn):
+        _REG.register(name, fn, doc=fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+def _resize(x, size, method, antialias=False):
+    shape = (x.shape[0], int(size[0]), int(size[1]), x.shape[3])
+    return jax.image.resize(x, shape, method=method, antialias=antialias)
+
+
+@_op("resize_bilinear")
+def resize_bilinear(x, *, size):
+    """NHWC bilinear resize (generic/parity_ops/resize_bilinear.cpp)."""
+    return _resize(x, size, "bilinear")
+
+
+@_op("resize_nearest_neighbor")
+def resize_nearest_neighbor(x, *, size):
+    """NHWC nearest resize (generic/parity_ops/resize_neighbor.cpp)."""
+    return _resize(x, size, "nearest")
+
+
+@_op("resize_bicubic")
+def resize_bicubic(x, *, size):
+    """NHWC bicubic resize (generic/parity_ops/resize_bicubic.cpp)."""
+    return _resize(x, size, "cubic")
+
+
+@_op("crop_and_resize")
+def crop_and_resize(image, boxes, box_indices, *, crop_size):
+    """crop normalized boxes then bilinear-resize each to crop_size
+    (generic/images/crop_and_resize.cpp). image: (N,H,W,C); boxes (B,4)
+    as [y1,x1,y2,x2] in [0,1]; box_indices (B,) into N."""
+    n, h, w, c = image.shape
+    ch, cw = crop_size
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        # TF sampling rule: size-1 crop dims sample the box CENTER, larger
+        # dims linspace corner-to-corner
+        if ch > 1:
+            ys = y1 * (h - 1) + jnp.arange(ch) / (ch - 1) * (y2 - y1) * (h - 1)
+        else:
+            ys = 0.5 * (y1 + y2) * (h - 1) + jnp.zeros((1,))
+        if cw > 1:
+            xs = x1 * (w - 1) + jnp.arange(cw) / (cw - 1) * (x2 - x1) * (w - 1)
+        else:
+            xs = 0.5 * (x1 + x2) * (w - 1) + jnp.zeros((1,))
+        img = image[bi]
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        tl = img[y0][:, x0]
+        tr = img[y0][:, x1i]
+        bl = img[y1i][:, x0]
+        br = img[y1i][:, x1i]
+        top = tl * (1 - wx) + tr * wx
+        bot = bl * (1 - wx) + br * wx
+        return top * (1 - wy) + bot * wy
+
+    return jax.vmap(one)(boxes, box_indices)
+
+
+@_op("non_max_suppression")
+def non_max_suppression(boxes, scores, *, max_output_size: int,
+                        iou_threshold: float = 0.5,
+                        score_threshold: float = -np.inf):
+    """greedy IoU NMS (generic/images [parity_ops]/non_max_suppression.cpp).
+
+    Static shapes for XLA: returns (indices[max_output_size], valid 0/1 mask)
+    — the reference returns a dynamic-length index list; the mask carries the
+    same information with a compilable shape. boxes: (N,4) [y1,x1,y2,x2]."""
+    n = boxes.shape[0]
+    y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+
+    def iou_row(i):
+        yy1 = jnp.maximum(y1[i], y1)
+        xx1 = jnp.maximum(x1[i], x1)
+        yy2 = jnp.minimum(y2[i], y2)
+        xx2 = jnp.minimum(x2[i], x2)
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(area[i] + area - inter, 1e-9)
+
+    live = scores > score_threshold
+
+    def body(k, carry):
+        sel_idx, sel_mask, live = carry
+        s = jnp.where(live, scores, -jnp.inf)
+        i = jnp.argmax(s)
+        ok = s[i] > -jnp.inf
+        sel_idx = sel_idx.at[k].set(jnp.where(ok, i, -1))
+        sel_mask = sel_mask.at[k].set(ok.astype(jnp.int32))
+        suppress = iou_row(i) > iou_threshold
+        live = live & jnp.where(ok, ~suppress, live) & \
+            (jnp.arange(n) != i)
+        return sel_idx, sel_mask, live
+
+    idx0 = jnp.full((max_output_size,), -1, jnp.int32)
+    m0 = jnp.zeros((max_output_size,), jnp.int32)
+    sel_idx, sel_mask, _ = jax.lax.fori_loop(0, max_output_size, body,
+                                             (idx0, m0, live))
+    return sel_idx, sel_mask
+
+
+@_op("extract_image_patches")
+def extract_image_patches(x, *, kernel, strides, rates=(1, 1),
+                          padding: str = "VALID"):
+    """extract_image_patches (generic/images [parity_ops]/
+    extract_image_patches.cpp) — NHWC, returns (N, H', W', kh*kw*C)."""
+    kh, kw = kernel
+    sh, sw = strides
+    rh, rw = rates
+    c = x.shape[3]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding,
+        rhs_dilation=(rh, rw),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # conv_general_dilated_patches emits channel-major (C, kh, kw) feature
+    # order; the reference (TF semantics) wants (kh, kw, C) — re-interleave.
+    n, oh, ow, _ = patches.shape
+    patches = patches.reshape(n, oh, ow, c, kh * kw)
+    return jnp.swapaxes(patches, 3, 4).reshape(n, oh, ow, kh * kw * c)
+
+
+@_op("adjust_contrast")
+def adjust_contrast(x, *, factor: float):
+    """scale distance from per-channel mean (custom/adjust_contrast.cpp)."""
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+@_op("rgb_to_hsv")
+def rgb_to_hsv(x):
+    """RGB→HSV on the last axis (generic/images/rgb_to_hsv.cpp)."""
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0)) / 6.0
+    h = jnp.where(d == 0, 0.0, h)
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+@_op("hsv_to_rgb")
+def hsv_to_rgb(x):
+    """HSV→RGB on the last axis (generic/images/hsv_to_rgb.cpp)."""
+    h, s, v = x[..., 0], x[..., 1], x[..., 2]
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], axis=-1)
+
+
+@_op("adjust_hue")
+def adjust_hue(x, *, delta: float):
+    """rotate hue by delta (custom/adjust_hue.cpp)."""
+    hsv = rgb_to_hsv(x)
+    h = (hsv[..., 0] + delta) % 1.0
+    return hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+@_op("adjust_saturation")
+def adjust_saturation(x, *, factor: float):
+    """scale saturation (custom/adjust_saturation.cpp)."""
+    hsv = rgb_to_hsv(x)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+@_op("rgb_to_grs")
+def rgb_to_grs(x):
+    """RGB→grayscale, ITU-R 601 weights (generic/images/rgb_to_grs.cpp)."""
+    w = jnp.asarray([0.2989, 0.5870, 0.1140], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+# --------------------------------------------------------------------------
+
+
+def _img(seed=0, shape=(2, 8, 8, 3)):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+@validation.case("resize_bilinear")
+def _check_bilinear():
+    x = _img(0)
+    got = np.asarray(_REG.exec("resize_bilinear", jnp.asarray(x), size=(4, 4)))
+    assert got.shape == (2, 4, 4, 3)
+    # downscale-by-2 bilinear == 2x2 average at aligned half-pixel centers
+    import tensorflow as tf
+
+    want = tf.image.resize(x, (4, 4), method="bilinear").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@validation.case("resize_nearest_neighbor")
+def _check_nearest():
+    x = _img(1)
+    got = np.asarray(_REG.exec("resize_nearest_neighbor", jnp.asarray(x),
+                               size=(16, 16)))
+    np.testing.assert_array_equal(got[:, ::2, ::2], x)
+
+
+@validation.case("resize_bicubic")
+def _check_bicubic():
+    x = _img(2)
+    got = np.asarray(_REG.exec("resize_bicubic", jnp.asarray(x), size=(16, 16)))
+    assert got.shape == (2, 16, 16, 3) and np.isfinite(got).all()
+
+
+@validation.case("crop_and_resize")
+def _check_crop_resize():
+    import tensorflow as tf
+
+    x = _img(3, (2, 10, 10, 1))
+    boxes = np.asarray([[0.0, 0.0, 0.5, 0.5], [0.2, 0.2, 0.9, 0.8]], np.float32)
+    bi = np.asarray([0, 1], np.int32)
+    got = np.asarray(_REG.exec("crop_and_resize", jnp.asarray(x),
+                               jnp.asarray(boxes), jnp.asarray(bi),
+                               crop_size=(4, 4)))
+    want = tf.image.crop_and_resize(x, boxes, bi, (4, 4)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+    # size-1 crop dims sample the box center (TF rule)
+    got1 = np.asarray(_REG.exec("crop_and_resize", jnp.asarray(x),
+                                jnp.asarray(boxes), jnp.asarray(bi),
+                                crop_size=(1, 1)))
+    want1 = tf.image.crop_and_resize(x, boxes, bi, (1, 1)).numpy()
+    np.testing.assert_allclose(got1, want1, rtol=1e-3, atol=1e-4)
+
+
+@validation.case("non_max_suppression")
+def _check_nms():
+    import tensorflow as tf
+
+    r = np.random.RandomState(4)
+    base = r.rand(12, 2).astype(np.float32)
+    boxes = np.concatenate([base, base + 0.3 + 0.2 * r.rand(12, 2).astype(np.float32)], 1)
+    scores = r.rand(12).astype(np.float32)
+    idx, mask = _REG.exec("non_max_suppression", jnp.asarray(boxes),
+                          jnp.asarray(scores), max_output_size=5,
+                          iou_threshold=0.5)
+    got = np.asarray(idx)[np.asarray(mask).astype(bool)]
+    want = tf.image.non_max_suppression(boxes, scores, 5, 0.5).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+@validation.case("extract_image_patches")
+def _check_patches():
+    import tensorflow as tf
+
+    x = _img(5, (1, 6, 6, 2))
+    got = np.asarray(_REG.exec("extract_image_patches", jnp.asarray(x),
+                               kernel=(3, 3), strides=(2, 2)))
+    want = tf.image.extract_patches(x, [1, 3, 3, 1], [1, 2, 2, 1],
+                                    [1, 1, 1, 1], "VALID").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@validation.case("adjust_contrast")
+def _check_contrast():
+    import tensorflow as tf
+
+    x = _img(6)
+    got = np.asarray(_REG.exec("adjust_contrast", jnp.asarray(x), factor=1.7))
+    want = tf.image.adjust_contrast(x, 1.7).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@validation.case("rgb_to_hsv")
+def _check_rgb_hsv():
+    import tensorflow as tf
+
+    x = _img(7)
+    got = np.asarray(_REG.exec("rgb_to_hsv", jnp.asarray(x)))
+    want = tf.image.rgb_to_hsv(x).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@validation.case("hsv_to_rgb")
+def _check_hsv_rgb():
+    import tensorflow as tf
+
+    x = _img(8)
+    hsv = tf.image.rgb_to_hsv(x).numpy()
+    got = np.asarray(_REG.exec("hsv_to_rgb", jnp.asarray(hsv)))
+    np.testing.assert_allclose(got, x, rtol=1e-3, atol=1e-4)
+
+
+@validation.case("adjust_hue")
+def _check_hue():
+    import tensorflow as tf
+
+    x = _img(9)
+    got = np.asarray(_REG.exec("adjust_hue", jnp.asarray(x), delta=0.15))
+    want = tf.image.adjust_hue(x, 0.15).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-4)
+
+
+@validation.case("adjust_saturation")
+def _check_sat():
+    import tensorflow as tf
+
+    x = _img(10)
+    got = np.asarray(_REG.exec("adjust_saturation", jnp.asarray(x), factor=0.6))
+    want = tf.image.adjust_saturation(x, 0.6).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-4)
+
+
+@validation.case("rgb_to_grs")
+def _check_grs():
+    x = _img(11)
+    got = np.asarray(_REG.exec("rgb_to_grs", jnp.asarray(x)))
+    want = (x * np.asarray([0.2989, 0.5870, 0.1140])).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

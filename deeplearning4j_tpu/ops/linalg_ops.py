@@ -1,0 +1,242 @@
+"""Linear-algebra ops.
+
+Reference parity: libnd4j linalg DynamicCustomOps
+(include/ops/declarable/generic/linalg/** — cholesky.cpp, qr.cpp, svd.cpp,
+solve.cpp, triangular_solve.cpp, lstsq.cpp, matrix_inverse.cpp,
+matrix_determinant.cpp, lup.cpp, cross.cpp, tensormmul.cpp; Java surface
+org.nd4j.linalg.api.ops.custom.*). Bodies lower to jnp.linalg /
+jax.scipy.linalg, which XLA routes to its native decomposition custom-calls
+on TPU.
+
+Every op registers a numpy.linalg-oracle validation case.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import registry
+from deeplearning4j_tpu.ops import validation
+
+_REG = registry()
+
+
+def _op(name):
+    def deco(fn):
+        _REG.register(name, fn, doc=fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+def _spd(r, n):
+    a = r.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+@_op("cholesky")
+def cholesky(x):
+    """lower-triangular Cholesky factor (generic/linalg/cholesky.cpp)."""
+    return jnp.linalg.cholesky(x)
+
+
+@_op("qr")
+def qr(x, *, full_matrices: bool = False):
+    """QR decomposition → (Q, R) (generic/linalg/qr.cpp)."""
+    return jnp.linalg.qr(x, mode="complete" if full_matrices else "reduced")
+
+
+@_op("svd")
+def svd(x, *, full_matrices: bool = False, compute_uv: bool = True):
+    """singular value decomposition (generic/linalg/svd.cpp)."""
+    return jnp.linalg.svd(x, full_matrices=full_matrices,
+                          compute_uv=compute_uv)
+
+
+@_op("solve")
+def solve(a, b):
+    """linear system solve Ax=b (generic/linalg/solve.cpp)."""
+    return jnp.linalg.solve(a, b)
+
+
+@_op("triangular_solve")
+def triangular_solve(a, b, *, lower: bool = True, adjoint: bool = False):
+    """triangular solve (generic/linalg/triangular_solve.cpp)."""
+    return jax.scipy.linalg.solve_triangular(a, b, lower=lower,
+                                             trans=1 if adjoint else 0)
+
+
+@_op("lstsq")
+def lstsq(a, b):
+    """least-squares solution (generic/linalg/lstsq.cpp)."""
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+@_op("matrix_inverse")
+def matrix_inverse(x):
+    """matrix inverse (generic/linalg/matrix_inverse.cpp)."""
+    return jnp.linalg.inv(x)
+
+
+@_op("matrix_determinant")
+def matrix_determinant(x):
+    """determinant (generic/linalg/matrixDeterminant.cpp)."""
+    return jnp.linalg.det(x)
+
+
+@_op("log_matrix_determinant")
+def log_matrix_determinant(x):
+    """(sign, log|det|) (generic/linalg/logMatrixDeterminant analog)."""
+    return jnp.linalg.slogdet(x)
+
+
+@_op("lu")
+def lu(x):
+    """LU with partial pivoting → (lu_packed, pivots) (generic/linalg/lup.cpp)."""
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv
+
+
+@_op("cross")
+def cross(a, b):
+    """3-vector cross product (generic/linalg/cross.cpp)."""
+    return jnp.cross(a, b)
+
+
+@_op("tensormmul")
+def tensormmul(a, b, *, axes_a, axes_b):
+    """tensordot (generic/linalg/tensormmul.cpp)."""
+    return jnp.tensordot(a, b, axes=(tuple(axes_a), tuple(axes_b)))
+
+
+@_op("matrix_set_diag")
+def matrix_set_diag(x, diag_vals):
+    """replace the main diagonal (generic/parity_ops/matrix_set_diag.cpp)."""
+    n = min(x.shape[-2], x.shape[-1])
+    idx = jnp.arange(n)
+    return x.at[..., idx, idx].set(diag_vals[..., :n])
+
+
+# --------------------------------------------------------------------------
+
+
+@validation.case("cholesky")
+def _check_chol():
+    a = _spd(np.random.RandomState(0), 4)
+    got = np.asarray(_REG.exec("cholesky", jnp.asarray(a)))
+    np.testing.assert_allclose(got @ got.T, a, rtol=1e-4, atol=1e-4)
+    assert np.allclose(got, np.tril(got))
+
+
+@validation.case("qr")
+def _check_qr():
+    a = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+    q, r = _REG.exec("qr", jnp.asarray(a))
+    np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(q).T @ np.asarray(q), np.eye(3),
+                               rtol=1e-4, atol=1e-4)
+
+
+@validation.case("svd")
+def _check_svd():
+    a = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+    u, s, vt = _REG.exec("svd", jnp.asarray(a))
+    rec = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(vt)
+    np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s),
+                               np.linalg.svd(a, compute_uv=False),
+                               rtol=1e-4, atol=1e-5)
+
+
+@validation.case("solve")
+def _check_solve():
+    r = np.random.RandomState(3)
+    a = _spd(r, 4)
+    b = r.randn(4, 2).astype(np.float32)
+    got = np.asarray(_REG.exec("solve", jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, np.linalg.solve(a, b), rtol=1e-3, atol=1e-3)
+
+
+@validation.case("triangular_solve")
+def _check_tri_solve():
+    r = np.random.RandomState(4)
+    a = np.tril(r.randn(4, 4).astype(np.float32)) + 4 * np.eye(4, dtype=np.float32)
+    b = r.randn(4, 2).astype(np.float32)
+    got = np.asarray(_REG.exec("triangular_solve", jnp.asarray(a),
+                               jnp.asarray(b), lower=True))
+    np.testing.assert_allclose(a @ got, b, rtol=1e-4, atol=1e-4)
+
+
+@validation.case("lstsq")
+def _check_lstsq():
+    r = np.random.RandomState(5)
+    a = r.randn(6, 3).astype(np.float32)
+    b = r.randn(6).astype(np.float32)
+    got = np.asarray(_REG.exec("lstsq", jnp.asarray(a), jnp.asarray(b)))
+    want = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+@validation.case("matrix_inverse")
+def _check_inv():
+    a = _spd(np.random.RandomState(6), 4)
+    got = np.asarray(_REG.exec("matrix_inverse", jnp.asarray(a)))
+    np.testing.assert_allclose(a @ got, np.eye(4), rtol=1e-3, atol=1e-3)
+
+
+@validation.case("matrix_determinant")
+def _check_det():
+    a = _spd(np.random.RandomState(7), 3)
+    got = float(_REG.exec("matrix_determinant", jnp.asarray(a)))
+    np.testing.assert_allclose(got, np.linalg.det(a), rtol=1e-3)
+
+
+@validation.case("log_matrix_determinant")
+def _check_slogdet():
+    a = _spd(np.random.RandomState(8), 3)
+    sign, logdet = _REG.exec("log_matrix_determinant", jnp.asarray(a))
+    s, l = np.linalg.slogdet(a)
+    np.testing.assert_allclose(float(sign), s, rtol=1e-5)
+    np.testing.assert_allclose(float(logdet), l, rtol=1e-4)
+
+
+@validation.case("lu")
+def _check_lu():
+    import scipy.linalg as sla
+
+    a = _spd(np.random.RandomState(9), 4)
+    lu_, piv = _REG.exec("lu", jnp.asarray(a))
+    want_lu, want_piv = sla.lu_factor(a)
+    np.testing.assert_allclose(np.asarray(lu_), want_lu, rtol=1e-3, atol=1e-3)
+
+
+@validation.case("cross")
+def _check_cross():
+    r = np.random.RandomState(10)
+    a = r.randn(3).astype(np.float32)
+    b = r.randn(3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("cross", jnp.asarray(a), jnp.asarray(b))),
+        np.cross(a, b), rtol=1e-5, atol=1e-6)
+
+
+@validation.case("tensormmul")
+def _check_tensormmul():
+    r = np.random.RandomState(11)
+    a = r.randn(2, 3, 4).astype(np.float32)
+    b = r.randn(4, 3, 5).astype(np.float32)
+    got = np.asarray(_REG.exec("tensormmul", jnp.asarray(a), jnp.asarray(b),
+                               axes_a=[1, 2], axes_b=[1, 0]))
+    np.testing.assert_allclose(got, np.tensordot(a, b, axes=([1, 2], [1, 0])),
+                               rtol=1e-4, atol=1e-4)
+
+
+@validation.case("matrix_set_diag")
+def _check_set_diag():
+    x = np.zeros((3, 3), np.float32)
+    got = np.asarray(_REG.exec("matrix_set_diag", jnp.asarray(x),
+                               jnp.asarray([1.0, 2.0, 3.0], dtype=jnp.float32)))
+    np.testing.assert_array_equal(got, np.diag([1.0, 2.0, 3.0]))

@@ -1,0 +1,450 @@
+"""Remaining declarable-op families: top-k, CTC, set/histogram, norms.
+
+Reference parity:
+  * top_k / in_top_k — generic/parity_ops/top_k.cpp, in_top_k.cpp
+  * ctc_loss — generic/nn/ctc_loss.cpp (+ the cuDNN ctcloss platform helper;
+    SURVEY §3.1 lists ctc among the cuDNN-helper ops)
+  * unique, listdiff — generic/parity_ops/unique.cpp, listdiff.cpp
+  * nth_element — generic/parity_ops/nth_element.cpp
+  * confusion_matrix — generic/parity_ops/confusion_matrix.cpp
+  * histogram, histogram_fixed_width — generic/parity_ops/histogram*.cpp
+  * clip_by_global_norm / clip_by_avg_norm — generic/transforms/clip ops
+  * l2_normalize, zeta, polygamma, digamma, lgamma, igamma —
+    generic/parity_ops math specials
+
+The CTC forward is a log-semiring alpha recursion under lax.scan — static
+shapes, no host loop; oracle is optax.ctc_loss.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import registry
+from deeplearning4j_tpu.ops import validation
+
+_REG = registry()
+
+
+def _op(name):
+    def deco(fn):
+        _REG.register(name, fn, doc=fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+@_op("top_k")
+def top_k(x, *, k: int, sorted: bool = True):
+    """top_k → (values, indices) along the last axis
+    (generic/parity_ops/top_k.cpp)."""
+    return jax.lax.top_k(x, k)
+
+
+@_op("in_top_k")
+def in_top_k(predictions, targets, *, k: int):
+    """whether targets[i] ranks in the top-k of predictions[i]
+    (generic/parity_ops/in_top_k.cpp)."""
+    target_logit = jnp.take_along_axis(
+        predictions, targets[:, None].astype(jnp.int32), axis=1)[:, 0]
+    rank = jnp.sum(predictions > target_logit[:, None], axis=1)
+    return rank < k
+
+
+@_op("ctc_loss")
+def ctc_loss(logits, labels, logit_lengths, label_lengths, *, blank: int = 0):
+    """CTC negative log-likelihood (generic/nn/ctc_loss.cpp; cuDNN ctcloss
+    helper analog). logits: (B, T, C) unnormalized; labels: (B, S) int
+    (padded); lengths: (B,). Returns per-example loss (B,).
+
+    Log-semiring alpha recursion over the blank-interleaved extended label
+    sequence, scanned over time with lax.scan — the whole computation is one
+    XLA program (no host loop), so it fuses and runs on the VPU."""
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    b, t_max, _ = logits.shape
+    s_max = labels.shape[1]
+    neg_inf = -1e30
+
+    def one(lp, lab, t_len, s_len):
+        # extended labels: [blank, l1, blank, l2, ..., blank] — length 2S+1
+        ext = jnp.full((2 * s_max + 1,), blank, lab.dtype)
+        ext = ext.at[1::2].set(lab)
+        n_ext = 2 * s_len + 1
+        # can skip from s-2 when ext[s] is a label differing from ext[s-2]
+        can_skip = jnp.zeros((2 * s_max + 1,), bool)
+        if s_max > 1:
+            can_skip = can_skip.at[3::2].set(lab[1:] != lab[:-1])
+
+        alpha0 = jnp.full((2 * s_max + 1,), neg_inf)
+        alpha0 = alpha0.at[0].set(lp[0, blank])
+        if s_max >= 1:
+            alpha0 = alpha0.at[1].set(lp[0, ext[1]])
+
+        def step(alpha, lp_t):
+            prev1 = jnp.concatenate([jnp.full((1,), neg_inf), alpha[:-1]])
+            prev2 = jnp.concatenate([jnp.full((2,), neg_inf), alpha[:-2]])
+            prev2 = jnp.where(can_skip, prev2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            return merged + lp_t[ext]
+
+        # scan all steps, freezing alpha once t >= t_len (padded frames)
+        def scan_step(carry, lp_t):
+            alpha, t = carry
+            new_alpha = step(alpha, lp_t)
+            alpha = jnp.where(t < t_len, new_alpha, alpha)
+            return (alpha, t + 1), None
+
+        (alpha, _), _ = jax.lax.scan(scan_step, (alpha0, jnp.asarray(1)),
+                                     lp[1:])
+        last = alpha[n_ext - 1]
+        second = jnp.where(n_ext >= 2, alpha[n_ext - 2], neg_inf)
+        return -jnp.logaddexp(last, second)
+
+    return jax.vmap(one)(log_probs, labels, logit_lengths, label_lengths)
+
+
+@_op("unique")
+def unique(x, *, size: int = None, fill_value=0):
+    """unique values + inverse indices (generic/parity_ops/unique.cpp).
+    XLA needs static shapes: pass size (defaults to len(x)); extras padded
+    with fill_value."""
+    size = size if size is not None else int(np.prod(x.shape))
+    vals, inv = jnp.unique(x.ravel(), return_inverse=True, size=size,
+                           fill_value=fill_value)
+    return vals, inv.reshape(x.shape)
+
+
+@_op("listdiff")
+def listdiff(x, y, *, size: int = None):
+    """elements of x not in y (generic/parity_ops/listdiff.cpp): returns
+    (values padded to ``size``, 0/1 validity mask)."""
+    size = size if size is not None else int(x.shape[0])
+    keep = ~jnp.isin(x, y)
+    order = jnp.argsort(~keep, stable=True)
+    vals = x[order]
+    mask = (jnp.arange(x.shape[0]) < jnp.sum(keep)).astype(jnp.int32)
+    vals = jnp.where(mask.astype(bool), vals, 0)
+    return vals[:size], mask[:size]
+
+
+@_op("nth_element")
+def nth_element(x, *, n: int, reverse: bool = False):
+    """n-th order statistic along the last axis
+    (generic/parity_ops/nth_element.cpp)."""
+    s = jnp.sort(x, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return s[..., n]
+
+
+@_op("confusion_matrix")
+def confusion_matrix(labels, predictions, *, num_classes: int, weights=None):
+    """confusion matrix (generic/parity_ops/confusion_matrix.cpp)."""
+    idx = labels.astype(jnp.int32) * num_classes + predictions.astype(jnp.int32)
+    w = jnp.ones_like(idx, jnp.float32) if weights is None else weights
+    flat = jnp.zeros((num_classes * num_classes,), w.dtype).at[idx].add(w)
+    return flat.reshape(num_classes, num_classes)
+
+
+@_op("histogram")
+def histogram(x, *, num_bins: int):
+    """equal-width histogram over [min, max]
+    (generic/parity_ops/histogram.cpp)."""
+    lo, hi = jnp.min(x), jnp.max(x)
+    width = jnp.maximum(hi - lo, 1e-12)
+    bins = jnp.clip(((x - lo) / width * num_bins).astype(jnp.int32),
+                    0, num_bins - 1)
+    return jnp.zeros((num_bins,), jnp.int32).at[bins.ravel()].add(1)
+
+
+@_op("histogram_fixed_width")
+def histogram_fixed_width(x, *, range, num_bins: int = 100):
+    """histogram over an explicit [lo, hi] range
+    (generic/parity_ops/histogram_fixed_width.cpp)."""
+    lo, hi = range
+    width = (hi - lo) / num_bins
+    bins = jnp.clip(((x - lo) / width).astype(jnp.int32), 0, num_bins - 1)
+    return jnp.zeros((num_bins,), jnp.int32).at[bins.ravel()].add(1)
+
+
+@_op("clip_by_global_norm")
+def clip_by_global_norm(*xs, clip_norm: float):
+    """scale a tensor list so the joint L2 norm <= clip_norm
+    (generic/transforms/clip_by_global_norm analog)."""
+    gn = jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in xs))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
+    return tuple(x * scale for x in xs)
+
+
+@_op("clip_by_avg_norm")
+def clip_by_avg_norm(x, *, clip_norm: float):
+    """clip by mean-normalized L2 norm (generic/transforms/clipbyavgnorm)."""
+    n = x.size
+    avg = jnp.linalg.norm(x.ravel()) / n
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(avg, 1e-12))
+    return x * scale
+
+
+@_op("l2_normalize")
+def l2_normalize(x, *, axis=-1, eps: float = 1e-12):
+    """x / ||x||_2 along axis (TF l2_normalize parity)."""
+    return x / jnp.sqrt(jnp.maximum(
+        jnp.sum(jnp.square(x), axis=axis, keepdims=True), eps))
+
+
+@_op("lgamma")
+def lgamma(x):
+    """log-gamma (generic/parity_ops/lgamma.cpp)."""
+    return jax.lax.lgamma(x)
+
+
+@_op("digamma")
+def digamma(x):
+    """digamma ψ (generic/parity_ops/digamma.cpp)."""
+    return jax.lax.digamma(x)
+
+
+@_op("igamma")
+def igamma(a, x):
+    """regularized lower incomplete gamma (generic/parity_ops/igamma.cpp)."""
+    return jax.lax.igamma(a, x)
+
+
+@_op("igammac")
+def igammac(a, x):
+    """regularized upper incomplete gamma (generic/parity_ops/igammac.cpp)."""
+    return jax.lax.igammac(a, x)
+
+
+@_op("betainc")
+def betainc(a, b, x):
+    """regularized incomplete beta (generic/parity_ops/betainc.cpp)."""
+    return jax.lax.betainc(a, b, x)
+
+
+@_op("zeta")
+def zeta(x, q):
+    """Hurwitz zeta (generic/parity_ops/zeta.cpp)."""
+    return jax.lax.zeta(x, q)
+
+
+@_op("polygamma")
+def polygamma(n, x):
+    """polygamma ψ⁽ⁿ⁾ (generic/parity_ops/polygamma.cpp)."""
+    return jax.lax.polygamma(n.astype(x.dtype), x)
+
+
+# --------------------------------------------------------------------------
+
+
+@validation.case("top_k")
+def _check_top_k():
+    x = np.random.RandomState(0).randn(4, 10).astype(np.float32)
+    vals, idx = _REG.exec("top_k", jnp.asarray(x), k=3)
+    want = np.sort(x, axis=1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(np.asarray(vals), want, rtol=1e-6)
+    np.testing.assert_array_equal(np.take_along_axis(x, np.asarray(idx), 1),
+                                  want)
+
+
+@validation.case("in_top_k")
+def _check_in_top_k():
+    import tensorflow as tf
+
+    r = np.random.RandomState(1)
+    preds = r.randn(6, 8).astype(np.float32)
+    targets = r.randint(0, 8, 6).astype(np.int32)
+    got = np.asarray(_REG.exec("in_top_k", jnp.asarray(preds),
+                               jnp.asarray(targets), k=3))
+    want = tf.math.in_top_k(targets, preds, 3).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+@validation.case("ctc_loss")
+def _check_ctc():
+    import optax
+
+    r = np.random.RandomState(2)
+    b, t, c, s = 3, 12, 6, 4
+    logits = r.randn(b, t, c).astype(np.float32)
+    labels = r.randint(1, c, (b, s)).astype(np.int32)  # 0 is blank
+    logit_lengths = np.asarray([12, 9, 11], np.int32)
+    label_lengths = np.asarray([4, 2, 3], np.int32)
+    got = np.asarray(_REG.exec(
+        "ctc_loss", jnp.asarray(logits), jnp.asarray(labels),
+        jnp.asarray(logit_lengths), jnp.asarray(label_lengths)))
+    logit_pad = (np.arange(t)[None, :] >= logit_lengths[:, None]).astype(np.float32)
+    label_pad = (np.arange(s)[None, :] >= label_lengths[:, None]).astype(np.float32)
+    want = np.asarray(optax.ctc_loss(jnp.asarray(logits), jnp.asarray(logit_pad),
+                                     jnp.asarray(labels), jnp.asarray(label_pad)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@validation.case("ctc_loss")
+def _check_ctc_grad():
+    # gradient exists and is finite (the loss trains)
+    r = np.random.RandomState(3)
+    logits = jnp.asarray(r.randn(2, 8, 5).astype(np.float32))
+    labels = jnp.asarray(r.randint(1, 5, (2, 3)).astype(np.int32))
+
+    def loss(lg):
+        return jnp.sum(_REG.exec("ctc_loss", lg, labels,
+                                 jnp.asarray([8, 8]), jnp.asarray([3, 2])))
+
+    g = jax.grad(loss)(logits)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+@validation.case("unique")
+def _check_unique():
+    x = np.asarray([3, 1, 3, 2, 1], np.int32)
+    vals, inv = _REG.exec("unique", jnp.asarray(x), size=5, fill_value=0)
+    want_vals, want_inv = np.unique(x, return_inverse=True)
+    np.testing.assert_array_equal(np.asarray(vals)[:3], want_vals)
+    np.testing.assert_array_equal(np.asarray(inv), want_inv)
+
+
+@validation.case("listdiff")
+def _check_listdiff():
+    x = np.asarray([1, 2, 3, 4, 5], np.int32)
+    y = np.asarray([2, 4], np.int32)
+    vals, mask = _REG.exec("listdiff", jnp.asarray(x), jnp.asarray(y))
+    got = np.asarray(vals)[np.asarray(mask).astype(bool)]
+    np.testing.assert_array_equal(got, [1, 3, 5])
+
+
+@validation.case("nth_element")
+def _check_nth():
+    x = np.random.RandomState(4).randn(5, 9).astype(np.float32)
+    got = np.asarray(_REG.exec("nth_element", jnp.asarray(x), n=2))
+    np.testing.assert_allclose(got, np.sort(x, axis=-1)[:, 2], rtol=1e-6)
+
+
+@validation.case("confusion_matrix")
+def _check_confusion():
+    labels = np.asarray([0, 1, 2, 1], np.int32)
+    preds = np.asarray([0, 2, 2, 1], np.int32)
+    got = np.asarray(_REG.exec("confusion_matrix", jnp.asarray(labels),
+                               jnp.asarray(preds), num_classes=3))
+    want = np.zeros((3, 3), np.float32)
+    for l, p in zip(labels, preds):
+        want[l, p] += 1
+    np.testing.assert_array_equal(got, want)
+
+
+@validation.case("histogram")
+def _check_histogram():
+    x = np.random.RandomState(5).rand(100).astype(np.float32)
+    got = np.asarray(_REG.exec("histogram", jnp.asarray(x), num_bins=10))
+    assert got.sum() == 100 and got.shape == (10,)
+
+
+@validation.case("histogram_fixed_width")
+def _check_hfw():
+    x = np.asarray([0.1, 0.5, 0.9, 0.55], np.float32)
+    got = np.asarray(_REG.exec("histogram_fixed_width", jnp.asarray(x),
+                               range=(0.0, 1.0), num_bins=2))
+    np.testing.assert_array_equal(got, [1, 3])
+
+
+@validation.case("clip_by_global_norm")
+def _check_cgn():
+    a = jnp.asarray([3.0, 4.0])
+    b = jnp.asarray([0.0])
+    ca, cb = _REG.exec("clip_by_global_norm", a, b, clip_norm=1.0)
+    total = np.sqrt(np.sum(np.asarray(ca) ** 2) + np.sum(np.asarray(cb) ** 2))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+@validation.case("clip_by_avg_norm")
+def _check_can():
+    x = jnp.asarray([3.0, 4.0])
+    got = np.asarray(_REG.exec("clip_by_avg_norm", x, clip_norm=1.0))
+    np.testing.assert_allclose(got, np.asarray([3.0, 4.0]) * (1.0 / 2.5),
+                               rtol=1e-5)
+
+
+@validation.case("l2_normalize")
+def _check_l2n():
+    x = np.random.RandomState(6).randn(3, 4).astype(np.float32)
+    got = np.asarray(_REG.exec("l2_normalize", jnp.asarray(x)))
+    np.testing.assert_allclose(np.linalg.norm(got, axis=-1), 1.0, rtol=1e-5)
+
+
+@validation.case("lgamma")
+def _check_lgamma():
+    from scipy import special
+
+    x = np.abs(np.random.RandomState(7).randn(10).astype(np.float32)) + 0.2
+    np.testing.assert_allclose(np.asarray(_REG.exec("lgamma", jnp.asarray(x))),
+                               special.gammaln(x), rtol=1e-4, atol=1e-5)
+
+
+@validation.case("digamma")
+def _check_digamma():
+    from scipy import special
+
+    x = np.abs(np.random.RandomState(8).randn(10).astype(np.float32)) + 0.5
+    np.testing.assert_allclose(np.asarray(_REG.exec("digamma", jnp.asarray(x))),
+                               special.digamma(x), rtol=1e-3, atol=1e-4)
+
+
+@validation.case("igamma")
+def _check_igamma():
+    from scipy import special
+
+    a = np.asarray([1.0, 2.0, 3.0], np.float32)
+    x = np.asarray([0.5, 2.0, 1.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("igamma", jnp.asarray(a), jnp.asarray(x))),
+        special.gammainc(a, x), rtol=1e-4, atol=1e-5)
+
+
+@validation.case("igammac")
+def _check_igammac():
+    from scipy import special
+
+    a = np.asarray([1.0, 2.0], np.float32)
+    x = np.asarray([0.5, 2.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("igammac", jnp.asarray(a), jnp.asarray(x))),
+        special.gammaincc(a, x), rtol=1e-4, atol=1e-5)
+
+
+@validation.case("betainc")
+def _check_betainc():
+    from scipy import special
+
+    a = np.asarray([1.0, 2.0], np.float32)
+    b = np.asarray([2.0, 3.0], np.float32)
+    x = np.asarray([0.3, 0.7], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("betainc", jnp.asarray(a), jnp.asarray(b),
+                             jnp.asarray(x))),
+        special.betainc(a, b, x), rtol=1e-4, atol=1e-5)
+
+
+@validation.case("zeta")
+def _check_zeta():
+    from scipy import special
+
+    x = np.asarray([2.0, 3.0], np.float32)
+    q = np.asarray([1.0, 2.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("zeta", jnp.asarray(x), jnp.asarray(q))),
+        special.zeta(x, q), rtol=1e-4, atol=1e-5)
+
+
+@validation.case("polygamma")
+def _check_polygamma():
+    from scipy import special
+
+    n = np.asarray([1, 2], np.int32)
+    x = np.asarray([1.5, 2.5], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("polygamma", jnp.asarray(n), jnp.asarray(x))),
+        special.polygamma(n, x).astype(np.float32), rtol=1e-3, atol=1e-4)

@@ -1,0 +1,270 @@
+"""Reduction / accumulation ops.
+
+Reference parity: libnd4j legacy reduce ops (reduce_same/reduce_float kinds
+in include/loops/legacy_ops.h) and the custom reduce DynamicCustomOps
+(include/ops/declarable/generic/reduce/**; Java surface
+org.nd4j.linalg.api.ops.impl.reduce.*). Names preserved; bodies lower to
+jnp reductions, which XLA maps to tree-reductions over the VPU (SURVEY
+§3.1: legacy loop kernels dissolve into XLA HLO reduce).
+
+Each table entry auto-registers a numpy-oracle validation case.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import registry
+from deeplearning4j_tpu.ops import validation
+
+_REG = registry()
+
+# name -> (jax fn(x, axis, keepdims), numpy oracle, needs_float)
+_REDUCE = {
+    "reduce_sum": (jnp.sum, np.sum),
+    "reduce_mean": (jnp.mean, np.mean),
+    "reduce_max": (jnp.max, np.max),
+    "reduce_min": (jnp.min, np.min),
+    "reduce_prod": (jnp.prod, np.prod),
+    "reduce_norm1": (lambda x, **k: jnp.sum(jnp.abs(x), **k),
+                     lambda x, **k: np.sum(np.abs(x), **k)),
+    "reduce_norm2": (lambda x, **k: jnp.sqrt(jnp.sum(jnp.square(x), **k)),
+                     lambda x, **k: np.sqrt(np.sum(np.square(x), **k))),
+    "reduce_norm_max": (lambda x, **k: jnp.max(jnp.abs(x), **k),
+                        lambda x, **k: np.max(np.abs(x), **k)),
+    "reduce_sqnorm": (lambda x, **k: jnp.sum(jnp.square(x), **k),
+                      lambda x, **k: np.sum(np.square(x), **k)),
+    "reduce_variance": (jnp.var, np.var),
+    "reduce_stdev": (jnp.std, np.std),
+    "reduce_logsumexp": (None, None),  # special-cased below
+    "amax": (lambda x, **k: jnp.max(jnp.abs(x), **k),
+             lambda x, **k: np.max(np.abs(x), **k)),
+    "amin": (lambda x, **k: jnp.min(jnp.abs(x), **k),
+             lambda x, **k: np.min(np.abs(x), **k)),
+    "amean": (lambda x, **k: jnp.mean(jnp.abs(x), **k),
+              lambda x, **k: np.mean(np.abs(x), **k)),
+    "asum": (lambda x, **k: jnp.sum(jnp.abs(x), **k),
+             lambda x, **k: np.sum(np.abs(x), **k)),
+    "reduce_any": (jnp.any, np.any),
+    "reduce_all": (jnp.all, np.all),
+}
+
+
+def _reduce_apply(jfn, x, *, axis=None, keepdims: bool = False):
+    return jfn(x, axis=axis, keepdims=keepdims)
+
+
+def _check_reduce(name, oracle):
+    r = np.random.RandomState(0)
+    x = r.randn(4, 6, 5).astype(np.float32)
+    if name in ("reduce_any", "reduce_all"):
+        x = x > 0.5
+    for axis in (None, 1, (0, 2)):
+        got = np.asarray(_REG.exec(name, jnp.asarray(x), axis=axis))
+        want = oracle(x, axis=axis)
+        if got.dtype == np.bool_:
+            np.testing.assert_array_equal(got, want)
+        else:
+            np.testing.assert_allclose(got, want.astype(got.dtype),
+                                       rtol=2e-5, atol=1e-6)
+
+
+for _name, (_jfn, _npfn) in _REDUCE.items():
+    if _jfn is None:
+        continue
+    _REG.register(_name, functools.partial(_reduce_apply, _jfn),
+                  doc=f"{_name} reduction (libnd4j legacy reduce op)")
+    validation.add_case(_name, functools.partial(_check_reduce, _name, _npfn))
+
+
+def _logsumexp(x, *, axis=None, keepdims: bool = False):
+    """reduce_logsumexp — stable log-sum-exp (generic/reduce family)."""
+    import jax
+
+    return jax.nn.logsumexp(x, axis=axis, keepdims=keepdims)
+
+
+_REG.register("reduce_logsumexp", _logsumexp, doc=_logsumexp.__doc__)
+
+
+@validation.case("reduce_logsumexp")
+def _check_lse():
+    x = np.random.RandomState(1).randn(5, 7).astype(np.float32) * 10
+    got = np.asarray(_REG.exec("reduce_logsumexp", jnp.asarray(x), axis=1))
+    m = x.max(axis=1, keepdims=True)
+    want = (np.log(np.sum(np.exp(x - m), axis=1)) + m[:, 0])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+# ---- index reductions ------------------------------------------------------
+
+
+def _argmax(x, *, axis=None, keepdims: bool = False):
+    """argmax (libnd4j indexreduce IMax)."""
+    return jnp.argmax(x, axis=axis, keepdims=keepdims)
+
+
+def _argmin(x, *, axis=None, keepdims: bool = False):
+    """argmin (libnd4j indexreduce IMin)."""
+    return jnp.argmin(x, axis=axis, keepdims=keepdims)
+
+
+_REG.register("argmax", _argmax, doc=_argmax.__doc__)
+_REG.register("argmin", _argmin, doc=_argmin.__doc__)
+
+
+@validation.case("argmax")
+def _check_argmax():
+    x = np.random.RandomState(2).randn(6, 9).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("argmax", jnp.asarray(x), axis=1)),
+        np.argmax(x, axis=1))
+
+
+@validation.case("argmin")
+def _check_argmin():
+    x = np.random.RandomState(3).randn(6, 9).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("argmin", jnp.asarray(x), axis=0)),
+        np.argmin(x, axis=0))
+
+
+# ---- counting / moments / cumulative --------------------------------------
+
+
+def _count_nonzero(x, *, axis=None, keepdims: bool = False):
+    """count_nonzero (generic/reduce/countNonZero analog)."""
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdims)
+
+
+def _count_zero(x, *, axis=None, keepdims: bool = False):
+    """count_zero (generic/reduce/countZero analog)."""
+    total = np.prod([x.shape[a] for a in (
+        range(x.ndim) if axis is None else np.atleast_1d(axis))], dtype=int)
+    return total - jnp.count_nonzero(x, axis=axis, keepdims=keepdims)
+
+
+def _moments(x, *, axis=None, keepdims: bool = False):
+    """moments: (mean, variance) pair (generic/reduce/moments.cpp analog)."""
+    return (jnp.mean(x, axis=axis, keepdims=keepdims),
+            jnp.var(x, axis=axis, keepdims=keepdims))
+
+
+def _cumsum(x, *, axis: int = 0, exclusive: bool = False,
+            reverse: bool = False):
+    """cumsum with the reference's exclusive/reverse flags
+    (generic/parity_ops/cumsum.cpp analog)."""
+    if reverse:
+        x = jnp.flip(x, axis=axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+def _cumprod(x, *, axis: int = 0, exclusive: bool = False,
+             reverse: bool = False):
+    """cumprod with exclusive/reverse flags (generic/parity_ops/cumprod).
+    Exclusive form shifts the input right by one (identity=1) before the
+    scan — robust to zeros, unlike the divide-out trick."""
+    if reverse:
+        x = jnp.flip(x, axis=axis)
+    if exclusive:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, x.shape[axis])
+        x = jnp.pad(x, pad, constant_values=1)[tuple(sl)]
+    out = jnp.cumprod(x, axis=axis)
+    if reverse:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+_REG.register("count_nonzero", _count_nonzero, doc=_count_nonzero.__doc__)
+_REG.register("count_zero", _count_zero, doc=_count_zero.__doc__)
+_REG.register("moments", _moments, doc=_moments.__doc__)
+_REG.register("cumsum", _cumsum, doc=_cumsum.__doc__)
+_REG.register("cumprod", _cumprod, doc=_cumprod.__doc__)
+
+
+@validation.case("count_nonzero")
+def _check_cnz():
+    x = np.asarray([[0, 1, 2], [3, 0, 0]], np.float32)
+    assert int(_REG.exec("count_nonzero", jnp.asarray(x))) == 3
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("count_nonzero", jnp.asarray(x), axis=1)), [2, 1])
+
+
+@validation.case("count_zero")
+def _check_cz():
+    x = np.asarray([[0, 1, 2], [3, 0, 0]], np.float32)
+    assert int(_REG.exec("count_zero", jnp.asarray(x))) == 3
+
+
+@validation.case("moments")
+def _check_moments():
+    x = np.random.RandomState(4).randn(8, 5).astype(np.float32)
+    m, v = _REG.exec("moments", jnp.asarray(x), axis=0)
+    np.testing.assert_allclose(np.asarray(m), x.mean(axis=0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v), x.var(axis=0), rtol=1e-5, atol=1e-6)
+
+
+@validation.case("cumsum")
+def _check_cumsum():
+    x = np.random.RandomState(5).randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("cumsum", jnp.asarray(x), axis=1)),
+        np.cumsum(x, axis=1), rtol=1e-5, atol=1e-6)
+    # exclusive: [0, x0, x0+x1, ...]
+    got = np.asarray(_REG.exec("cumsum", jnp.asarray(x), axis=1, exclusive=True))
+    want = np.cumsum(x, axis=1) - x
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # reverse: suffix sums
+    got = np.asarray(_REG.exec("cumsum", jnp.asarray(x), axis=1, reverse=True))
+    np.testing.assert_allclose(got, np.flip(np.cumsum(np.flip(x, 1), 1), 1),
+                               rtol=1e-5, atol=1e-6)
+
+
+@validation.case("cumprod")
+def _check_cumprod():
+    x = np.random.RandomState(6).rand(3, 5).astype(np.float32) + 0.5
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("cumprod", jnp.asarray(x), axis=1)),
+        np.cumprod(x, axis=1), rtol=1e-5, atol=1e-6)
+
+
+def _bincount(x, *, weights=None, minlength: int = 0, maxlength: int = None):
+    """bincount (generic/parity_ops/bincount.cpp analog).
+
+    XLA needs a static output shape, so the caller must bound the value
+    range: pass minlength (or maxlength) >= max(x)+1. Counts for values
+    beyond the bound would be silently dropped by the underlying scatter,
+    so an unbounded call is an error rather than a wrong answer."""
+    if maxlength is None and minlength <= 0:
+        raise ValueError(
+            "bincount needs a static length: pass minlength (or maxlength) "
+            ">= max(x)+1 — XLA cannot size the output from data")
+    length = minlength if maxlength is None else maxlength
+    return jnp.bincount(x, weights=weights, length=length)
+
+
+_REG.register("bincount", _bincount, doc=_bincount.__doc__)
+
+
+@validation.case("bincount")
+def _check_bincount():
+    x = np.asarray([0, 1, 1, 3, 2, 1], np.int32)
+    got = np.asarray(_REG.exec("bincount", jnp.asarray(x), minlength=5))
+    np.testing.assert_array_equal(got, np.bincount(x, minlength=5))
+    try:
+        _REG.exec("bincount", jnp.asarray(x))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("unbounded bincount must raise, not truncate")

@@ -1,0 +1,274 @@
+"""Scatter / segment ops.
+
+Reference parity: libnd4j scatter family
+(include/ops/declarable/generic/parity_ops/scatter_*.cpp — scatter_add/
+sub/mul/div/max/min/upd, scatter_nd*) and segment family
+(generic/parity_ops/segment_*.cpp, unsorted_segment_*.cpp; Java surface
+org.nd4j.linalg.api.ops.impl.scatter.* / .transforms.segment.*).
+
+TPU-native realization: scatter lowers to jax .at[] indexed updates (XLA
+scatter HLO); segment ops lower to jax.ops.segment_* which XLA turns into
+sorted-segment reductions — no serial loops. Duplicate indices follow XLA
+scatter semantics (adds combine; updates pick one winner), matching the
+reference's documented "undefined order for duplicate updates".
+
+Every op registers a numpy-oracle validation case.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import registry
+from deeplearning4j_tpu.ops import validation
+
+_REG = registry()
+
+# name -> (at-method, numpy combine)
+_SCATTER = {
+    "scatter_add": ("add", np.add),
+    "scatter_sub": ("subtract", np.subtract),
+    "scatter_mul": ("multiply", np.multiply),
+    "scatter_div": ("divide", np.divide),
+    "scatter_max": ("max", np.maximum),
+    "scatter_min": ("min", np.minimum),
+    "scatter_upd": ("set", None),
+}
+
+
+def _scatter_apply(method, ref, indices, updates):
+    return getattr(ref.at[indices], method)(updates)
+
+
+def _check_scatter(name, method, combine):
+    r = np.random.RandomState(0)
+    ref = r.randn(6, 4).astype(np.float32)
+    if name == "scatter_div":
+        updates = (np.abs(r.randn(3, 4)) + 0.5).astype(np.float32)
+    else:
+        updates = r.randn(3, 4).astype(np.float32)
+    idx = np.asarray([5, 0, 2], np.int32)  # unique rows → order-free oracle
+    got = np.asarray(_REG.exec(name, jnp.asarray(ref), jnp.asarray(idx),
+                               jnp.asarray(updates)))
+    want = ref.copy()
+    for i, row in zip(idx, updates):
+        want[i] = row if combine is None else combine(want[i], row)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+for _name, (_method, _combine) in _SCATTER.items():
+    _REG.register(_name, functools.partial(_scatter_apply, _method),
+                  doc=f"{_name}(ref, indices, updates) — row-indexed scatter "
+                      "(generic/parity_ops/scatter_*.cpp)")
+    validation.add_case(_name, functools.partial(
+        _check_scatter, _name, _method, _combine))
+
+
+def _scatter_nd(indices, updates, *, shape):
+    """scatter_nd: build a zeros(shape) tensor with updates at nd-indices
+    (generic/parity_ops/scatter_nd.cpp)."""
+    z = jnp.zeros(shape, updates.dtype)
+    return z.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+def _scatter_nd_add(ref, indices, updates):
+    """scatter_nd_add (generic/parity_ops/scatter_nd_add.cpp)."""
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+def _scatter_nd_update(ref, indices, updates):
+    """scatter_nd_update (generic/parity_ops/scatter_nd_update.cpp)."""
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].set(updates)
+
+
+_REG.register("scatter_nd", _scatter_nd, doc=_scatter_nd.__doc__)
+_REG.register("scatter_nd_add", _scatter_nd_add, doc=_scatter_nd_add.__doc__)
+_REG.register("scatter_nd_update", _scatter_nd_update,
+              doc=_scatter_nd_update.__doc__)
+
+
+@validation.case("scatter_nd")
+def _check_scatter_nd():
+    idx = np.asarray([[0, 1], [2, 3]], np.int32)
+    upd = np.asarray([5.0, 7.0], np.float32)
+    got = np.asarray(_REG.exec("scatter_nd", jnp.asarray(idx),
+                               jnp.asarray(upd), shape=(3, 4)))
+    want = np.zeros((3, 4), np.float32)
+    want[0, 1], want[2, 3] = 5.0, 7.0
+    np.testing.assert_array_equal(got, want)
+
+
+@validation.case("scatter_nd_add")
+def _check_scatter_nd_add():
+    ref = np.ones((3, 4), np.float32)
+    idx = np.asarray([[1, 1]], np.int32)
+    got = np.asarray(_REG.exec("scatter_nd_add", jnp.asarray(ref),
+                               jnp.asarray(idx), jnp.asarray([2.0], np.float32)))
+    want = ref.copy(); want[1, 1] += 2.0
+    np.testing.assert_array_equal(got, want)
+
+
+@validation.case("scatter_nd_update")
+def _check_scatter_nd_update():
+    ref = np.zeros((2, 2), np.float32)
+    idx = np.asarray([[0, 0]], np.int32)
+    got = np.asarray(_REG.exec("scatter_nd_update", jnp.asarray(ref),
+                               jnp.asarray(idx), jnp.asarray([9.0], np.float32)))
+    assert got[0, 0] == 9.0 and got.sum() == 9.0
+
+
+# ---- segment reductions ----------------------------------------------------
+
+_SEGMENT = {
+    "segment_sum": (jax.ops.segment_sum, np.add.reduceat),
+    "segment_max": (jax.ops.segment_max, None),
+    "segment_min": (jax.ops.segment_min, None),
+    "segment_prod": (jax.ops.segment_prod, None),
+}
+
+
+def _segment_apply(jfn, data, segment_ids, *, num_segments: int):
+    return jfn(data, segment_ids, num_segments=num_segments)
+
+
+def _segment_mean(data, segment_ids, *, num_segments: int):
+    """segment_mean (generic/parity_ops/segment_mean.cpp)."""
+    s = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    n = jax.ops.segment_sum(jnp.ones_like(data, jnp.float32), segment_ids,
+                            num_segments=num_segments)
+    return s / jnp.maximum(n, 1)
+
+
+def _np_segment(npfn, data, ids, n):
+    out = []
+    for s in range(n):
+        rows = data[ids == s]
+        out.append(npfn(rows, axis=0) if len(rows) else np.zeros(data.shape[1:]))
+    return np.stack(out)
+
+
+def _check_segment(name, npfn):
+    # name is the REGISTRY entry to exec (sorted or unsorted_ prefixed);
+    # the numpy oracle is shared
+    r = np.random.RandomState(1)
+    data = r.randn(8, 3).astype(np.float32)
+    ids = np.asarray([0, 0, 1, 1, 1, 3, 3, 0], np.int32)  # sorted not required
+    got = np.asarray(_REG.exec(name, jnp.asarray(data), jnp.asarray(ids),
+                               num_segments=4))
+    want = _np_segment(npfn, data, ids, 4).astype(np.float32)
+    base = name.replace("unsorted_", "")
+    if base == "segment_max":
+        want[2] = -np.inf  # empty segment identity
+    if base == "segment_min":
+        want[2] = np.inf
+    if base == "segment_prod":
+        want[2] = 1.0
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+_NPFN = {"segment_sum": np.sum, "segment_max": np.max, "segment_min": np.min,
+         "segment_prod": np.prod, "segment_mean": np.mean}
+
+for _name, (_jfn, _) in _SEGMENT.items():
+    _REG.register(_name, functools.partial(_segment_apply, _jfn),
+                  doc=f"{_name}(data, segment_ids, num_segments) — "
+                      "(generic/parity_ops segment family); ids need not be "
+                      "sorted (unsorted_segment_* alias)")
+    _REG.register("unsorted_" + _name,
+                  functools.partial(_segment_apply, _jfn),
+                  doc=f"unsorted_{_name} — same lowering (XLA scatter-reduce)")
+    validation.add_case(_name, functools.partial(
+        _check_segment, _name, _NPFN[_name]))
+    validation.add_case("unsorted_" + _name, functools.partial(
+        _check_segment, "unsorted_" + _name, _NPFN[_name]))
+
+_REG.register("segment_mean", _segment_mean, doc=_segment_mean.__doc__)
+_REG.register("unsorted_segment_mean", _segment_mean,
+              doc="unsorted segment mean — same lowering")
+validation.add_case("segment_mean", functools.partial(
+    _check_segment, "segment_mean", np.mean))
+validation.add_case("unsorted_segment_mean", functools.partial(
+    _check_segment, "unsorted_segment_mean", np.mean))
+
+
+def _unsorted_segment_sqrt_n(data, segment_ids, *, num_segments: int):
+    """unsorted_segment_sqrt_n: sum / sqrt(count)
+    (generic/parity_ops/unsorted_segment_sqrt_n.cpp)."""
+    s = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    n = jax.ops.segment_sum(jnp.ones_like(data, jnp.float32), segment_ids,
+                            num_segments=num_segments)
+    return s / jnp.sqrt(jnp.maximum(n, 1))
+
+
+_REG.register("unsorted_segment_sqrt_n", _unsorted_segment_sqrt_n,
+              doc=_unsorted_segment_sqrt_n.__doc__)
+
+
+@validation.case("unsorted_segment_sqrt_n")
+def _check_sqrt_n():
+    data = np.asarray([[2.0], [4.0], [6.0]], np.float32)
+    ids = np.asarray([0, 0, 1], np.int32)
+    got = np.asarray(_REG.exec("unsorted_segment_sqrt_n", jnp.asarray(data),
+                               jnp.asarray(ids), num_segments=2))
+    np.testing.assert_allclose(got, [[6.0 / np.sqrt(2)], [6.0]], rtol=1e-6)
+
+
+# ---- dynamic partition / stitch -------------------------------------------
+
+
+def _dynamic_partition(data, partitions, *, num_partitions: int):
+    """dynamic_partition (generic/parity_ops/dynamic_parition.cpp [sic]).
+    XLA needs static shapes, so each partition is returned padded to the
+    full data length with a parallel 0/1 validity mask:
+    returns ([part_0..part_{P-1}], [mask_0..mask_{P-1}])."""
+    outs, masks = [], []
+    n = data.shape[0]
+    for p in range(num_partitions):
+        sel = partitions == p
+        cnt = jnp.sum(sel)
+        idx_sorted = jnp.argsort(~sel, stable=True)  # members first
+        outs.append(data[idx_sorted])
+        masks.append((jnp.arange(n) < cnt).astype(jnp.int32))
+    return outs, masks
+
+
+def _dynamic_stitch(indices, parts):
+    """dynamic_stitch (generic/parity_ops/dynamic_stitch.cpp)."""
+    idx = jnp.concatenate([jnp.ravel(i) for i in indices])
+    flat = jnp.concatenate([p.reshape((-1,) + p.shape[i.ndim:])
+                            for i, p in zip(indices, parts)])
+    n = int(idx.shape[0])
+    out = jnp.zeros((n,) + flat.shape[1:], flat.dtype)
+    return out.at[idx].set(flat)
+
+
+_REG.register("dynamic_partition", _dynamic_partition,
+              doc=_dynamic_partition.__doc__)
+_REG.register("dynamic_stitch", _dynamic_stitch, doc=_dynamic_stitch.__doc__)
+
+
+@validation.case("dynamic_partition")
+def _check_dyn_part():
+    data = np.asarray([[1.0], [2.0], [3.0], [4.0]], np.float32)
+    parts = np.asarray([1, 0, 1, 0], np.int32)
+    outs, masks = _REG.exec("dynamic_partition", jnp.asarray(data),
+                            jnp.asarray(parts), num_partitions=2)
+    m0 = np.asarray(masks[0]).astype(bool)
+    np.testing.assert_array_equal(np.asarray(outs[0])[m0], [[2.0], [4.0]])
+    m1 = np.asarray(masks[1]).astype(bool)
+    np.testing.assert_array_equal(np.asarray(outs[1])[m1], [[1.0], [3.0]])
+
+
+@validation.case("dynamic_stitch")
+def _check_dyn_stitch():
+    idx = [np.asarray([0, 2], np.int32), np.asarray([1, 3], np.int32)]
+    parts = [np.asarray([[10.0], [30.0]], np.float32),
+             np.asarray([[20.0], [40.0]], np.float32)]
+    got = np.asarray(_REG.exec("dynamic_stitch",
+                               [jnp.asarray(i) for i in idx],
+                               [jnp.asarray(p) for p in parts]))
+    np.testing.assert_array_equal(got, [[10.0], [20.0], [30.0], [40.0]])

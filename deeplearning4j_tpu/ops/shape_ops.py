@@ -1,0 +1,569 @@
+"""Shape / layout / indexing ops.
+
+Reference parity: libnd4j's shape DynamicCustomOps
+(include/ops/declarable/generic/shape/** — reshape, permute, expand_dims,
+squeeze, …; generic/parity_ops/** — stack, unstack, pad, reverse, tile,
+gather_nd, …; Java surface org.nd4j.linalg.api.ops.impl.shape.*). Names
+preserved; bodies lower to jnp/lax, where XLA folds most of them into
+layout changes that cost nothing at runtime (SURVEY §3.1).
+
+Every op registers a numpy-oracle validation case (ops/validation.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import registry
+from deeplearning4j_tpu.ops import validation
+
+_REG = registry()
+
+
+def _op(name, doc=""):
+    def deco(fn):
+        _REG.register(name, fn, doc=doc or fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+@_op("reshape")
+def reshape(x, *, shape):
+    """reshape (generic/shape/reshape.cpp)."""
+    return jnp.reshape(x, shape)
+
+
+@_op("permute")
+def permute(x, *, axes):
+    """permute/transpose with explicit axes (generic/shape/permute.cpp)."""
+    return jnp.transpose(x, axes)
+
+
+@_op("transpose")
+def transpose(x):
+    """full transpose — reverse all axes (generic/shape/transpose.cpp)."""
+    return jnp.transpose(x)
+
+
+@_op("expand_dims")
+def expand_dims(x, *, axis: int):
+    """expand_dims (generic/shape/expand_dims.cpp)."""
+    return jnp.expand_dims(x, axis)
+
+
+@_op("squeeze")
+def squeeze(x, *, axis=None):
+    """squeeze (generic/shape/squeeze.cpp)."""
+    return jnp.squeeze(x, axis=axis)
+
+
+@_op("concat")
+def concat(*xs, axis: int = 0):
+    """concat (generic/transforms/concat.cpp)."""
+    return jnp.concatenate(xs, axis=axis)
+
+
+@_op("stack")
+def stack(*xs, axis: int = 0):
+    """stack (generic/parity_ops/stack.cpp)."""
+    return jnp.stack(xs, axis=axis)
+
+
+@_op("unstack")
+def unstack(x, *, axis: int = 0):
+    """unstack → tuple of arrays (generic/parity_ops/unstack.cpp)."""
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+@_op("split")
+def split(x, *, num_split: int, axis: int = 0):
+    """split into equal parts (generic/parity_ops/split.cpp)."""
+    return tuple(jnp.split(x, num_split, axis=axis))
+
+
+@_op("split_v")
+def split_v(x, *, sizes, axis: int = 0):
+    """split by explicit sizes (generic/parity_ops/split_v.cpp)."""
+    idx = np.cumsum(sizes)[:-1]
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@_op("slice")
+def slice_op(x, *, begin, size):
+    """slice by begin/size (generic/parity_ops/slice.cpp)."""
+    import jax
+
+    size = [x.shape[i] - b if s == -1 else s
+            for i, (b, s) in enumerate(zip(begin, size))]
+    return jax.lax.dynamic_slice(x, begin, size)
+
+
+@_op("strided_slice")
+def strided_slice(x, *, begin, end, strides=None):
+    """strided_slice (generic/parity_ops/strided_slice.cpp) — basic form."""
+    strides = strides or [1] * len(begin)
+    sl = tuple(slice(b, e, s) for b, e, s in zip(begin, end, strides))
+    return x[sl]
+
+
+@_op("gather_nd")
+def gather_nd(x, indices):
+    """gather_nd (generic/parity_ops/gather_nd.cpp)."""
+    return x[tuple(jnp.moveaxis(indices, -1, 0))]
+
+
+@_op("repeat")
+def repeat(x, *, repeats: int, axis: int = 0):
+    """repeat elements along axis (NDArray::repeat analog)."""
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@_op("tile")
+def tile(x, *, reps):
+    """tile (generic/transforms/tile.cpp)."""
+    return jnp.tile(x, reps)
+
+
+@_op("pad")
+def pad(x, *, paddings, mode: str = "constant", constant: float = 0.0):
+    """pad with CONSTANT/REFLECT/SYMMETRIC modes (generic/transforms/pad.cpp)."""
+    mode = mode.lower()
+    if mode == "constant":
+        return jnp.pad(x, paddings, constant_values=constant)
+    return jnp.pad(x, paddings, mode={"reflect": "reflect",
+                                      "symmetric": "symmetric"}[mode])
+
+
+@_op("reverse")
+def reverse(x, *, axis):
+    """reverse along axes (generic/transforms/reverse.cpp)."""
+    return jnp.flip(x, axis=axis)
+
+
+@_op("rank")
+def rank(x):
+    """rank (generic/shape/rank.cpp)."""
+    return jnp.asarray(x.ndim, jnp.int32)
+
+
+@_op("shape_of")
+def shape_of(x):
+    """shape_of (generic/shape/shape.cpp)."""
+    return jnp.asarray(x.shape, jnp.int64 if max(x.shape, default=0) > 2**31 else jnp.int32)
+
+
+@_op("size")
+def size(x):
+    """total element count (generic/shape/size.cpp)."""
+    return jnp.asarray(int(np.prod(x.shape)), jnp.int32)
+
+
+@_op("zeros_like")
+def zeros_like(x):
+    """zeros_like (generic/parity_ops/zeros_as.cpp)."""
+    return jnp.zeros_like(x)
+
+
+@_op("ones_like")
+def ones_like(x):
+    """ones_like (generic/parity_ops/ones_as.cpp)."""
+    return jnp.ones_like(x)
+
+
+@_op("fill")
+def fill(*, shape, value, dtype=jnp.float32):
+    """fill (generic/parity_ops/fill.cpp)."""
+    return jnp.full(shape, value, dtype=dtype)
+
+
+@_op("linspace")
+def linspace(*, start, stop, num, dtype=jnp.float32):
+    """linspace (Nd4j.linspace analog)."""
+    return jnp.linspace(start, stop, num, dtype=dtype)
+
+
+@_op("range")
+def range_op(*, start, limit, delta=1, dtype=jnp.float32):
+    """range (generic/parity_ops/range.cpp)."""
+    return jnp.arange(start, limit, delta, dtype=dtype)
+
+
+@_op("broadcast_to")
+def broadcast_to(x, *, shape):
+    """broadcast_to (generic/shape/broadcast_to.cpp)."""
+    return jnp.broadcast_to(x, shape)
+
+
+@_op("space_to_depth")
+def space_to_depth(x, *, block_size: int, data_format: str = "NHWC"):
+    """space_to_depth (generic/parity_ops/space_to_depth.cpp)."""
+    if data_format == "NCHW":
+        x = x.transpose(0, 2, 3, 1)
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h // b, b, w // b, b, c).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(n, h // b, w // b, b * b * c)
+    if data_format == "NCHW":
+        x = x.transpose(0, 3, 1, 2)
+    return x
+
+
+@_op("depth_to_space")
+def depth_to_space(x, *, block_size: int, data_format: str = "NHWC"):
+    """depth_to_space (generic/parity_ops/depth_to_space.cpp)."""
+    if data_format == "NCHW":
+        x = x.transpose(0, 2, 3, 1)
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h, w, b, b, c // (b * b)).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(n, h * b, w * b, c // (b * b))
+    if data_format == "NCHW":
+        x = x.transpose(0, 3, 1, 2)
+    return x
+
+
+@_op("batch_to_space")
+def batch_to_space(x, *, block_shape, crops):
+    """batch_to_space_nd (generic/parity_ops/batch_to_space_nd.cpp)."""
+    return _b2s(x, block_shape, crops)
+
+
+def _b2s(x, block_shape, crops):
+    n = x.shape[0]
+    block = list(block_shape)
+    prod = int(np.prod(block))
+    spatial = x.shape[1:1 + len(block)]
+    rest = x.shape[1 + len(block):]
+    x = x.reshape(tuple(block) + (n // prod,) + tuple(spatial) + tuple(rest))
+    perm = [len(block)]
+    for i in range(len(block)):
+        perm += [len(block) + 1 + i, i]
+    perm += list(range(2 * len(block) + 1, x.ndim))
+    x = x.transpose(perm)
+    shape = (n // prod,) + tuple(s * b for s, b in zip(spatial, block)) + tuple(rest)
+    x = x.reshape(shape)
+    sl = [slice(None)]
+    for (lo, hi), dim in zip(crops, shape[1:1 + len(block)]):
+        sl.append(slice(lo, dim - hi))
+    sl += [slice(None)] * len(rest)
+    return x[tuple(sl)]
+
+
+@_op("space_to_batch")
+def space_to_batch(x, *, block_shape, paddings):
+    """space_to_batch_nd (generic/parity_ops/space_to_batch_nd.cpp)."""
+    block = list(block_shape)
+    pads = [(0, 0)] + [tuple(p) for p in paddings] + \
+        [(0, 0)] * (x.ndim - 1 - len(block))
+    x = jnp.pad(x, pads)
+    n = x.shape[0]
+    spatial = x.shape[1:1 + len(block)]
+    rest = x.shape[1 + len(block):]
+    shape = (n,)
+    for s, b in zip(spatial, block):
+        shape += (s // b, b)
+    shape += tuple(rest)
+    x = x.reshape(shape)
+    perm = []
+    for i in range(len(block)):
+        perm.append(2 + 2 * i)
+    perm.append(0)
+    for i in range(len(block)):
+        perm.append(1 + 2 * i)
+    perm += list(range(1 + 2 * len(block), x.ndim))
+    x = x.transpose(perm)
+    return x.reshape((n * int(np.prod(block)),) +
+                     tuple(s // b for s, b in zip(spatial, block)) + tuple(rest))
+
+
+@_op("diag")
+def diag(x):
+    """vector → diagonal matrix (generic/parity_ops/diag.cpp)."""
+    return jnp.diag(x)
+
+
+@_op("diag_part")
+def diag_part(x):
+    """matrix diagonal (generic/parity_ops/diag_part.cpp)."""
+    return jnp.diagonal(x)
+
+
+@_op("matrix_diag")
+def matrix_diag(x):
+    """batched vector → diagonal matrices (parity_ops/matrix_diag.cpp)."""
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    return x[..., None] * eye
+
+
+@_op("matrix_band_part")
+def matrix_band_part(x, *, num_lower: int, num_upper: int):
+    """keep a band of the matrix (parity_ops/matrix_band_part.cpp);
+    negative bound = keep whole triangle."""
+    m, n = x.shape[-2], x.shape[-1]
+    rows = jnp.arange(m)[:, None]
+    cols = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if num_lower >= 0:
+        keep = keep & (rows - cols <= num_lower)
+    if num_upper >= 0:
+        keep = keep & (cols - rows <= num_upper)
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+@_op("trace")
+def trace(x):
+    """matrix trace (NDArray trace analog)."""
+    return jnp.trace(x, axis1=-2, axis2=-1)
+
+
+@_op("eye")
+def eye(*, rows: int, cols=None, dtype=jnp.float32):
+    """identity matrix (generic/parity_ops/eye.cpp)."""
+    return jnp.eye(rows, cols, dtype=dtype)
+
+
+@_op("sequence_mask")
+def sequence_mask(lengths, *, maxlen: int, dtype=jnp.float32):
+    """sequence_mask (generic/parity_ops/sequence_mask.cpp)."""
+    return (jnp.arange(maxlen)[None, :] < lengths[:, None]).astype(dtype)
+
+
+@_op("reverse_sequence")
+def reverse_sequence(x, lengths, *, seq_axis: int = 1, batch_axis: int = 0):
+    """reverse the first lengths[i] entries of every sequence
+    (generic/parity_ops/reverse_sequence.cpp)."""
+    xm = jnp.moveaxis(x, (batch_axis, seq_axis), (0, 1))
+    t = xm.shape[1]
+    idx = jnp.arange(t)[None, :]
+    rev = lengths[:, None] - 1 - idx
+    take = jnp.where(idx < lengths[:, None], rev, idx)
+    out = jnp.take_along_axis(
+        xm, take.reshape(take.shape + (1,) * (xm.ndim - 2)), axis=1)
+    return jnp.moveaxis(out, (0, 1), (batch_axis, seq_axis))
+
+
+# --------------------------------------------------------------------------
+# validation cases
+# --------------------------------------------------------------------------
+
+
+def _r(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _add(name, fn):
+    validation.add_case(name, fn)
+
+
+_add("reshape", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("reshape", jnp.arange(12), shape=(3, 4))),
+    np.arange(12).reshape(3, 4)))
+_add("permute", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("permute", jnp.asarray(_r().randn(2, 3, 4).astype(np.float32)), axes=(2, 0, 1))),
+    _r().randn(2, 3, 4).astype(np.float32).transpose(2, 0, 1)))
+_add("transpose", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("transpose", jnp.asarray(_r(1).randn(2, 5).astype(np.float32)))),
+    _r(1).randn(2, 5).astype(np.float32).T))
+_add("expand_dims", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("expand_dims", jnp.arange(4), axis=0)).shape, (1, 4)))
+_add("squeeze", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("squeeze", jnp.zeros((2, 1, 3)))).shape, (2, 3)))
+_add("concat", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("concat", jnp.ones((2, 2)), jnp.zeros((1, 2)), axis=0)),
+    np.concatenate([np.ones((2, 2)), np.zeros((1, 2))], 0)))
+_add("stack", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("stack", jnp.ones(3), jnp.zeros(3), axis=0)),
+    np.stack([np.ones(3), np.zeros(3)])))
+
+
+@validation.case("unstack")
+def _check_unstack():
+    x = _r(2).randn(3, 4).astype(np.float32)
+    parts = _REG.exec("unstack", jnp.asarray(x), axis=0)
+    assert len(parts) == 3
+    for i, p in enumerate(parts):
+        np.testing.assert_array_equal(np.asarray(p), x[i])
+
+
+@validation.case("split")
+def _check_split():
+    x = _r(3).randn(6, 4).astype(np.float32)
+    parts = _REG.exec("split", jnp.asarray(x), num_split=3, axis=0)
+    for got, want in zip(parts, np.split(x, 3, axis=0)):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+@validation.case("split_v")
+def _check_split_v():
+    x = _r(4).randn(7, 2).astype(np.float32)
+    parts = _REG.exec("split_v", jnp.asarray(x), sizes=[2, 4, 1], axis=0)
+    for got, want in zip(parts, np.split(x, [2, 6], axis=0)):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+@validation.case("slice")
+def _check_slice():
+    x = _r(5).randn(5, 6).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("slice", jnp.asarray(x), begin=[1, 2], size=[3, -1])),
+        x[1:4, 2:])
+
+
+@validation.case("strided_slice")
+def _check_strided():
+    x = _r(6).randn(6, 8).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("strided_slice", jnp.asarray(x),
+                             begin=[0, 1], end=[5, 7], strides=[2, 3])),
+        x[0:5:2, 1:7:3])
+
+
+@validation.case("gather_nd")
+def _check_gather_nd():
+    x = _r(7).randn(4, 5).astype(np.float32)
+    idx = np.asarray([[0, 1], [3, 2]], np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("gather_nd", jnp.asarray(x), jnp.asarray(idx))),
+        x[idx[:, 0], idx[:, 1]])
+
+
+_add("repeat", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("repeat", jnp.arange(3), repeats=2, axis=0)),
+    np.repeat(np.arange(3), 2)))
+_add("tile", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("tile", jnp.arange(3), reps=(2,))),
+    np.tile(np.arange(3), 2)))
+
+
+@validation.case("pad")
+def _check_pad():
+    x = _r(8).randn(2, 3).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("pad", jnp.asarray(x), paddings=[(1, 0), (0, 2)],
+                             constant=7.0)),
+        np.pad(x, [(1, 0), (0, 2)], constant_values=7.0))
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("pad", jnp.asarray(x), paddings=[(1, 1), (1, 1)],
+                             mode="reflect")),
+        np.pad(x, [(1, 1), (1, 1)], mode="reflect"))
+
+
+_add("reverse", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("reverse", jnp.arange(6).reshape(2, 3), axis=1)),
+    np.flip(np.arange(6).reshape(2, 3), 1)))
+_add("rank", lambda: np.testing.assert_array_equal(
+    int(_REG.exec("rank", jnp.zeros((2, 3, 4)))), 3))
+_add("shape_of", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("shape_of", jnp.zeros((2, 3)))), [2, 3]))
+_add("size", lambda: np.testing.assert_array_equal(
+    int(_REG.exec("size", jnp.zeros((2, 3)))), 6))
+_add("zeros_like", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("zeros_like", jnp.ones((2, 2)))), np.zeros((2, 2))))
+_add("ones_like", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("ones_like", jnp.zeros((2, 2)))), np.ones((2, 2))))
+_add("fill", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("fill", shape=(2, 3), value=5.0)), np.full((2, 3), 5.0)))
+_add("linspace", lambda: np.testing.assert_allclose(
+    np.asarray(_REG.exec("linspace", start=0.0, stop=1.0, num=5)),
+    np.linspace(0, 1, 5, dtype=np.float32), rtol=1e-6))
+_add("range", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("range", start=1, limit=7, delta=2)),
+    np.arange(1, 7, 2, dtype=np.float32)))
+_add("broadcast_to", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("broadcast_to", jnp.arange(3), shape=(2, 3))),
+    np.broadcast_to(np.arange(3), (2, 3))))
+
+
+@validation.case("space_to_depth")
+def _check_s2d():
+    import tensorflow as tf
+
+    x = _r(9).randn(2, 4, 4, 3).astype(np.float32)
+    got = np.asarray(_REG.exec("space_to_depth", jnp.asarray(x), block_size=2))
+    want = tf.nn.space_to_depth(x, 2).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+@validation.case("depth_to_space")
+def _check_d2s():
+    import tensorflow as tf
+
+    x = _r(10).randn(2, 2, 2, 12).astype(np.float32)
+    got = np.asarray(_REG.exec("depth_to_space", jnp.asarray(x), block_size=2))
+    want = tf.nn.depth_to_space(x, 2).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+@validation.case("space_to_batch")
+def _check_s2b():
+    import tensorflow as tf
+
+    x = _r(11).randn(1, 4, 4, 2).astype(np.float32)
+    got = np.asarray(_REG.exec("space_to_batch", jnp.asarray(x),
+                               block_shape=[2, 2], paddings=[(0, 0), (0, 0)]))
+    want = tf.space_to_batch_nd(x, [2, 2], [[0, 0], [0, 0]]).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+@validation.case("batch_to_space")
+def _check_b2s():
+    import tensorflow as tf
+
+    x = _r(12).randn(4, 2, 2, 3).astype(np.float32)
+    got = np.asarray(_REG.exec("batch_to_space", jnp.asarray(x),
+                               block_shape=[2, 2], crops=[(0, 0), (0, 0)]))
+    want = tf.batch_to_space(x, [2, 2], [[0, 0], [0, 0]]).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+_add("diag", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("diag", jnp.arange(3))), np.diag(np.arange(3))))
+_add("diag_part", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("diag_part", jnp.arange(9).reshape(3, 3))),
+    np.diagonal(np.arange(9).reshape(3, 3))))
+
+
+@validation.case("matrix_diag")
+def _check_matrix_diag():
+    x = _r(13).randn(2, 3).astype(np.float32)
+    got = np.asarray(_REG.exec("matrix_diag", jnp.asarray(x)))
+    want = np.stack([np.diag(row) for row in x])
+    np.testing.assert_array_equal(got, want)
+
+
+@validation.case("matrix_band_part")
+def _check_band():
+    import tensorflow as tf
+
+    x = _r(14).randn(5, 5).astype(np.float32)
+    got = np.asarray(_REG.exec("matrix_band_part", jnp.asarray(x),
+                               num_lower=1, num_upper=2))
+    want = tf.linalg.band_part(x, 1, 2).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+_add("trace", lambda: np.testing.assert_allclose(
+    float(_REG.exec("trace", jnp.arange(9.0).reshape(3, 3))),
+    np.trace(np.arange(9.0).reshape(3, 3)), rtol=1e-6))
+_add("eye", lambda: np.testing.assert_array_equal(
+    np.asarray(_REG.exec("eye", rows=3, cols=4)), np.eye(3, 4)))
+
+
+@validation.case("sequence_mask")
+def _check_seq_mask():
+    got = np.asarray(_REG.exec("sequence_mask", jnp.asarray([1, 3]), maxlen=4))
+    np.testing.assert_array_equal(got, [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+@validation.case("reverse_sequence")
+def _check_rev_seq():
+    import tensorflow as tf
+
+    x = _r(15).randn(3, 5, 2).astype(np.float32)
+    lengths = np.asarray([2, 5, 3], np.int32)
+    got = np.asarray(_REG.exec("reverse_sequence", jnp.asarray(x),
+                               jnp.asarray(lengths)))
+    want = tf.reverse_sequence(x, lengths, seq_axis=1, batch_axis=0).numpy()
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,48 @@
+"""Per-op validation registry — the OpValidation ratchet (SURVEY §5.2).
+
+Reference parity: ND4J's OpValidation framework
+(nd4j/nd4j-backends/nd4j-tests/.../OpValidationSuite) tracks which declarable
+ops have gradient/equality checks and FAILS the build for ops with none —
+"coverage is asserted, not hoped for". Here every registered op must own at
+least one validation case: a callable that executes the op and asserts
+against an independent oracle (usually numpy). tests/test_op_validation.py
+enforces the ratchet:
+
+  * every name in the op registry has >= 1 case,
+  * every case passes on the CPU backend,
+  * (chip runs) table-driven cases double as CPU-vs-TPU consistency fodder.
+
+Cases register via :func:`case` (decorator) or :func:`add_case`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+_CASES: Dict[str, List[Callable[[], None]]] = {}
+
+
+def case(op_name: str):
+    """Decorator: register fn as a validation case for ``op_name``."""
+
+    def deco(fn: Callable[[], None]) -> Callable[[], None]:
+        _CASES.setdefault(op_name, []).append(fn)
+        return fn
+
+    return deco
+
+
+def add_case(op_name: str, fn: Callable[[], None]) -> None:
+    _CASES.setdefault(op_name, []).append(fn)
+
+
+def cases() -> Dict[str, List[Callable[[], None]]]:
+    """All registered validation cases (name -> list of runnables)."""
+    return _CASES
+
+
+def uncovered_ops() -> List[str]:
+    """Registered ops with no validation case — the ratchet's red list."""
+    from deeplearning4j_tpu.ops.registry import registry
+
+    return [n for n in registry().names() if not _CASES.get(n)]

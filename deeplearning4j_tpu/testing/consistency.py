@@ -115,6 +115,39 @@ def _cases() -> List[Case]:
                                         block_k=64, dropout_rate=0.2),
         arr(4, 128, 32), arr(4, 128, 32), arr(4, 128, 32), grad=True)
 
+    # ---- new declarable-op families (round 3): one representative
+    # CPU-vs-TPU case per family, exercising the same registry path users
+    # hit via exec_op ------------------------------------------------------
+    idx = jnp.asarray(np.array([5, 0, 2], np.int32))
+    add("scatter_add_op", lambda ref, u: exec_op("scatter_add", ref, idx, u),
+        arr(6, 8), arr(3, 8))
+    seg_ids = jnp.asarray(np.array([0, 0, 1, 2, 2, 2], np.int32))
+    add("segment_sum_op", lambda d: exec_op("segment_sum", d, seg_ids,
+                                            num_segments=3), arr(6, 16))
+    add("top_k_op", lambda x: exec_op("top_k", x, k=4)[0], arr(8, 32))
+    add("resize_bilinear_op",
+        lambda x: exec_op("resize_bilinear", jnp.abs(x), size=(7, 9)),
+        arr(2, 14, 18, 3))
+    add("cholesky_op",
+        lambda a: exec_op("cholesky", a @ a.T + 8 * jnp.eye(8)), arr(8, 8),
+        rtol=5e-2, atol=5e-2)  # decomposition conditioning, not MXU error
+    add("solve_op",
+        lambda a, b: exec_op("solve", a @ a.T + 8 * jnp.eye(8), b),
+        arr(8, 8), arr(8, 2))
+    ctc_logits = arr(2, 12, 6)
+    ctc_labels = jnp.asarray(np.array([[1, 2, 3], [4, 5, 0]], np.int32))
+    add("ctc_loss_op",
+        lambda lg: exec_op("ctc_loss", lg, ctc_labels,
+                           jnp.asarray(np.array([12, 10], np.int32)),
+                           jnp.asarray(np.array([3, 2], np.int32))),
+        ctc_logits, grad=True)
+    add("cumprod_op", lambda x: exec_op("cumprod", x, axis=1, exclusive=True),
+        arr(4, 16))
+    add("space_to_depth_op",
+        lambda x: exec_op("space_to_depth", x, block_size=2), arr(2, 8, 8, 4))
+    add("reduce_logsumexp_op",
+        lambda x: exec_op("reduce_logsumexp", x, axis=1), arr(8, 64))
+
     # full-layer forward: LeNet-sized conv net output
     def lenet_fwd():
         from deeplearning4j_tpu import models

@@ -1,0 +1,79 @@
+"""The OpValidation ratchet (SURVEY §5.2): every registered declarable op
+must have at least one validation case, and every case must pass.
+
+Mirrors ND4J's OpValidationSuite "coverage is asserted" pattern: the first
+test FAILS THE BUILD if an op is registered without a case, so the catalog
+cannot grow unvalidated.
+"""
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu  # noqa: F401 — populates the registry
+from deeplearning4j_tpu.ops import validation
+from deeplearning4j_tpu.ops.registry import registry
+
+# Ops predating the ratchet whose coverage lives in dedicated test files
+# (tests/test_ops.py, test_nn_layers.py, test_pallas_attention.py, …).
+# Do NOT add new ops here — new registrations must ship validation cases.
+_LEGACY_COVERED = {
+    "avgpool2d", "batchnorm", "clip_by_norm", "clip_by_value", "conv1d",
+    "conv2d", "conv3d", "decode_bitmap", "decode_threshold", "deconv2d",
+    "depthwise_conv2d", "dot_product_attention", "dropout", "encode_bitmap", "encode_threshold",
+    "embedding_lookup", "gather", "global_avg_pool", "global_max_pool",
+    "gru_cell", "im2col", "layer_norm", "log_softmax_op", "lrn", "lstm_cell",
+    "matmul", "maxpool2d", "multi_head_dot_product_attention", "one_hot",
+    "pnormpool2d", "random_bernoulli", "random_exponential", "random_gamma",
+    "random_normal", "random_truncated_normal", "random_uniform", "sconv2d",
+    "simple_rnn_cell", "softmax_op", "standardize", "upsampling2d",
+    "xw_plus_b",
+}
+
+
+def test_catalog_size():
+    """Breadth ratchet: the catalog must not shrink below its high-water
+    mark (round-3 target: >=150 named declarable ops vs the reference's
+    ~270; round 2 sat at 42)."""
+    n = len(registry().names())
+    assert n >= 150, f"op catalog regressed: {n} < 150"
+
+
+def test_every_op_has_validation_case():
+    uncovered = [n for n in validation.uncovered_ops()
+                 if n not in _LEGACY_COVERED]
+    assert not uncovered, (
+        f"{len(uncovered)} registered ops lack validation cases: "
+        f"{sorted(uncovered)} — add a numpy-oracle case via "
+        "ops.validation.add_case when registering an op")
+
+
+_ALL_CASES = [(name, i, fn)
+              for name, fns in sorted(validation.cases().items())
+              for i, fn in enumerate(fns)]
+
+
+@pytest.mark.parametrize("name,i,fn", _ALL_CASES,
+                         ids=[f"{n}[{i}]" for n, i, _ in _ALL_CASES])
+def test_validation_case(name, i, fn):
+    fn()
+
+
+def test_shape_function_agrees_with_execution():
+    """calculate_output_shape (DeclarableOp shape-fn analog) must match the
+    executed shape for a sample of multi-shape ops."""
+    import jax
+    import jax.numpy as jnp
+
+    reg = registry()
+    samples = [
+        ("reduce_sum", (jnp.ones((3, 4, 5)),), {"axis": 1}),
+        ("top_k", (jnp.ones((2, 9)),), {"k": 3}),
+        ("space_to_depth", (jnp.ones((1, 4, 4, 2)),), {"block_size": 2}),
+        ("cholesky", (jnp.eye(4),), {}),
+    ]
+    for name, args, kwargs in samples:
+        want = reg.exec(name, *args, **kwargs)
+        got = reg.calculate_output_shape(name, *args, **kwargs)
+        flat_w = jax.tree.leaves(want)
+        flat_g = jax.tree.leaves(got)
+        assert [w.shape for w in flat_w] == [g.shape for g in flat_g], name
