@@ -259,7 +259,10 @@ GRAPH_OPS: Dict[str, Callable[..., Any]] = {
     "reduce_var": _reduce(jnp.var),
     "argmax": lambda a, *, axis=-1: jnp.argmax(a, axis=axis),
     "argmin": lambda a, *, axis=-1: jnp.argmin(a, axis=axis),
-    "cumsum": lambda a, *, axis=0: jnp.cumsum(a, axis=axis),
+    "cumsum": lambda a, *, axis=0, exclusive=False, reverse=False:
+        _cumsum_flags(a, axis, exclusive, reverse),
+    "zeros_like": jnp.zeros_like,
+    "ones_like": jnp.ones_like,
     "norm2": lambda a, *, axes=None: jnp.sqrt(jnp.sum(a**2, axis=None if not axes else tuple(axes))),
     # nn composites
     "linear": lambda x, w, b=None: (x @ w + b) if b is not None else x @ w,
@@ -276,6 +279,17 @@ GRAPH_OPS: Dict[str, Callable[..., Any]] = {
     "huber_loss": lambda pred, labels, *, delta=1.0: _huber(pred, labels, delta),
     "cosine_distance": lambda a, b: loss_lib.cosine_proximity(a, b),
 }
+
+
+def _cumsum_flags(a, axis, exclusive, reverse):
+    if reverse:
+        a = jnp.flip(a, axis=axis)
+    out = jnp.cumsum(a, axis=axis)
+    if exclusive:
+        out = out - a
+    if reverse:
+        out = jnp.flip(out, axis=axis)
+    return out
 
 
 def _layer_norm(x, gain, bias, axis, eps):
@@ -611,21 +625,35 @@ class SameDiff:
         keep.reverse()
         return keep
 
+    def _precision_policy(self) -> str:
+        """Dtype policy the graph's float variables imply — f32 graphs get
+        f32 MXU math (nn.dtype.precision_scope), same as the network
+        classes' forward chokepoints. Imported f32 models (TF/ONNX golden
+        parity) would otherwise silently run bf16-class matmuls on TPU."""
+        for a in self._arrays.values():
+            dt = getattr(a, "dtype", None)
+            if dt is not None and dt in (jnp.bfloat16, jnp.float16):
+                return "bfloat16"
+        return "float32"
+
     def _interpret(self, env: Dict[str, Any], wanted: Sequence[str]) -> Dict[str, Any]:
         """Run the needed subgraph in order (pure; called under trace/jit)."""
-        for node in self._needed_nodes(wanted):
-            if not all(i in env for i in node.inputs):
-                missing = [i for i in node.inputs if i not in env]
-                raise KeyError(
-                    f"op '{node.op}' needs {missing}; placeholders not fed or "
-                    f"graph out of order")
-            fn = resolve_graph_op(node.op, self._local_ops)
-            res = fn(*[env[i] for i in node.inputs], **node.kwargs)
-            if len(node.outputs) == 1:
-                env[node.outputs[0]] = res
-            else:
-                for o, r in zip(node.outputs, res):
-                    env[o] = r
+        from deeplearning4j_tpu.nn import dtype as DT
+
+        with DT.precision_scope(self._precision_policy()):
+            for node in self._needed_nodes(wanted):
+                if not all(i in env for i in node.inputs):
+                    missing = [i for i in node.inputs if i not in env]
+                    raise KeyError(
+                        f"op '{node.op}' needs {missing}; placeholders not fed or "
+                        f"graph out of order")
+                fn = resolve_graph_op(node.op, self._local_ops)
+                res = fn(*[env[i] for i in node.inputs], **node.kwargs)
+                if len(node.outputs) == 1:
+                    env[node.outputs[0]] = res
+                else:
+                    for o, r in zip(node.outputs, res):
+                        env[o] = r
         return {w: env[w] for w in wanted}
 
     def _exec_fn(self, out_names: Tuple[str, ...]):

@@ -1,7 +1,14 @@
-"""Model import — TF GraphDef → SameDiff (samediff-import role)."""
+"""Model import — TF GraphDef / ONNX ModelProto → SameDiff
+(samediff-import role: shared IR layer + per-framework dialect tables)."""
 
+from deeplearning4j_tpu.imports.ir import IRGraph, IRImporter, IRNode
 from deeplearning4j_tpu.imports.tf_import import (
     TensorflowImporter,
     import_frozen_graph,
     register_tf_op,
+)
+from deeplearning4j_tpu.imports.onnx_import import (
+    OnnxImporter,
+    import_onnx,
+    register_onnx_op,
 )

@@ -1,0 +1,104 @@
+"""Framework-neutral import IR — the samediff-import framework analog.
+
+Reference parity: the reference's Kotlin IR import stack
+(nd4j/samediff-import/samediff-import-api — FrameworkImporter,
+MappingProcess, IRGraph/IRNode abstractions) normalizes TF and ONNX graphs
+into one node/attribute shape, then per-op mapping rules translate to
+SameDiff. This module is that layer: TF GraphDefs and ONNX ModelProtos
+both lower into :class:`IRGraph`, and :class:`IRImporter` owns the shared
+walk (constants → variables, placeholders, topological dispatch, output
+renaming) that was previously TF-private — so a new frontend only writes
+(a) a parser to IRGraph and (b) a dialect rule table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+
+
+@dataclasses.dataclass
+class IRNode:
+    """One computation node, framework-normalized."""
+
+    name: str
+    op_type: str
+    inputs: List[str]
+    outputs: List[str]
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    # back-compat shim: TF mappers historically read node.input[i]
+    @property
+    def input(self) -> List[str]:
+        return self.inputs
+
+
+@dataclasses.dataclass
+class IRGraph:
+    """Normalized graph: nodes in topological-ish file order + tensors."""
+
+    nodes: List[IRNode]
+    initializers: Dict[str, np.ndarray]
+    inputs: List[Tuple[str, Optional[Tuple[Optional[int], ...]]]]
+    outputs: List[str]
+    name: str = "imported"
+
+
+class IRImporter:
+    """Shared rule-dispatch walker (MappingProcess executor analog).
+
+    ``rules``: op_type -> fn(sd, ins, attrs, node, const_values=...) -> SDVariable.
+    Rules listed in ``needs_consts`` additionally receive the raw numpy
+    values of constant operands (shape/perm/axis inputs).
+    """
+
+    def __init__(self, rules: Dict[str, Callable[..., Any]],
+                 needs_consts: Sequence[str] = (),
+                 trainable_consts: bool = True):
+        self.rules = dict(rules)
+        self.needs_consts = set(needs_consts)
+        self.trainable_consts = trainable_consts
+
+    def supported_ops(self) -> List[str]:
+        return sorted(self.rules)
+
+    def run_import(self, ir: IRGraph) -> SameDiff:
+        sd = SameDiff.create()
+        produced: Dict[str, SDVariable] = {}
+        const_values: Dict[str, np.ndarray] = dict(ir.initializers)
+
+        for name, arr in ir.initializers.items():
+            if (self.trainable_consts and
+                    np.issubdtype(arr.dtype, np.floating) and arr.size > 1):
+                produced[name] = sd.var(name, arr)
+            else:
+                produced[name] = sd.constant(name, arr)
+        for name, shape in ir.inputs:
+            produced[name] = sd.placeholder(name, shape=shape)
+
+        for node in ir.nodes:
+            rule = self.rules.get(node.op_type)
+            if rule is None:
+                raise NotImplementedError(
+                    f"op '{node.op_type}' (node {node.name}) has no mapping "
+                    f"rule; register one in the {ir.name} dialect table")
+            ins = [produced[n] for n in node.inputs if n in produced]
+            if node.op_type in self.needs_consts:
+                out = rule(sd, ins, node.attrs, node, const_values=const_values)
+            else:
+                out = rule(sd, ins, node.attrs, node)
+            if out is None:
+                continue
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            names = node.outputs or [node.name]
+            for o, oname in zip(outs, names):
+                if o.vtype == "ARRAY" and oname not in sd._vars:
+                    o.rename(oname)
+                produced[oname] = o
+            # the node's own name also resolves (TF addressing convention)
+            produced.setdefault(node.name, outs[0])
+        return sd

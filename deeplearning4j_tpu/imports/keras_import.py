@@ -156,24 +156,24 @@ def _lstm(cfg, weights):
     return lc, {"W": regate(kernel), "RW": regate(recurrent), "b": regate(bias)}
 
 
-def import_keras_model(model, input_type: Optional[C.InputType] = None) -> nn.MultiLayerNetwork:
-    """In-memory tf.keras Sequential → MultiLayerNetwork (the
-    KerasModelImport.importKerasSequentialModelAndWeights role)."""
+def _assemble_sequential(specs, input_type) -> nn.MultiLayerNetwork:
+    """Shared Sequential assembly + weight grafting: specs are
+    (class_name, layer_cfg, weights) triples from EITHER a live keras model
+    or an own-parsed h5 config. Keras flattens conv activations HWC-major
+    while our CnnToFeedForward preprocessor flattens CHW-major, so the
+    input rows of a Dense W sitting right after that preprocessor are
+    reordered during grafting."""
+    import jax.numpy as jnp
+
     layer_confs: List[C.LayerConf] = []
     params_list: List[Dict[str, Any]] = []
     states_list: List[Dict[str, Any]] = []
-    input_shape = None
-    for kl in model.layers:
-        cfg = kl.get_config()
-        cls = type(kl).__name__
-        if cls == "InputLayer":
-            continue
+    for cls, cfg, weights in specs:
         mapper = KerasLayerMapper.MAPPERS.get(cls)
         if mapper is None:
             raise NotImplementedError(
                 f"Keras layer '{cls}' has no import mapper; register one on "
                 f"KerasLayerMapper")
-        weights = [np.asarray(w) for w in kl.get_weights()]
         lc, p = mapper(cfg, weights)
         if lc == "FLATTEN":
             continue  # shape inference inserts CnnToFeedForward automatically
@@ -184,39 +184,41 @@ def import_keras_model(model, input_type: Optional[C.InputType] = None) -> nn.Mu
         layer_confs.append(lc)
         params_list.append(p)
         states_list.append(state)
-    if input_type is None:
-        shape = model.input_shape  # (None, ...) tuple
-        if len(shape) == 2:
-            input_type = C.InputType.feed_forward(shape[1])
-        elif len(shape) == 4:
-            input_type = C.InputType.convolutional(shape[1], shape[2], shape[3])
-        elif len(shape) == 3:
-            input_type = C.InputType.recurrent(shape[2])
-        else:
-            raise ValueError(f"cannot infer InputType from {shape}")
     b = nn.builder().list()
     for lc in layer_confs:
         b.layer(lc)
     conf = b.set_input_type(input_type).build()
     net = nn.MultiLayerNetwork(conf).init()
-    # graft imported weights. Keras flattens conv activations HWC-major; our
-    # CnnToFeedForward preprocessor flattens CHW-major — reorder the input
-    # rows of any Dense W that sits right after that preprocessor.
-    import jax.numpy as jnp
-
     for i, (lc, p, st) in enumerate(zip(layer_confs, params_list, states_list)):
         pre = net.conf.preprocessors.get(i)
         for k, w in p.items():
             if (k == "W" and isinstance(pre, C.CnnToFeedForwardPreProcessor)
-                    and w.ndim == 2
+                    and hasattr(w, "ndim") and w.ndim == 2
                     and w.shape[0] == pre.height * pre.width * pre.channels):
                 w = (w.reshape(pre.height, pre.width, pre.channels, -1)
                      .transpose(2, 0, 1, 3)
                      .reshape(w.shape[0], -1))
-            net.params[i][k] = jnp.asarray(w)
+            net.params[i][k] = (
+                {kk: jnp.asarray(vv) for kk, vv in w.items()}
+                if isinstance(w, dict) else jnp.asarray(w))
         for k, v in st.items():
             net.net_state[i][k] = jnp.asarray(v)
     return net
+
+
+def import_keras_model(model, input_type: Optional[C.InputType] = None) -> nn.MultiLayerNetwork:
+    """In-memory tf.keras Sequential → MultiLayerNetwork (the
+    KerasModelImport.importKerasSequentialModelAndWeights role)."""
+    specs = []
+    for kl in model.layers:
+        cls = type(kl).__name__
+        if cls == "InputLayer":
+            continue
+        specs.append((cls, kl.get_config(),
+                      [np.asarray(w) for w in kl.get_weights()]))
+    if input_type is None:
+        input_type = _infer_input_type_from_shape(model.input_shape)
+    return _assemble_sequential(specs, input_type)
 
 
 def import_keras_sequential_model_and_weights(h5_path: str) -> nn.MultiLayerNetwork:
@@ -226,3 +228,327 @@ def import_keras_sequential_model_and_weights(h5_path: str) -> nn.MultiLayerNetw
 
     model = tf.keras.models.load_model(h5_path, compile=False)
     return import_keras_model(model)
+
+
+# ---------------------------------------------------------------------------
+# Widened mapper table (round 3): conv variants, poolings, RNNs, advanced
+# activations — KerasLayer subclass coverage toward the reference's ~100.
+# ---------------------------------------------------------------------------
+
+
+@KerasLayerMapper.register("DepthwiseConv2D")
+def _depthwise(cfg, weights):
+    k = _pair(cfg["kernel_size"])
+    dw = weights[0]  # (kh, kw, C, mult) — matches our layout
+    lc = C.DepthwiseConvolution2D(
+        n_in=dw.shape[2], n_out=dw.shape[2] * dw.shape[3], kernel=k,
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=cfg.get("padding", "valid"),
+        activation=_act(cfg), has_bias=cfg.get("use_bias", True),
+        depth_multiplier=dw.shape[3])
+    p = {"W": dw}
+    if cfg.get("use_bias", True) and len(weights) > 1:
+        p["b"] = weights[1]
+    return lc, p
+
+
+@KerasLayerMapper.register("SeparableConv2D")
+def _separable(cfg, weights):
+    k = _pair(cfg["kernel_size"])
+    dw, pw = weights[0], weights[1]  # (kh,kw,C,mult), (1,1,C*mult,out)
+    lc = C.SeparableConvolution2D(
+        n_in=dw.shape[2], n_out=pw.shape[3], kernel=k,
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=cfg.get("padding", "valid"),
+        activation=_act(cfg), has_bias=cfg.get("use_bias", True),
+        depth_multiplier=dw.shape[3])
+    p = {"dW": dw, "pW": pw}
+    if cfg.get("use_bias", True) and len(weights) > 2:
+        p["b"] = weights[2]
+    return lc, p
+
+
+@KerasLayerMapper.register("Conv2DTranspose")
+def _deconv(cfg, weights):
+    k = _pair(cfg["kernel_size"])
+    w = weights[0]  # keras: (kh, kw, out, in) → ours: (kh, kw, in, out)
+    lc = C.Deconvolution2D(
+        n_in=w.shape[3], n_out=w.shape[2], kernel=k,
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=cfg.get("padding", "valid"),
+        activation=_act(cfg), has_bias=cfg.get("use_bias", True))
+    p = {"W": w.transpose(0, 1, 3, 2)}
+    if cfg.get("use_bias", True) and len(weights) > 1:
+        p["b"] = weights[1]
+    return lc, p
+
+
+@KerasLayerMapper.register("GlobalMaxPooling2D")
+def _gmp(cfg, weights):
+    return C.GlobalPoolingLayer(pooling_type="max"), {}
+
+
+@KerasLayerMapper.register("UpSampling2D")
+def _upsampling(cfg, weights):
+    return C.Upsampling2D(size=_pair(cfg.get("size", 2))), {}
+
+
+@KerasLayerMapper.register("SimpleRNN")
+def _simple_rnn(cfg, weights):
+    w, rw, b = weights[0], weights[1], (weights[2] if len(weights) > 2
+                                        else np.zeros(weights[0].shape[1]))
+    lc = C.SimpleRnn(n_in=w.shape[0], n_out=w.shape[1],
+                     activation=_act(cfg))
+    return lc, {"W": w, "RW": rw, "b": b}
+
+
+@KerasLayerMapper.register("Bidirectional")
+def _bidirectional(cfg, weights):
+    inner_spec = cfg["layer"]
+    if inner_spec["class_name"] != "LSTM":
+        raise NotImplementedError(
+            f"Bidirectional({inner_spec['class_name']}) import")
+    half = len(weights) // 2
+    inner_cfg = inner_spec["config"]
+    fwd_lc, fwd_p = _lstm(inner_cfg, weights[:half])
+    _, bwd_p = _lstm(inner_cfg, weights[half:])
+    merge = cfg.get("merge_mode", "concat")
+    mode = {"sum": "add", "ave": "average", "mul": "mul",
+            "concat": "concat", "add": "add", "average": "average"}.get(merge)
+    if mode is None:
+        raise NotImplementedError(
+            f"Bidirectional merge_mode={merge!r} import (None means "
+            "two-output mode, which MultiLayerNetwork cannot represent)")
+    lc = C.Bidirectional(fwd=fwd_lc.to_dict(), mode=mode)
+    return lc, {"fwd": fwd_p, "bwd": bwd_p}
+
+
+@KerasLayerMapper.register("LeakyReLU")
+def _leaky_relu(cfg, weights):
+    # keras defaults alpha=0.3 (ours 0.01) — bind the exact slope as a
+    # callable activation (get_activation passes callables through)
+    import functools
+
+    from deeplearning4j_tpu.ops.activations import leakyrelu
+
+    alpha = float(cfg.get("negative_slope", cfg.get("alpha", 0.3)))
+    return C.ActivationLayer(
+        activation=functools.partial(leakyrelu, alpha=alpha)), {}
+
+
+@KerasLayerMapper.register("ReLU")
+def _relu_layer(cfg, weights):
+    if cfg.get("max_value") not in (None, 0) or cfg.get("threshold", 0):
+        raise NotImplementedError("ReLU with max_value/threshold import")
+    slope = float(cfg.get("negative_slope", 0) or 0)
+    if slope:
+        import functools
+
+        from deeplearning4j_tpu.ops.activations import leakyrelu
+
+        return C.ActivationLayer(
+            activation=functools.partial(leakyrelu, alpha=slope)), {}
+    return C.ActivationLayer(activation="relu"), {}
+
+
+@KerasLayerMapper.register("ELU")
+def _elu_layer(cfg, weights):
+    return C.ActivationLayer(activation="elu"), {}
+
+
+@KerasLayerMapper.register("Softmax")
+def _softmax_layer(cfg, weights):
+    return C.ActivationLayer(activation="softmax"), {}
+
+
+@KerasLayerMapper.register("SpatialDropout2D")
+@KerasLayerMapper.register("GaussianDropout")
+def _spatial_dropout(cfg, weights):
+    return C.DropoutLayer(rate=float(cfg.get("rate", 0.5))), {}
+
+
+# ---------------------------------------------------------------------------
+# Own HDF5 reading (Hdf5Archive.java analog) — no tf.keras deserialization
+# ---------------------------------------------------------------------------
+
+
+def read_keras_h5(path: str):
+    """Parse a legacy Keras .h5 file with h5py directly: returns
+    (model_config dict, {layer_name: [weight arrays in weight_names order]}).
+
+    The reference's Hdf5Archive reads the same two pieces (model_config
+    JSON attr + model_weights groups) through the HDF5 C API."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs["model_config"]
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        config = json.loads(raw)
+        weights: Dict[str, List[np.ndarray]] = {}
+        mw = f["model_weights"]
+        for lname in mw:
+            g = mw[lname]
+            names = [n.decode() if isinstance(n, bytes) else str(n)
+                     for n in g.attrs.get("weight_names", [])]
+            arrs = []
+            for n in names:
+                node = g[n] if n in g else f["model_weights"][n]
+                arrs.append(np.asarray(node))
+            weights[lname] = arrs
+    return config, weights
+
+
+def _layer_specs_from_config(config):
+    """[(class_name, layer_cfg, layer_name)] from a Sequential config."""
+    out = []
+    for entry in config["config"]["layers"]:
+        cls = entry["class_name"]
+        cfg = entry.get("config", {})
+        out.append((cls, cfg, cfg.get("name", entry.get("name", ""))))
+    return out
+
+
+def _infer_input_type_from_shape(shape):
+    shape = tuple(shape)
+    if len(shape) == 2:
+        return C.InputType.feed_forward(shape[1])
+    if len(shape) == 4:
+        return C.InputType.convolutional(shape[1], shape[2], shape[3])
+    if len(shape) == 3:
+        return C.InputType.recurrent(shape[2])
+    raise ValueError(f"cannot infer InputType from {shape}")
+
+
+def import_keras_sequential_config(config, weights_map) -> nn.MultiLayerNetwork:
+    """Sequential model_config + weights dict → MultiLayerNetwork (the
+    own-h5 path; shares _assemble_sequential with the live-model path)."""
+    specs = []
+    input_shape = None
+    for cls, cfg, name in _layer_specs_from_config(config):
+        if cls == "InputLayer":
+            input_shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
+            continue
+        if input_shape is None and "batch_input_shape" in cfg:
+            input_shape = cfg["batch_input_shape"]
+        specs.append((cls, cfg, weights_map.get(name, [])))
+    return _assemble_sequential(
+        specs, _infer_input_type_from_shape(input_shape))
+
+
+# ---------------------------------------------------------------------------
+# Functional-API import → ComputationGraph (KerasModel.java analog)
+# ---------------------------------------------------------------------------
+
+_MERGE_LAYERS = {
+    "Add": ("elementwise", "add"),
+    "Subtract": ("elementwise", "subtract"),
+    "Multiply": ("elementwise", "product"),
+    "Average": ("elementwise", "average"),
+    "Maximum": ("elementwise", "max"),
+    "Concatenate": ("merge", None),
+}
+
+
+def _inbound_names(layer) -> List[str]:
+    """Input layer-names of a functional-config layer — handles both the
+    keras-3 __keras_tensor__ args form and the legacy nested-list form."""
+    names: List[str] = []
+
+    def walk(o):
+        if isinstance(o, dict):
+            if o.get("class_name") == "__keras_tensor__":
+                names.append(o["config"]["keras_history"][0])
+            else:
+                for v in o.values():
+                    walk(v)
+        elif isinstance(o, (list, tuple)):
+            if (len(o) >= 3 and isinstance(o[0], str)
+                    and isinstance(o[1], int)):
+                names.append(o[0])  # legacy ["name", node_idx, tensor_idx, {}]
+            else:
+                for v in o:
+                    walk(v)
+
+    walk(layer.get("inbound_nodes") or [])
+    return names
+
+
+def _out_names(spec) -> List[str]:
+    """Normalize input_layers/output_layers: 'n' | ['n',0,0] | [['n',0,0],…]."""
+    if isinstance(spec, str):
+        return [spec]
+    if (isinstance(spec, (list, tuple)) and spec
+            and isinstance(spec[0], str)):
+        return [spec[0]]
+    return [s[0] if isinstance(s, (list, tuple)) else s for s in (spec or [])]
+
+
+def import_keras_functional_config(config, weights_map):
+    """Functional model_config + weights → ComputationGraph."""
+    from deeplearning4j_tpu.nn import graph as G
+
+    gcfg = config["config"]
+    gb = G.graph_builder()
+    params_by_name: Dict[str, Dict[str, Any]] = {}
+    input_types: Dict[str, Any] = {}
+
+    for entry in gcfg["layers"]:
+        cls = entry["class_name"]
+        cfg = entry.get("config", {})
+        name = cfg.get("name", entry.get("name", ""))
+        inputs = _inbound_names(entry)
+        if cls == "InputLayer":
+            shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
+            gb.add_inputs(name)
+            input_types[name] = _infer_input_type_from_shape(shape)
+            continue
+        if cls in _MERGE_LAYERS:
+            kind, op = _MERGE_LAYERS[cls]
+            if kind == "merge":
+                gb.add_vertex(name, G.MergeVertex(), *inputs)
+            else:
+                gb.add_vertex(name, G.ElementWiseVertex(op=op), *inputs)
+            continue
+        if cls == "Flatten":
+            # our conv activations are NHWC like keras's — a batch-preserving
+            # flatten keeps keras Dense weight order (no CHW reorder needed)
+            gb.add_vertex(name, G.FlattenVertex(), *inputs)
+            continue
+        mapper = KerasLayerMapper.MAPPERS.get(cls)
+        if mapper is None:
+            raise NotImplementedError(
+                f"Keras layer '{cls}' has no import mapper (functional)")
+        lc, p = mapper(cfg, weights_map.get(name, []))
+        state = {}
+        if isinstance(p, dict) and "__params__" in p:
+            state = p["__state__"]
+            p = p["__params__"]
+        gb.add_layer(name, lc, *inputs)
+        params_by_name[name] = {"params": p, "state": state}
+
+    for out in _out_names(gcfg.get("output_layers")):
+        gb.set_outputs(out)
+    gb.set_input_types(**input_types)
+    net = G.ComputationGraph(gb.build()).init()
+
+    import jax.numpy as jnp
+
+    for name, blob in params_by_name.items():
+        for k, w in blob["params"].items():
+            net.params[name][k] = (
+                {kk: jnp.asarray(vv) for kk, vv in w.items()}
+                if isinstance(w, dict) else jnp.asarray(w))
+        for k, v in blob["state"].items():
+            net.net_state[name][k] = jnp.asarray(v)
+    return net
+
+
+def import_keras_model_and_weights(h5_path: str):
+    """KerasModelImport.importKerasModelAndWeights analog: reads the .h5
+    with h5py (own parsing — no tf.keras), dispatches Sequential →
+    MultiLayerNetwork / Functional → ComputationGraph."""
+    config, weights = read_keras_h5(h5_path)
+    if config.get("class_name") == "Sequential":
+        return import_keras_sequential_config(config, weights)
+    return import_keras_functional_config(config, weights)

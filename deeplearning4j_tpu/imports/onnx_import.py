@@ -1,0 +1,498 @@
+"""ONNX import → SameDiff graph (samediff-import-onnx analog).
+
+Reference parity: nd4j/samediff-import/samediff-import-onnx
+(OnnxFrameworkImporter.kt + onnx-mapping-ruleset.pbtxt): ONNX ModelProto →
+IR → per-op mapping rules → SameDiff. Here the ModelProto is decoded by
+the in-repo wire codec (imports/protowire.py — no onnx package in the
+environment), normalized to imports/ir.IRGraph, and mapped by the ONNX
+dialect table below onto the same SameDiff op catalog the TF frontend
+targets.
+
+Layout note: ONNX is NCHW; the graph records transposes around conv/pool
+(our declarable conv2d/maxpool2d are NHWC, the TPU-friendly layout) and
+XLA folds adjacent transposes away.
+
+Supported surface: the ~35 ops covering MLP/CNN inference graphs (Gemm,
+MatMul, Conv, pooling, BatchNormalization, activations, elementwise,
+shape ops, reductions, Softmax/LogSoftmax, Pad, Clip, Cast, LRN, …).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.imports import protowire as pw
+from deeplearning4j_tpu.imports.ir import IRGraph, IRImporter, IRNode
+
+# ---------------------------------------------------------------------------
+# ModelProto decoding (field numbers from the public onnx.proto3 schema)
+# ---------------------------------------------------------------------------
+
+# TensorProto.DataType
+_DT_NP = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+          6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16,
+          11: np.float64, 12: np.uint32, 13: np.uint64}
+
+
+def _decode_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    f = pw.parse_message(buf)
+    dims = pw.get_packed_or_repeated_varints(f, 1)
+    dtype = _DT_NP.get(pw.get_varint(f, 2, 1), np.float32)
+    name = pw.get_string(f, 8)
+    raw = pw.get_byte(f, 9)
+    if raw:
+        arr = np.frombuffer(raw, dtype=dtype)
+    elif dtype == np.float32:
+        arr = np.asarray(pw.get_packed_floats(f, 4), np.float32)
+    elif dtype in (np.int64, np.uint64):
+        arr = np.asarray(pw.get_packed_or_repeated_varints(f, 7), np.int64)
+    elif dtype in (np.int32, np.int8, np.int16, np.uint8, np.uint16, np.bool_):
+        arr = np.asarray(pw.get_packed_or_repeated_varints(f, 5)).astype(dtype)
+    elif dtype == np.float64:
+        raw10 = b"".join(v for wt, v in f.get(10, []) if wt == pw.LEN)
+        arr = np.frombuffer(raw10, np.float64) if raw10 else np.asarray(
+            [struct.unpack("<d", v)[0] for wt, v in f.get(10, []) if wt == pw.I64])
+    else:  # pragma: no cover
+        raise NotImplementedError(f"tensor dtype {dtype}")
+    return name, arr.reshape(dims) if dims else arr
+
+
+def _decode_attr(buf: bytes) -> Tuple[str, Any]:
+    f = pw.parse_message(buf)
+    name = pw.get_string(f, 1)
+    atype = pw.get_varint(f, 20, 0)
+    if atype == 1:  # FLOAT
+        return name, pw.get_float(f, 2)
+    if atype == 2:  # INT
+        return name, pw._to_signed64(pw.get_varint(f, 3))
+    if atype == 3:  # STRING
+        return name, pw.get_byte(f, 4).decode("utf-8", "replace")
+    if atype == 4:  # TENSOR
+        return name, _decode_tensor(pw.get_byte(f, 5))[1]
+    if atype == 6:  # FLOATS
+        return name, pw.get_packed_floats(f, 7)
+    if atype == 7:  # INTS
+        return name, pw.get_packed_or_repeated_varints(f, 8)
+    if atype == 8:  # STRINGS
+        return name, [b.decode() for b in pw.get_bytes(f, 9)]
+    return name, None
+
+
+def _decode_value_info(buf: bytes) -> Tuple[str, Optional[Tuple]]:
+    f = pw.parse_message(buf)
+    name = pw.get_string(f, 1)
+    shape = None
+    t = pw.get_byte(f, 2)
+    if t:
+        tt = pw.get_byte(pw.parse_message(t), 1)  # TypeProto.tensor_type
+        if tt:
+            sh = pw.get_byte(pw.parse_message(tt), 2)  # TensorTypeProto.shape
+            if sh:
+                dims = []
+                for d in pw.get_bytes(pw.parse_message(sh), 1):
+                    df = pw.parse_message(d)
+                    v = pw.get_varint(df, 1, 0)
+                    dims.append(int(v) if v > 0 else None)
+                shape = tuple(dims)
+    return name, shape
+
+
+def parse_model(data: bytes) -> IRGraph:
+    """ONNX ModelProto bytes → IRGraph."""
+    model = pw.parse_message(data)
+    graph = pw.parse_message(pw.get_byte(model, 7))  # ModelProto.graph
+    initializers: Dict[str, np.ndarray] = {}
+    for tbuf in pw.get_bytes(graph, 5):
+        name, arr = _decode_tensor(tbuf)
+        initializers[name] = arr
+    nodes: List[IRNode] = []
+    for nbuf in pw.get_bytes(graph, 1):
+        nf = pw.parse_message(nbuf)
+        attrs = dict(_decode_attr(a) for a in pw.get_bytes(nf, 5))
+        outputs = [b.decode() for b in pw.get_bytes(nf, 2)]
+        nodes.append(IRNode(
+            name=pw.get_string(nf, 3) or (outputs[0] if outputs else ""),
+            op_type=pw.get_string(nf, 4),
+            inputs=[b.decode() for b in pw.get_bytes(nf, 1)],
+            outputs=outputs,
+            attrs=attrs))
+    inputs = []
+    for vbuf in pw.get_bytes(graph, 11):
+        name, shape = _decode_value_info(vbuf)
+        if name not in initializers:  # opset<9 lists initializers as inputs
+            inputs.append((name, shape))
+    outputs = [_decode_value_info(v)[0] for v in pw.get_bytes(graph, 12)]
+    return IRGraph(nodes=nodes, initializers=initializers, inputs=inputs,
+                   outputs=outputs, name="onnx")
+
+
+# ---------------------------------------------------------------------------
+# ONNX dialect rules
+# ---------------------------------------------------------------------------
+
+ONNX_OP_MAPPERS: Dict[str, Callable[..., Any]] = {}
+
+_NEEDS_CONSTS = {"Reshape", "Transpose", "Squeeze", "Unsqueeze", "Gather", "Conv",
+                 "ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin", "Clip",
+                 "Pad", "Concat"}
+
+
+def register_onnx_op(name: str):
+    def wrap(fn):
+        ONNX_OP_MAPPERS[name] = fn
+        return fn
+
+    return wrap
+
+
+def _unary(sd_op: str):
+    def rule(sd, ins, attrs, node):
+        return sd._record(sd_op, [ins[0]])
+
+    return rule
+
+
+for _onnx, _sd in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
+                   ("Tanh", "tanh"), ("Softplus", "softplus"),
+                   ("Softsign", "softsign"), ("Exp", "exp"), ("Log", "log"),
+                   ("Sqrt", "sqrt"), ("Neg", "neg"), ("Abs", "abs"),
+                   ("Floor", "floor"), ("Ceil", "ceil"), ("Round", "round"),
+                   ("Erf", "erf"), ("Sign", "sign"), ("Reciprocal", "reciprocal"),
+                   ("Sin", "sin"), ("Cos", "cos"), ("Mish", "mish"),
+                   ("HardSigmoid", "hardsigmoid"), ("Gelu", "gelu")]:
+    ONNX_OP_MAPPERS[_onnx] = _unary(_sd)
+
+for _onnx, _sd in [("Add", "add"), ("Sub", "sub"), ("Mul", "mul"),
+                   ("Div", "div"), ("Pow", "pow"), ("Max", "maximum"),
+                   ("Min", "minimum")]:
+    def _bin_rule(sd, ins, attrs, node, _op=_sd):
+        return sd._record(_op, ins)
+
+    ONNX_OP_MAPPERS[_onnx] = _bin_rule
+
+
+@register_onnx_op("LeakyRelu")
+def _leaky(sd, ins, attrs, node):
+    return sd._record("leakyrelu", [ins[0]],
+                      {"alpha": float(attrs.get("alpha", 0.01))})
+
+
+@register_onnx_op("Elu")
+def _elu(sd, ins, attrs, node):
+    return sd._record("elu", [ins[0]])
+
+
+@register_onnx_op("Selu")
+def _selu(sd, ins, attrs, node):
+    return sd._record("selu", [ins[0]])
+
+
+@register_onnx_op("Softmax")
+def _softmax(sd, ins, attrs, node):
+    return sd._record("softmax", [ins[0]],
+                      {"axis": int(attrs.get("axis", -1))})
+
+
+@register_onnx_op("LogSoftmax")
+def _log_softmax(sd, ins, attrs, node):
+    return sd._record("log_softmax", [ins[0]],
+                      {"axis": int(attrs.get("axis", -1))})
+
+
+@register_onnx_op("MatMul")
+def _matmul(sd, ins, attrs, node):
+    return sd._record("mmul", ins)
+
+
+@register_onnx_op("Gemm")
+def _gemm(sd, ins, attrs, node):
+    """Y = alpha·op(A)·op(B) + beta·C."""
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    y = sd._record("mmul", ins[:2], {
+        "transpose_a": bool(attrs.get("transA", 0)),
+        "transpose_b": bool(attrs.get("transB", 0))})
+    if alpha != 1.0:
+        y = y * alpha
+    if len(ins) > 2:
+        c = ins[2] if beta == 1.0 else ins[2] * beta
+        y = y + c
+    return y
+
+
+@register_onnx_op("Identity")
+@register_onnx_op("Dropout")
+def _identity(sd, ins, attrs, node):
+    return sd._record("identity", [ins[0]])
+
+
+@register_onnx_op("Flatten")
+def _flatten(sd, ins, attrs, node):
+    return sd._record("flatten_from", [ins[0]],
+                      {"axis": int(attrs.get("axis", 1))})
+
+
+@register_onnx_op("Reshape")
+def _reshape(sd, ins, attrs, node, const_values=None):
+    shape = const_values.get(node.inputs[1])
+    if shape is None:
+        raise NotImplementedError("Reshape with dynamic shape input")
+    return sd._record("reshape", [ins[0]],
+                      {"shape": tuple(int(s) for s in shape)})
+
+
+@register_onnx_op("Transpose")
+def _transpose(sd, ins, attrs, node, const_values=None):
+    perm = attrs.get("perm")
+    return sd._record("transpose", [ins[0]],
+                      {"axes": None if perm is None else tuple(int(p) for p in perm)})
+
+
+@register_onnx_op("Squeeze")
+def _squeeze(sd, ins, attrs, node, const_values=None):
+    axes = attrs.get("axes")
+    if axes is None and len(node.inputs) > 1:
+        axes = const_values.get(node.inputs[1])
+    ax = None if axes is None else tuple(int(a) for a in axes)
+    if ax is not None and len(ax) == 1:
+        ax = ax[0]
+    return sd._record("squeeze", [ins[0]], {"axis": ax})
+
+
+@register_onnx_op("Unsqueeze")
+def _unsqueeze(sd, ins, attrs, node, const_values=None):
+    axes = attrs.get("axes")
+    if axes is None and len(node.inputs) > 1:
+        axes = const_values.get(node.inputs[1])
+    y = ins[0]
+    # insert in ascending order so later axes account for earlier inserts
+    for ax in sorted(int(a) for a in axes):
+        y = sd._record("expand_dims", [y], {"axis": ax})
+    return y
+
+
+@register_onnx_op("Concat")
+def _concat(sd, ins, attrs, node, const_values=None):
+    return sd._record("concat", ins, {"axis": int(attrs.get("axis", 0))})
+
+
+@register_onnx_op("Gather")
+def _gather(sd, ins, attrs, node, const_values=None):
+    return sd._record("gather", ins, {"axis": int(attrs.get("axis", 0))})
+
+
+def _reduce_rule(sd_op):
+    def rule(sd, ins, attrs, node, const_values=None):
+        axes = attrs.get("axes")
+        if axes is None and len(node.inputs) > 1:
+            axes = const_values.get(node.inputs[1])
+        return sd._record(sd_op, [ins[0]], {
+            "axes": None if axes is None else tuple(int(a) for a in axes),
+            "keepdims": bool(attrs.get("keepdims", 1))})
+
+    return rule
+
+
+ONNX_OP_MAPPERS["ReduceMean"] = _reduce_rule("reduce_mean")
+ONNX_OP_MAPPERS["ReduceSum"] = _reduce_rule("reduce_sum")
+ONNX_OP_MAPPERS["ReduceMax"] = _reduce_rule("reduce_max")
+ONNX_OP_MAPPERS["ReduceMin"] = _reduce_rule("reduce_min")
+
+
+@register_onnx_op("Clip")
+def _clip(sd, ins, attrs, node, const_values=None):
+    lo = attrs.get("min")
+    hi = attrs.get("max")
+    if lo is None and len(node.inputs) > 1 and node.inputs[1]:
+        lo = float(const_values.get(node.inputs[1]))
+    if hi is None and len(node.inputs) > 2 and node.inputs[2]:
+        hi = float(const_values.get(node.inputs[2]))
+    return sd._record("clip_by_value_graph", [ins[0]], {
+        "min_value": -np.inf if lo is None else float(lo),
+        "max_value": np.inf if hi is None else float(hi)})
+
+
+@register_onnx_op("Pad")
+def _pad(sd, ins, attrs, node, const_values=None):
+    pads = attrs.get("pads")
+    if pads is None and len(node.inputs) > 1:
+        pads = const_values.get(node.inputs[1])
+    pads = [int(p) for p in pads]
+    ndim = len(pads) // 2
+    paddings = tuple((pads[i], pads[i + ndim]) for i in range(ndim))
+    return sd._record("pad", [ins[0]], {"paddings": paddings,
+                                        "value": float(attrs.get("value", 0.0))})
+
+
+@register_onnx_op("Cast")
+def _cast(sd, ins, attrs, node):
+    to = _DT_NP.get(int(attrs.get("to", 1)), np.float32)
+    return sd._record("cast", [ins[0]], {"dtype": np.dtype(to).name})
+
+
+def _to_nhwc(sd, x):
+    return sd._record("transpose", [x], {"axes": (0, 2, 3, 1)})
+
+
+def _to_nchw(sd, x):
+    return sd._record("transpose", [x], {"axes": (0, 3, 1, 2)})
+
+
+@register_onnx_op("Conv")
+def _conv(sd, ins, attrs, node, const_values=None):
+    """NCHW Conv → (transpose) NHWC conv2d (transpose back). XLA folds the
+    sandwiched transposes into the convolution's layout assignment."""
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    pads = [int(p) for p in attrs.get("pads", [0, 0, 0, 0])]
+    dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
+    group = int(attrs.get("group", 1))
+    if dilations != [1, 1]:
+        raise NotImplementedError("dilated Conv import")
+    if group > 1 and const_values.get(node.inputs[1]) is not None \
+            and const_values[node.inputs[1]].shape[1] != 1:
+        raise NotImplementedError(
+            f"Conv {node.name}: grouped conv with group={group} and "
+            "C/group > 1 (ResNeXt-style) is not supported — only depthwise "
+            "(C/group == 1)")
+    x = _to_nhwc(sd, ins[0])
+    if any(pads):
+        x = sd._record("pad", [x], {"paddings": (
+            (0, 0), (pads[0], pads[2]), (pads[1], pads[3]), (0, 0))})
+    w = ins[1]  # (M, C/g, kH, kW)
+    if group == 1:
+        wt = sd._record("transpose", [w], {"axes": (2, 3, 1, 0)})  # → kh,kw,C,M
+        y = sd._record("conv2d", [x, wt],
+                       {"stride": tuple(strides), "padding": "valid"})
+    else:
+        # depthwise: group == C_in, weights (C, 1, kH, kW) → (kh, kw, C, 1)
+        wt = sd._record("transpose", [w], {"axes": (2, 3, 0, 1)})
+        y = sd._record("depthwise_conv2d", [x, wt],
+                       {"stride": tuple(strides), "padding": "valid"})
+    if len(ins) > 2:  # bias (M,) broadcasts over NHWC channels-last
+        y = y + ins[2]
+    return _to_nchw(sd, y)
+
+
+def _pool_rule(sd_op, is_global):
+    def rule(sd, ins, attrs, node):
+        x = _to_nhwc(sd, ins[0])
+        if is_global:
+            y = sd._record(sd_op, [x])  # NHWC global pool → (N, C)
+            # ONNX keeps unit spatial dims: (N, C, 1, 1)
+            y = sd._record("expand_dims", [y], {"axis": -1})
+            return sd._record("expand_dims", [y], {"axis": -1})
+        kernel = [int(k) for k in attrs["kernel_shape"]]
+        strides = [int(s) for s in attrs.get("strides", kernel)]
+        pads = [int(p) for p in attrs.get("pads", [0, 0, 0, 0])]
+        if any(pads):
+            pp = ((0, 0), (pads[0], pads[2]), (pads[1], pads[3]), (0, 0))
+            x = sd._record("pad", [x], {
+                "paddings": pp,
+                "value": -np.inf if sd_op == "maxpool2d" else 0.0})
+        y = sd._record(sd_op, [x], {"kernel": tuple(kernel),
+                                    "stride": tuple(strides)})
+        if sd_op == "avgpool2d" and any(pads) \
+                and not int(attrs.get("count_include_pad", 0)):
+            # ONNX default excludes padding from the average denominator:
+            # divide by the pooled fraction of a ones-mask padded with zeros
+            ones = sd._record("ones_like", [_to_nhwc(sd, ins[0])])
+            ones = sd._record("pad", [ones], {"paddings": pp})
+            frac = sd._record(sd_op, [ones], {"kernel": tuple(kernel),
+                                              "stride": tuple(strides)})
+            y = sd._record("div", [y, frac])
+        return _to_nchw(sd, y)
+
+    return rule
+
+
+ONNX_OP_MAPPERS["MaxPool"] = _pool_rule("maxpool2d", False)
+ONNX_OP_MAPPERS["AveragePool"] = _pool_rule("avgpool2d", False)
+ONNX_OP_MAPPERS["GlobalAveragePool"] = _pool_rule("global_avg_pool", True)
+ONNX_OP_MAPPERS["GlobalMaxPool"] = _pool_rule("global_max_pool", True)
+
+
+@register_onnx_op("BatchNormalization")
+def _batchnorm(sd, ins, attrs, node):
+    """inference-mode BN; params reshape to (C,1,1) for NCHW broadcast."""
+    x, scale, bias, mean, var = ins[:5]
+    eps = float(attrs.get("epsilon", 1e-5))
+
+    def chan(v, tag):
+        return sd._record("reshape", [v], {"shape": (-1, 1, 1)})
+
+    return sd._record("batch_norm_graph",
+                      [x, chan(mean, "m"), chan(var, "v"),
+                       chan(scale, "g"), chan(bias, "b")], {"eps": eps})
+
+
+@register_onnx_op("LRN")
+def _lrn(sd, ins, attrs, node):
+    x = _to_nhwc(sd, ins[0])
+    y = sd._record("lrn", [x], {
+        "depth": int(attrs.get("size", 5)),
+        "bias": float(attrs.get("bias", 1.0)),
+        "alpha": float(attrs.get("alpha", 1e-4)) / int(attrs.get("size", 5)),
+        "beta": float(attrs.get("beta", 0.75))})
+    return _to_nchw(sd, y)
+
+
+@register_onnx_op("Constant")
+def _constant(sd, ins, attrs, node):
+    val = attrs.get("value")
+    return sd.constant(node.outputs[0], np.asarray(val))
+
+
+@register_onnx_op("PRelu")
+def _prelu(sd, ins, attrs, node):
+    x, slope = ins
+    pos = sd._record("relu", [x])
+    neg = sd._record("minimum", [x, sd.constant(
+        node.name + "_z", np.zeros((1,), np.float32))])
+    return pos + sd._record("mul", [slope, neg])
+
+
+# "flatten_from": keep leading `axis` dims, flatten the rest (ONNX Flatten)
+from deeplearning4j_tpu.autodiff import samediff as _sdmod
+
+if "flatten_from" not in _sdmod.GRAPH_OPS:
+    def _flatten_from(a, *, axis=1):
+        lead = 1
+        for s in a.shape[:axis]:
+            lead *= s
+        return a.reshape(lead, -1)
+
+    _sdmod.GRAPH_OPS["flatten_from"] = _flatten_from
+if "identity" not in _sdmod.GRAPH_OPS:
+    _sdmod.GRAPH_OPS["identity"] = lambda a: a
+
+
+# ---------------------------------------------------------------------------
+# Facade
+# ---------------------------------------------------------------------------
+
+
+class OnnxImporter(IRImporter):
+    """OnnxFrameworkImporter analog."""
+
+    def __init__(self, extra_mappers: Optional[Dict[str, Callable]] = None):
+        rules = dict(ONNX_OP_MAPPERS)
+        if extra_mappers:
+            rules.update(extra_mappers)
+        super().__init__(rules, needs_consts=_NEEDS_CONSTS)
+
+    def run_import(self, model) -> SameDiff:  # type: ignore[override]
+        if isinstance(model, str):
+            with open(model, "rb") as f:
+                model = f.read()
+        if isinstance(model, (bytes, bytearray)):
+            model = parse_model(bytes(model))
+        return super().run_import(model)
+
+
+def import_onnx(path_or_bytes) -> SameDiff:
+    """One-call facade (KerasModelImport-style)."""
+    return OnnxImporter().run_import(path_or_bytes)

@@ -274,6 +274,168 @@ def _equal(sd, ins, attrs, node):
     return sd._record("eq", ins)
 
 
+@register_tf_op("DepthwiseConv2dNative")
+def _depthwise_conv(sd, ins, attrs, node):
+    if attrs.get("data_format", b"NHWC") not in (b"NHWC", "NHWC"):
+        raise ValueError("only NHWC DepthwiseConv2dNative import supported")
+    if [int(d) for d in attrs.get("dilations", [1, 1, 1, 1])] != [1, 1, 1, 1]:
+        raise NotImplementedError("dilated DepthwiseConv2dNative import")
+    strides = attrs.get("strides", [1, 1, 1, 1])
+    padding = attrs.get("padding", b"SAME")
+    pad = padding.decode().lower() if isinstance(padding, bytes) else str(padding).lower()
+    return sd._record("depthwise_conv2d", ins,
+                      {"stride": (int(strides[1]), int(strides[2])),
+                       "padding": pad})
+
+
+@register_tf_op("FusedBatchNormV3")
+@register_tf_op("FusedBatchNorm")
+def _fused_bn(sd, ins, attrs, node):
+    """inference-mode fused BN: inputs x, scale, offset, mean, var (NHWC)."""
+    if attrs.get("data_format", b"NHWC") not in (b"NHWC", "NHWC"):
+        raise ValueError("only NHWC FusedBatchNorm import supported")
+    x, scale, offset, mean, var = ins[:5]
+    return sd._record("batch_norm_graph", [x, mean, var, scale, offset],
+                      {"eps": float(attrs.get("epsilon", 1e-3))})
+
+
+@register_tf_op("LeakyRelu")
+def _tf_leaky(sd, ins, attrs, node):
+    return sd._record("leakyrelu", ins,
+                      {"alpha": float(attrs.get("alpha", 0.2))})
+
+
+@register_tf_op("Pad")
+@register_tf_op("PadV2")
+def _tf_pad(sd, ins, attrs, node, const_values=None):
+    pads = _require_const(const_values, node, 1, "paddings")
+    value = 0.0
+    if len(node.input) > 2:
+        cv = const_values.get(node.input[2].split(":")[0])
+        if cv is not None:
+            value = float(cv)
+    return sd._record("pad", [ins[0]],
+                      {"paddings": tuple((int(a), int(b)) for a, b in pads),
+                       "value": value})
+
+
+@register_tf_op("StridedSlice")
+def _tf_strided_slice(sd, ins, attrs, node, const_values=None):
+    masks = [attrs.get(m, 0) for m in ("begin_mask", "end_mask",
+                                       "ellipsis_mask", "new_axis_mask",
+                                       "shrink_axis_mask")]
+    if any(masks):
+        raise NotImplementedError(
+            f"StridedSlice {node.name}: mask attrs {masks} not supported — "
+            "only explicit begin/end/strides slices import")
+    begin = _require_const(const_values, node, 1, "begin")
+    end = _require_const(const_values, node, 2, "end")
+    strides = _require_const(const_values, node, 3, "strides")
+    return sd._record("strided_slice", [ins[0]], {
+        "begin": [int(b) for b in begin], "end": [int(e) for e in end],
+        "strides": [int(s) for s in strides]})
+
+
+@register_tf_op("Unpack")
+def _tf_unpack(sd, ins, attrs, node):
+    # single-output use only: the common tf.unstack(x)[0] pattern — with
+    # num > 1 every :k consumer would silently receive element 0
+    if int(attrs.get("num", 1)) > 1 or int(attrs.get("axis", 0)) != 0:
+        raise NotImplementedError(
+            f"Unpack {node.name}: num={attrs.get('num')}/axis="
+            f"{attrs.get('axis', 0)} — only single-element axis-0 unstack "
+            "imports")
+    return sd._record("unstack_first", ins)
+
+
+@register_tf_op("ArgMax")
+def _tf_argmax(sd, ins, attrs, node, const_values=None):
+    axis = _require_const(const_values, node, 1, "dimension") \
+        if len(node.input) > 1 else -1
+    return sd._record("argmax", [ins[0]], {"axis": int(axis)})
+
+
+@register_tf_op("ArgMin")
+def _tf_argmin(sd, ins, attrs, node, const_values=None):
+    axis = _require_const(const_values, node, 1, "dimension") \
+        if len(node.input) > 1 else -1
+    return sd._record("argmin", [ins[0]], {"axis": int(axis)})
+
+
+@register_tf_op("Prod")
+def _tf_prod(sd, ins, attrs, node, const_values=None):
+    axes = _require_const(const_values, node, 1, "reduction axes")
+    return sd._record("reduce_prod", [ins[0]], {
+        "axes": tuple(int(a) for a in np.atleast_1d(axes)),
+        "keepdims": bool(attrs.get("keep_dims", False))})
+
+
+@register_tf_op("Min")
+def _tf_reduce_min(sd, ins, attrs, node, const_values=None):
+    axes = _require_const(const_values, node, 1, "reduction axes")
+    return sd._record("reduce_min", [ins[0]], {
+        "axes": tuple(int(a) for a in np.atleast_1d(axes)),
+        "keepdims": bool(attrs.get("keep_dims", False))})
+
+
+@register_tf_op("ClipByValue")
+def _tf_clip(sd, ins, attrs, node, const_values=None):
+    lo = float(_require_const(const_values, node, 1, "clip_value_min"))
+    hi = float(_require_const(const_values, node, 2, "clip_value_max"))
+    return sd._record("clip_by_value_graph", [ins[0]],
+                      {"min_value": lo, "max_value": hi})
+
+
+@register_tf_op("Cumsum")
+def _tf_cumsum(sd, ins, attrs, node, const_values=None):
+    axis = _require_const(const_values, node, 1, "axis")
+    return sd._record("cumsum", [ins[0]], {
+        "axis": int(axis),
+        "exclusive": bool(attrs.get("exclusive", False)),
+        "reverse": bool(attrs.get("reverse", False))})
+
+
+@register_tf_op("GreaterEqual")
+def _tf_gte(sd, ins, attrs, node):
+    return sd._record("gte", ins)
+
+
+@register_tf_op("LessEqual")
+def _tf_lte(sd, ins, attrs, node):
+    return sd._record("lte", ins)
+
+
+@register_tf_op("NotEqual")
+def _tf_neq(sd, ins, attrs, node):
+    return sd._record("neq", ins)
+
+
+@register_tf_op("ZerosLike")
+def _tf_zeros_like(sd, ins, attrs, node):
+    return sd._record("zeros_like", ins)
+
+
+@register_tf_op("OnesLike")
+def _tf_ones_like(sd, ins, attrs, node):
+    return sd._record("ones_like", ins)
+
+
+def _require_const(const_values, node, idx, what):
+    name = node.input[idx].split(":")[0]
+    val = (const_values or {}).get(name)
+    if val is None:
+        raise ValueError(
+            f"{node.op_type} {node.name}: dynamic (non-Const) {what} operand "
+            f"'{node.input[idx]}' is unsupported")
+    return val
+
+
+@register_tf_op("AvgPool3D")
+@register_tf_op("MaxPool3D")
+def _tf_pool3d_unsupported(sd, ins, attrs, node):
+    raise NotImplementedError("3-D pooling import is not supported yet")
+
+
 # ---------------------------------------------------------------------------
 # The importer
 # ---------------------------------------------------------------------------
@@ -281,11 +443,47 @@ def _equal(sd, ins, attrs, node):
 _CONST_ONLY_OPS = {"Const", "Placeholder", "PlaceholderWithDefault"}
 # mappers that need raw const operand values (shape/perm/axis inputs)
 _NEEDS_CONSTS = {"Reshape", "Transpose", "ExpandDims", "ConcatV2", "Mean",
-                 "Sum", "Max", "GatherV2", "Tile"}
+                 "Sum", "Max", "Min", "Prod", "GatherV2", "Tile", "Pad",
+                 "PadV2", "StridedSlice", "ArgMax", "ArgMin", "ClipByValue",
+                 "Cumsum"}
+
+
+def graphdef_to_ir(graph_def) -> "IRGraph":
+    """TF GraphDef → framework-neutral IRGraph (imports/ir.py): Const nodes
+    become initializers, Placeholders become graph inputs, everything else
+    an IRNode with normalized attrs."""
+    from tensorflow.python.framework import tensor_util
+
+    from deeplearning4j_tpu.imports.ir import IRGraph, IRNode
+
+    nodes: List = []
+    initializers: Dict[str, np.ndarray] = {}
+    inputs: List = []
+    for node in graph_def.node:
+        if node.op == "Const":
+            initializers[node.name] = tensor_util.MakeNdarray(
+                node.attr["value"].tensor)
+            continue
+        if node.op in ("Placeholder", "PlaceholderWithDefault"):
+            shape = None
+            if "shape" in node.attr:
+                dims = node.attr["shape"].shape.dim
+                shape = tuple(d.size if d.size > 0 else None for d in dims)
+            inputs.append((node.name, shape))
+            continue
+        attrs = {k: _attr_value(v) for k, v in node.attr.items()}
+        in_names = [i.split(":")[0].lstrip("^") for i in node.input]
+        nodes.append(IRNode(name=node.name, op_type=node.op,
+                            inputs=in_names, outputs=[node.name],
+                            attrs=attrs))
+    return IRGraph(nodes=nodes, initializers=initializers, inputs=inputs,
+                   outputs=[], name="tensorflow")
 
 
 class TensorflowImporter:
-    """FrameworkImporter analog for TF frozen GraphDefs."""
+    """FrameworkImporter analog for TF frozen GraphDefs — a thin frontend
+    over the shared IR walker (imports/ir.IRImporter): parse to IRGraph,
+    dispatch the TF dialect rule table."""
 
     def __init__(self, extra_mappers: Optional[Dict[str, Callable]] = None):
         self.mappers = dict(TF_OP_MAPPERS)
@@ -297,49 +495,13 @@ class TensorflowImporter:
 
     def run_import(self, graph_def, *, trainable_consts: bool = True) -> SameDiff:
         """GraphDef (or serialized bytes / .pb path) → SameDiff."""
+        from deeplearning4j_tpu.imports.ir import IRImporter
+
         graph_def = _coerce_graph_def(graph_def)
-        from tensorflow.python.framework import tensor_util
-
-        sd = SameDiff.create()
-        produced: Dict[str, SDVariable] = {}
-        const_values: Dict[str, np.ndarray] = {}
-
-        for node in graph_def.node:
-            op = node.op
-            attrs = {k: _attr_value(v) for k, v in node.attr.items()}
-            if op == "Const":
-                arr = tensor_util.MakeNdarray(node.attr["value"].tensor)
-                const_values[node.name] = arr
-                if trainable_consts and np.issubdtype(arr.dtype, np.floating) and arr.size > 1:
-                    produced[node.name] = sd.var(node.name, arr)
-                else:
-                    produced[node.name] = sd.constant(node.name, arr)
-                continue
-            if op in ("Placeholder", "PlaceholderWithDefault"):
-                shape = None
-                if "shape" in node.attr:
-                    dims = node.attr["shape"].shape.dim
-                    shape = tuple(d.size if d.size > 0 else None for d in dims)
-                produced[node.name] = sd.placeholder(node.name, shape=shape)
-                continue
-            mapper = self.mappers.get(op)
-            if mapper is None:
-                raise NotImplementedError(
-                    f"TF op '{op}' (node {node.name}) has no mapping rule; "
-                    f"register one via register_tf_op('{op}')")
-            in_names = [i.split(":")[0].lstrip("^") for i in node.input]
-            ins = [produced[n] for n in in_names if n in produced]
-            if op in _NEEDS_CONSTS:
-                out = mapper(sd, ins, attrs, node, const_values=const_values)
-            else:
-                out = mapper(sd, ins, attrs, node)
-            if out is not None:
-                # give freshly recorded op outputs the TF node's name so
-                # callers can request outputs by graph-node name
-                if out.vtype == "ARRAY" and node.name not in sd._vars:
-                    out.rename(node.name)
-                produced[node.name] = out
-        return sd
+        ir = graphdef_to_ir(graph_def)
+        walker = IRImporter(self.mappers, needs_consts=_NEEDS_CONSTS,
+                            trainable_consts=trainable_consts)
+        return walker.run_import(ir)
 
 
 def _coerce_graph_def(g):

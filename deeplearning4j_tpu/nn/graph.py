@@ -185,10 +185,25 @@ class ReshapeVertex(GraphVertex):
         return jnp.reshape(inputs[0], self.shape)
 
 
+@dataclasses.dataclass(frozen=True)
+class FlattenVertex(GraphVertex):
+    """Batch-preserving flatten (PreprocessorVertex(CnnToFeedForward)
+    analog, but feature-major order preserved — used by the Keras
+    functional import where activations are already NHWC like Keras's)."""
+
+    def apply(self, inputs):
+        x = inputs[0]
+        return jnp.reshape(x, (x.shape[0], -1))
+
+    def output_type(self, itypes):
+        return C.InputType.feed_forward(itypes[0].flat_size())
+
+
 VERTEX_TYPES = {
     c.__name__: c
     for c in [MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex,
-              ShiftVertex, L2NormalizeVertex, StackVertex, ReshapeVertex]
+              ShiftVertex, L2NormalizeVertex, StackVertex, ReshapeVertex,
+              FlattenVertex]
 }
 
 

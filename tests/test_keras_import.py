@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 tf = pytest.importorskip("tensorflow")
+from tensorflow import keras
 
 from deeplearning4j_tpu.imports.keras_import import (
     import_keras_model, import_keras_sequential_model_and_weights,
@@ -109,3 +110,95 @@ class TestKerasImport:
         ])
         with pytest.raises(NotImplementedError, match="Conv1D"):
             import_keras_model(model)
+
+
+class TestKerasOwnH5:
+    """Round 3: own HDF5 parsing (no tf.keras deserialization) + functional
+    API → ComputationGraph — KerasModelImport.importKerasModelAndWeights."""
+
+    def test_sequential_own_h5_golden(self, tmp_path):
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights, read_keras_h5)
+
+        rng = np.random.RandomState(0)
+        model = keras.Sequential([
+            keras.layers.Input((12,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dense(4, activation="softmax"),
+        ])
+        path = str(tmp_path / "seq.h5")
+        model.save(path)
+        config, weights = read_keras_h5(path)
+        assert config["class_name"] == "Sequential"
+        net = import_keras_model_and_weights(path)
+        x = rng.randn(6, 12).astype(np.float32)
+        golden = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x), golden, rtol=1e-4, atol=1e-5)
+
+    def test_functional_resnet_ish_golden(self, tmp_path):
+        """Functional graph with a residual Add and a Concatenate — the
+        'functional ResNet-ish golden import' from the round-2 verdict."""
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights)
+
+        rng = np.random.RandomState(1)
+        inp = keras.Input((16, 16, 3), name="img")
+        c1 = keras.layers.Conv2D(8, 3, padding="same", activation="relu",
+                                 name="c1")(inp)
+        c2 = keras.layers.Conv2D(8, 3, padding="same", name="c2")(c1)
+        add = keras.layers.Add(name="res_add")([c1, c2])
+        act = keras.layers.ReLU(name="res_act")(add)
+        p = keras.layers.MaxPooling2D(2, name="pool")(act)
+        br1 = keras.layers.Conv2D(4, 1, activation="relu", name="br1")(p)
+        br2 = keras.layers.DepthwiseConv2D(3, padding="same", name="br2")(p)
+        cat = keras.layers.Concatenate(name="cat")([br1, br2])
+        gap = keras.layers.GlobalAveragePooling2D(name="gap")(cat)
+        out = keras.layers.Dense(5, activation="softmax", name="logits")(gap)
+        model = keras.Model(inp, out)
+
+        path = str(tmp_path / "func.h5")
+        model.save(path)
+        net = import_keras_model_and_weights(path)
+        x = rng.rand(2, 16, 16, 3).astype(np.float32)
+        golden = model.predict(x, verbose=0)
+        got = net.output(x)[0]
+        np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+    def test_functional_flatten_dense_golden(self, tmp_path):
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights)
+
+        rng = np.random.RandomState(2)
+        inp = keras.Input((6, 6, 2), name="x")
+        c = keras.layers.Conv2D(3, 3, activation="tanh", name="conv")(inp)
+        f = keras.layers.Flatten(name="flat")(c)
+        out = keras.layers.Dense(4, name="fc")(f)
+        model = keras.Model(inp, out)
+        path = str(tmp_path / "flat.h5")
+        model.save(path)
+        net = import_keras_model_and_weights(path)
+        x = rng.rand(3, 6, 6, 2).astype(np.float32)
+        golden = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x)[0], golden, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_widened_sequential_layers_golden(self, tmp_path):
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights)
+
+        rng = np.random.RandomState(3)
+        model = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.SeparableConv2D(6, 3, activation="relu"),
+            keras.layers.UpSampling2D(2),
+            keras.layers.DepthwiseConv2D(3),
+            keras.layers.LeakyReLU(negative_slope=0.3),
+            keras.layers.GlobalMaxPooling2D(),
+            keras.layers.Dense(4, activation="softmax"),
+        ])
+        path = str(tmp_path / "widened.h5")
+        model.save(path)
+        net = import_keras_model_and_weights(path)
+        x = rng.rand(2, 10, 10, 3).astype(np.float32)
+        golden = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x), golden, rtol=1e-4, atol=1e-5)

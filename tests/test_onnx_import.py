@@ -1,0 +1,286 @@
+"""ONNX import golden tests — the samediff-import-onnx golden pattern
+(SURVEY §3.2): assemble an ONNX ModelProto, import to SameDiff, and compare
+outputs elementwise against an independent oracle (numpy / torch).
+
+No ONNX producer exists in this environment (no onnx package; torch's
+exporter requires it), so models are assembled at the protobuf byte level
+with the same wire codec the importer uses for decoding — the round trip
+plus the independent-oracle forward checks both codec directions AND the
+mapping rules.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.imports import protowire as pw
+from deeplearning4j_tpu.imports.onnx_import import (
+    OnnxImporter, import_onnx, parse_model,
+)
+
+
+# ---------------------------------------------------------------------------
+# ModelProto assembly helpers (public onnx.proto3 field numbers)
+# ---------------------------------------------------------------------------
+
+_NP_DT = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
+          np.dtype(np.int32): 6, np.dtype(np.float64): 11}
+
+
+def tensor_proto(name, arr):
+    arr = np.ascontiguousarray(arr)
+    out = pw.field_packed_varints(1, arr.shape) if arr.ndim else b""
+    out += pw.field_varint(2, _NP_DT[arr.dtype])
+    out += pw.field_string(8, name)
+    out += pw.field_bytes(9, arr.tobytes())
+    return out
+
+
+def attr_proto(name, val):
+    out = pw.field_string(1, name)
+    if isinstance(val, float):
+        out += pw.field_float(2, val) + pw.field_varint(20, 1)
+    elif isinstance(val, int):
+        out += pw.field_varint(3, val) + pw.field_varint(20, 2)
+    elif isinstance(val, str):
+        out += pw.field_bytes(4, val.encode()) + pw.field_varint(20, 3)
+    elif isinstance(val, np.ndarray):
+        out += pw.field_bytes(5, tensor_proto("", val)) + pw.field_varint(20, 4)
+    elif isinstance(val, (list, tuple)) and val and isinstance(val[0], float):
+        out += b"".join(pw.field_float(7, v) for v in val) + pw.field_varint(20, 6)
+    elif isinstance(val, (list, tuple)):
+        out += pw.field_packed_varints(8, val) + pw.field_varint(20, 7)
+    else:
+        raise TypeError(type(val))
+    return out
+
+
+def node_proto(op_type, inputs, outputs, name="", **attrs):
+    out = b"".join(pw.field_string(1, i) for i in inputs)
+    out += b"".join(pw.field_string(2, o) for o in outputs)
+    out += pw.field_string(3, name or outputs[0] + "_node")
+    out += pw.field_string(4, op_type)
+    out += b"".join(pw.field_bytes(5, attr_proto(k, v))
+                    for k, v in attrs.items())
+    return out
+
+
+def value_info(name, shape):
+    dims = b"".join(pw.field_bytes(1, pw.field_varint(1, d)) for d in shape)
+    shape_p = pw.field_bytes(2, dims)
+    tensor_t = pw.field_varint(1, 1) + shape_p  # elem_type=FLOAT
+    type_p = pw.field_bytes(1, tensor_t)
+    return pw.field_string(1, name) + pw.field_bytes(2, type_p)
+
+
+def build_model(nodes, inputs, outputs, initializers):
+    """nodes: list of node_proto bytes; inputs/outputs: [(name, shape)];
+    initializers: {name: array}."""
+    g = b"".join(pw.field_bytes(1, n) for n in nodes)
+    g += pw.field_string(2, "test_graph")
+    g += b"".join(pw.field_bytes(5, tensor_proto(n, a))
+                  for n, a in initializers.items())
+    g += b"".join(pw.field_bytes(11, value_info(n, s)) for n, s in inputs)
+    g += b"".join(pw.field_bytes(12, value_info(n, s)) for n, s in outputs)
+    m = pw.field_varint(1, 8)  # ir_version
+    m += pw.field_bytes(7, g)
+    m += pw.field_bytes(8, pw.field_string(1, "") + pw.field_varint(2, 13))
+    return m
+
+
+def _run(sd, feeds, out):
+    return sd.output(feeds, out)[out]
+
+
+class TestOnnxParser:
+    def test_tensor_round_trip(self):
+        arr = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        model = build_model([], [("x", (1,))], [("x", (1,))], {"w": arr})
+        ir = parse_model(model)
+        np.testing.assert_array_equal(ir.initializers["w"], arr)
+
+    def test_int64_tensor(self):
+        arr = np.asarray([2, -1, 12], np.int64)
+        model = build_model([], [("x", (1,))], [("x", (1,))], {"s": arr})
+        ir = parse_model(model)
+        np.testing.assert_array_equal(ir.initializers["s"], arr)
+
+    def test_node_attrs(self):
+        n = node_proto("Softmax", ["x"], ["y"], axis=-1)
+        model = build_model([n], [("x", (2, 3))], [("y", (2, 3))], {})
+        ir = parse_model(model)
+        assert ir.nodes[0].op_type == "Softmax"
+        assert ir.nodes[0].attrs["axis"] == -1
+        assert ir.inputs == [("x", (2, 3))]
+        assert ir.outputs == ["y"]
+
+
+class TestOnnxImport:
+    def test_mlp_golden(self):
+        r = np.random.RandomState(0)
+        w0 = r.randn(8, 4).astype(np.float32)  # Gemm transB: (out, in)
+        b0 = r.randn(8).astype(np.float32)
+        w1 = r.randn(3, 8).astype(np.float32)
+        b1 = r.randn(3).astype(np.float32)
+        nodes = [
+            node_proto("Gemm", ["x", "w0", "b0"], ["h0"], transB=1),
+            node_proto("Relu", ["h0"], ["h1"]),
+            node_proto("Gemm", ["h1", "w1", "b1"], ["h2"], transB=1),
+            node_proto("Softmax", ["h2"], ["y"], axis=-1),
+        ]
+        model = build_model(nodes, [("x", (5, 4))], [("y", (5, 3))],
+                            {"w0": w0, "b0": b0, "w1": w1, "b1": b1})
+        x = r.randn(5, 4).astype(np.float32)
+        # independent numpy oracle
+        h = np.maximum(x @ w0.T + b0, 0) @ w1.T + b1
+        e = np.exp(h - h.max(axis=-1, keepdims=True))
+        want = e / e.sum(axis=-1, keepdims=True)
+
+        sd = import_onnx(model)
+        got = _run(sd, {"x": x}, "y")
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_cnn_golden_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+
+        r = np.random.RandomState(1)
+        x = r.randn(2, 3, 8, 8).astype(np.float32)
+        w = (r.randn(4, 3, 3, 3) * 0.5).astype(np.float32)
+        b = r.randn(4).astype(np.float32)
+        gamma = (np.abs(r.randn(4)) + 0.5).astype(np.float32)
+        beta = r.randn(4).astype(np.float32)
+        mean = r.randn(4).astype(np.float32)
+        var = (np.abs(r.randn(4)) + 0.5).astype(np.float32)
+        wf = r.randn(5, 4).astype(np.float32)
+        bf = r.randn(5).astype(np.float32)
+
+        nodes = [
+            node_proto("Conv", ["x", "w", "b"], ["c1"],
+                       kernel_shape=[3, 3], strides=[1, 1],
+                       pads=[1, 1, 1, 1]),
+            node_proto("BatchNormalization",
+                       ["c1", "gamma", "beta", "mean", "var"], ["bn"],
+                       epsilon=1e-5),
+            node_proto("Relu", ["bn"], ["r1"]),
+            node_proto("MaxPool", ["r1"], ["p1"], kernel_shape=[2, 2],
+                       strides=[2, 2]),
+            node_proto("GlobalAveragePool", ["p1"], ["g1"]),
+            node_proto("Flatten", ["g1"], ["f1"], axis=1),
+            node_proto("Gemm", ["f1", "wf", "bf"], ["y"], transB=1),
+        ]
+        model = build_model(
+            nodes, [("x", (2, 3, 8, 8))], [("y", (2, 5))],
+            {"w": w, "b": b, "gamma": gamma, "beta": beta, "mean": mean,
+             "var": var, "wf": wf, "bf": bf})
+
+        with torch.no_grad():
+            t = torch.from_numpy(x)
+            t = F.conv2d(t, torch.from_numpy(w), torch.from_numpy(b),
+                         padding=1)
+            t = F.batch_norm(t, torch.from_numpy(mean), torch.from_numpy(var),
+                             torch.from_numpy(gamma), torch.from_numpy(beta),
+                             training=False, eps=1e-5)
+            t = F.relu(t)
+            t = F.max_pool2d(t, 2, 2)
+            t = F.adaptive_avg_pool2d(t, 1).flatten(1)
+            want = (t @ torch.from_numpy(wf).T + torch.from_numpy(bf)).numpy()
+
+        sd = import_onnx(model)
+        got = _run(sd, {"x": x}, "y")
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_depthwise_conv_golden_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+
+        r = np.random.RandomState(2)
+        x = r.randn(1, 4, 6, 6).astype(np.float32)
+        w = r.randn(4, 1, 3, 3).astype(np.float32)
+        nodes = [node_proto("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                            strides=[1, 1], pads=[0, 0, 0, 0], group=4)]
+        model = build_model(nodes, [("x", (1, 4, 6, 6))], [("y", (1, 4, 4, 4))],
+                            {"w": w})
+        with torch.no_grad():
+            want = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                            groups=4).numpy()
+        got = _run(import_onnx(model), {"x": x}, "y")
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_elementwise_reduce_chain(self):
+        r = np.random.RandomState(3)
+        x = r.randn(3, 6).astype(np.float32)
+        c = r.randn(6).astype(np.float32)
+        nodes = [
+            node_proto("Add", ["x", "c"], ["a"]),
+            node_proto("Clip", ["a"], ["cl"], min=-1.0, max=1.0),
+            node_proto("Mul", ["cl", "cl"], ["m"]),
+            node_proto("ReduceMean", ["m"], ["rm"], axes=[1], keepdims=0),
+            node_proto("Sqrt", ["rm"], ["y"]),
+        ]
+        model = build_model(nodes, [("x", (3, 6))], [("y", (3,))], {"c": c})
+        want = np.sqrt(np.mean(np.clip(x + c, -1, 1) ** 2, axis=1))
+        got = _run(import_onnx(model), {"x": x}, "y")
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_shape_ops_chain(self):
+        r = np.random.RandomState(4)
+        x = r.randn(2, 3, 4).astype(np.float32)
+        shape = np.asarray([2, 12], np.int64)
+        nodes = [
+            node_proto("Transpose", ["x"], ["t"], perm=[0, 2, 1]),
+            node_proto("Reshape", ["t", "shape"], ["rs"]),
+            node_proto("Concat", ["rs", "rs"], ["cc"], axis=0),
+            node_proto("Pad", ["cc"], ["y"], pads=[0, 1, 0, 1], value=0.5),
+        ]
+        model = build_model(nodes, [("x", (2, 3, 4))], [("y", (4, 14))],
+                            {"shape": shape})
+        t = x.transpose(0, 2, 1).reshape(2, 12)
+        cc = np.concatenate([t, t], axis=0)
+        want = np.pad(cc, [(0, 0), (1, 1)], constant_values=0.5)
+        got = _run(import_onnx(model), {"x": x}, "y")
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_unsupported_op_message(self):
+        nodes = [node_proto("NonexistentOp", ["x"], ["y"])]
+        model = build_model(nodes, [("x", (1,))], [("y", (1,))], {})
+        with pytest.raises(NotImplementedError, match="NonexistentOp"):
+            import_onnx(model)
+
+    def test_supported_ops_listing(self):
+        ops = OnnxImporter().supported_ops()
+        assert len(ops) >= 45
+        assert "Conv" in ops and "Gemm" in ops and "BatchNormalization" in ops
+
+    def test_avgpool_pads_excludes_padding(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+
+        r = np.random.RandomState(5)
+        x = r.randn(1, 2, 6, 6).astype(np.float32)
+        nodes = [node_proto("AveragePool", ["x"], ["y"], kernel_shape=[3, 3],
+                            strides=[1, 1], pads=[1, 1, 1, 1])]
+        model = build_model(nodes, [("x", (1, 2, 6, 6))], [("y", (1, 2, 6, 6))], {})
+        with torch.no_grad():
+            want = F.avg_pool2d(torch.from_numpy(x), 3, 1, padding=1,
+                                count_include_pad=False).numpy()
+        got = _run(import_onnx(model), {"x": x}, "y")
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_unsqueeze_multiple_axes(self):
+        x = np.random.RandomState(6).randn(3, 4).astype(np.float32)
+        ax = np.asarray([0, 3], np.int64)
+        nodes = [node_proto("Unsqueeze", ["x", "ax"], ["y"])]
+        model = build_model(nodes, [("x", (3, 4))], [("y", (1, 3, 4, 1))],
+                            {"ax": ax})
+        got = _run(import_onnx(model), {"x": x}, "y")
+        assert got.shape == (1, 3, 4, 1)
+        np.testing.assert_array_equal(got[0, :, :, 0], x)
+
+    def test_grouped_conv_rejected(self):
+        w = np.random.RandomState(7).randn(4, 2, 3, 3).astype(np.float32)
+        nodes = [node_proto("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                            group=2)]
+        model = build_model(nodes, [("x", (1, 4, 6, 6))], [("y", (1, 4, 4, 4))],
+                            {"w": w})
+        with pytest.raises(NotImplementedError, match="group"):
+            import_onnx(model)

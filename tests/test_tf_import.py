@@ -118,3 +118,46 @@ class TestTfImport:
         sd = import_frozen_graph(gd)
         got = sd.output({ins[0]: x}, outs[0])[outs[0]]
         np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+
+class TestTfImportWidened:
+    """Round-3 widened dialect: conv/bn/pad/slice ops via the shared IR layer."""
+
+    def test_cnn_bn_golden(self):
+        rng = np.random.RandomState(7)
+        w = tf.Variable((rng.randn(3, 3, 3, 8) * 0.3).astype(np.float32))
+        dw = tf.Variable((rng.randn(3, 3, 8, 1) * 0.3).astype(np.float32))
+        gamma = tf.Variable((np.abs(rng.randn(8)) + 0.5).astype(np.float32))
+        beta = tf.Variable(rng.randn(8).astype(np.float32))
+        mean = tf.Variable(rng.randn(8).astype(np.float32))
+        var = tf.Variable((np.abs(rng.randn(8)) + 0.5).astype(np.float32))
+
+        def model(x):
+            y = tf.nn.conv2d(x, w, strides=1, padding="SAME")
+            y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+                y, gamma, beta, mean=mean, variance=var, is_training=False)
+            y = tf.nn.leaky_relu(y, alpha=0.1)
+            y = tf.nn.depthwise_conv2d(y, dw, strides=[1, 1, 1, 1],
+                                       padding="VALID")
+            y = tf.pad(y, [[0, 0], [1, 1], [1, 1], [0, 0]])
+            return tf.reduce_mean(y, axis=[1, 2])
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([2, 8, 8, 3], tf.float32))
+        x = rng.randn(2, 8, 8, 3).astype(np.float32)
+        golden = model(tf.constant(x)).numpy()
+        sd = TensorflowImporter().run_import(gd)
+        got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+        np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+    def test_strided_slice_clip_cumsum_golden(self):
+        def model(x):
+            y = tf.strided_slice(x, [0, 1], [3, 7], [1, 2])
+            y = tf.clip_by_value(y, -0.5, 0.5)
+            return tf.cumsum(y, axis=1)
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([3, 8], tf.float32))
+        x = np.random.RandomState(8).randn(3, 8).astype(np.float32)
+        golden = model(tf.constant(x)).numpy()
+        sd = import_frozen_graph(gd.SerializeToString())
+        got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+        np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
