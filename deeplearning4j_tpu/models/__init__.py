@@ -10,4 +10,8 @@ from deeplearning4j_tpu.models.zoo import (
     Darknet19,
     UNet,
     TextGenerationLSTM,
+    VGG19,
+    SqueezeNet,
+    Xception,
+    TinyYOLO,
 )

@@ -350,3 +350,253 @@ class TextGenerationLSTM(ZooModel):
             .build()
         )
         return nn.MultiLayerNetwork(conf).init()
+
+
+class VGG19(ZooModel):
+    """zoo/model/VGG19.java: 16 conv + 3 dense (VGG16 with one extra conv
+    in each of the last three stages)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.Nesterovs(learning_rate=1e-2, momentum=0.9)
+        self.input_shape = input_shape
+
+    def init(self) -> nn.MultiLayerNetwork:
+        h, w, c = self.input_shape
+        b = self._builder(self.seed, self.updater).list()
+        for n_out, reps in [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]:
+            for _ in range(reps):
+                b = b.layer(nn.ConvolutionLayer(n_out=n_out, kernel=(3, 3),
+                                                convolution_mode="same",
+                                                activation="relu"))
+            b = b.layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        conf = (
+            b.layer(nn.DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(nn.DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(nn.OutputLayer(n_out=self.num_classes, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.convolutional(h, w, c))
+            .build()
+        )
+        return nn.MultiLayerNetwork(conf).init()
+
+
+class SqueezeNet(ZooModel):
+    """zoo/model/SqueezeNet.java (v1.1): fire modules — 1×1 squeeze then
+    parallel 1×1/3×3 expands concatenated (MergeVertex DAG)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (227, 227, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.Adam(learning_rate=1e-3)
+        self.input_shape = input_shape
+
+    def _fire(self, b: GraphBuilder, name: str, inp: str, squeeze: int,
+              expand: int) -> str:
+        b.add_layer(f"{name}_sq", nn.ConvolutionLayer(
+            n_out=squeeze, kernel=(1, 1), activation="relu",
+            convolution_mode="same"), inp)
+        b.add_layer(f"{name}_e1", nn.ConvolutionLayer(
+            n_out=expand, kernel=(1, 1), activation="relu",
+            convolution_mode="same"), f"{name}_sq")
+        b.add_layer(f"{name}_e3", nn.ConvolutionLayer(
+            n_out=expand, kernel=(3, 3), activation="relu",
+            convolution_mode="same"), f"{name}_sq")
+        b.add_vertex(f"{name}_cat", MergeVertex(), f"{name}_e1", f"{name}_e3")
+        return f"{name}_cat"
+
+    def init(self) -> ComputationGraph:
+        h, w, c = self.input_shape
+        b = (graph_builder().seed(self.seed).updater(self.updater)
+             .weight_init("relu")
+             .add_inputs("input")
+             .set_input_types(input=nn.InputType.convolutional(h, w, c)))
+        b.add_layer("conv1", nn.ConvolutionLayer(
+            n_out=64, kernel=(3, 3), stride=(2, 2), activation="relu",
+            convolution_mode="valid"), "input")
+        b.add_layer("pool1", nn.SubsamplingLayer(kernel=(3, 3), stride=(2, 2)),
+                    "conv1")
+        node = self._fire(b, "fire2", "pool1", 16, 64)
+        node = self._fire(b, "fire3", node, 16, 64)
+        b.add_layer("pool3", nn.SubsamplingLayer(kernel=(3, 3), stride=(2, 2)),
+                    node)
+        node = self._fire(b, "fire4", "pool3", 32, 128)
+        node = self._fire(b, "fire5", node, 32, 128)
+        b.add_layer("pool5", nn.SubsamplingLayer(kernel=(3, 3), stride=(2, 2)),
+                    node)
+        node = self._fire(b, "fire6", "pool5", 48, 192)
+        node = self._fire(b, "fire7", node, 48, 192)
+        node = self._fire(b, "fire8", node, 64, 256)
+        node = self._fire(b, "fire9", node, 64, 256)
+        b.add_layer("drop9", nn.DropoutLayer(rate=0.5), node)
+        b.add_layer("conv10", nn.ConvolutionLayer(
+            n_out=self.num_classes, kernel=(1, 1), activation="relu",
+            convolution_mode="same"), "drop9")
+        b.add_layer("gap", nn.GlobalPoolingLayer(pooling_type="avg"), "conv10")
+        b.add_layer("out", nn.LossLayer(loss="mcxent", activation="softmax"), "gap")
+        b.set_outputs("out")
+        return ComputationGraph(b.build()).init()
+
+
+class Xception(ZooModel):
+    """zoo/model/Xception.java: separable-conv stacks with residual
+    projection shortcuts (entry/middle/exit flows; middle-flow repeat count
+    is configurable so tests stay small)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (299, 299, 3),
+                 middle_repeats: int = 8):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.Adam(learning_rate=1e-3)
+        self.input_shape = input_shape
+        self.middle_repeats = middle_repeats
+
+    def _sep_bn(self, b, name, inp, n_out, relu_first=True):
+        if relu_first:
+            b.add_layer(f"{name}_act", nn.ActivationLayer(activation="relu"), inp)
+            inp = f"{name}_act"
+        b.add_layer(f"{name}_sep", nn.SeparableConvolution2D(
+            n_out=n_out, kernel=(3, 3), convolution_mode="same",
+            activation="identity", has_bias=False), inp)
+        b.add_layer(f"{name}_bn", nn.BatchNormalization(activation="identity"),
+                    f"{name}_sep")
+        return f"{name}_bn"
+
+    def _entry_block(self, b, name, inp, n_out, first_relu=True):
+        node = self._sep_bn(b, f"{name}_a", inp, n_out, relu_first=first_relu)
+        node = self._sep_bn(b, f"{name}_b", node, n_out)
+        b.add_layer(f"{name}_pool", nn.SubsamplingLayer(
+            kernel=(3, 3), stride=(2, 2), convolution_mode="same"), node)
+        b.add_layer(f"{name}_sc", nn.ConvolutionLayer(
+            n_out=n_out, kernel=(1, 1), stride=(2, 2), convolution_mode="same",
+            activation="identity", has_bias=False), inp)
+        b.add_layer(f"{name}_scbn", nn.BatchNormalization(activation="identity"),
+                    f"{name}_sc")
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"),
+                     f"{name}_pool", f"{name}_scbn")
+        return f"{name}_add"
+
+    def init(self) -> ComputationGraph:
+        h, w, c = self.input_shape
+        b = (graph_builder().seed(self.seed).updater(self.updater)
+             .weight_init("relu")
+             .add_inputs("input")
+             .set_input_types(input=nn.InputType.convolutional(h, w, c)))
+        b.add_layer("conv1", nn.ConvolutionLayer(
+            n_out=32, kernel=(3, 3), stride=(2, 2), activation="identity",
+            convolution_mode="same", has_bias=False), "input")
+        b.add_layer("bn1", nn.BatchNormalization(activation="relu"), "conv1")
+        b.add_layer("conv2", nn.ConvolutionLayer(
+            n_out=64, kernel=(3, 3), activation="identity",
+            convolution_mode="same", has_bias=False), "bn1")
+        b.add_layer("bn2", nn.BatchNormalization(activation="relu"), "conv2")
+        node = self._entry_block(b, "entry1", "bn2", 128, first_relu=False)
+        node = self._entry_block(b, "entry2", node, 256)
+        node = self._entry_block(b, "entry3", node, 728)
+        for i in range(self.middle_repeats):
+            inp = node
+            m = self._sep_bn(b, f"mid{i}_a", inp, 728)
+            m = self._sep_bn(b, f"mid{i}_b", m, 728)
+            m = self._sep_bn(b, f"mid{i}_c", m, 728)
+            b.add_vertex(f"mid{i}_add", ElementWiseVertex(op="add"), m, inp)
+            node = f"mid{i}_add"
+        # exit block (Xception.java block13): sepconv 728 then 1024, with a
+        # 1024-channel projection shortcut
+        inp = node
+        node = self._sep_bn(b, "exit1_a", inp, 728)
+        node = self._sep_bn(b, "exit1_b", node, 1024)
+        b.add_layer("exit1_pool", nn.SubsamplingLayer(
+            kernel=(3, 3), stride=(2, 2), convolution_mode="same"), node)
+        b.add_layer("exit1_sc", nn.ConvolutionLayer(
+            n_out=1024, kernel=(1, 1), stride=(2, 2), convolution_mode="same",
+            activation="identity", has_bias=False), inp)
+        b.add_layer("exit1_scbn", nn.BatchNormalization(activation="identity"),
+                    "exit1_sc")
+        b.add_vertex("exit1_add", ElementWiseVertex(op="add"),
+                     "exit1_pool", "exit1_scbn")
+        node = "exit1_add"
+        node = self._sep_bn(b, "exit2", node, 1536)
+        b.add_layer("exit2_relu", nn.ActivationLayer(activation="relu"), node)
+        node = self._sep_bn(b, "exit3", "exit2_relu", 2048)
+        b.add_layer("exit3_relu", nn.ActivationLayer(activation="relu"), node)
+        b.add_layer("gap", nn.GlobalPoolingLayer(pooling_type="avg"),
+                    "exit3_relu")
+        b.add_layer("fc", nn.OutputLayer(n_out=self.num_classes,
+                                         activation="softmax", loss="mcxent"),
+                    "gap")
+        b.set_outputs("fc")
+        return ComputationGraph(b.build()).init()
+
+
+class TinyYOLO(ZooModel):
+    """zoo/model/TinyYOLO.java: darknet-tiny backbone → 1×1 detection conv
+    emitting B·(5+C) channels per cell.
+
+    The reference appends Yolo2OutputLayer (anchor-box decode + multi-part
+    YOLOv2 loss); here the head is the raw detection tensor plus
+    ``yolo_loss`` implementing the same sum-squared objective
+    (coords/obj/noobj/class) against (N, H, W, B, 5+C) targets — training
+    runs through MultiLayerNetwork.fit with this loss via LossLayer("mse")
+    replaced by the external objective (see tests)."""
+
+    def __init__(self, num_classes: int = 20, num_boxes: int = 5,
+                 seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (416, 416, 3)):
+        self.num_classes = num_classes
+        self.num_boxes = num_boxes
+        self.seed = seed
+        self.updater = updater or nn.Adam(learning_rate=1e-3)
+        self.input_shape = input_shape
+
+    def init(self) -> nn.MultiLayerNetwork:
+        h, w, c = self.input_shape
+        b = self._builder(self.seed, self.updater).list()
+        filters = [16, 32, 64, 128, 256]
+        for f in filters:
+            b = b.layer(nn.ConvolutionLayer(
+                n_out=f, kernel=(3, 3), convolution_mode="same",
+                activation="identity", has_bias=False))
+            b = b.layer(nn.BatchNormalization(activation="leakyrelu"))
+            b = b.layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        for f in (512, 1024):
+            b = b.layer(nn.ConvolutionLayer(
+                n_out=f, kernel=(3, 3), convolution_mode="same",
+                activation="identity", has_bias=False))
+            b = b.layer(nn.BatchNormalization(activation="leakyrelu"))
+        depth = self.num_boxes * (5 + self.num_classes)
+        conf = (
+            b.layer(nn.ConvolutionLayer(n_out=depth, kernel=(1, 1),
+                                        convolution_mode="same",
+                                        activation="identity"))
+            .set_input_type(nn.InputType.convolutional(h, w, c))
+            .build()
+        )
+        return nn.MultiLayerNetwork(conf).init()
+
+    def yolo_loss(self, pred, target, *, lambda_coord: float = 5.0,
+                  lambda_noobj: float = 0.5):
+        """YOLOv2-style sum-squared loss (Yolo2OutputLayer.computeScore
+        analog). pred: (N, H, W, B*(5+C)) raw head output; target:
+        (N, H, W, B, 5+C) with [x, y, w, h, obj, class-onehot...]."""
+        import jax
+        import jax.numpy as jnp
+
+        n, gh, gw, _ = pred.shape
+        bx = self.num_boxes
+        p = pred.reshape(n, gh, gw, bx, 5 + self.num_classes)
+        xy = jax.nn.sigmoid(p[..., 0:2])
+        wh = p[..., 2:4]
+        obj = jax.nn.sigmoid(p[..., 4])
+        cls = jax.nn.softmax(p[..., 5:], axis=-1)
+        t_xy, t_wh = target[..., 0:2], target[..., 2:4]
+        t_obj, t_cls = target[..., 4], target[..., 5:]
+        coord = jnp.sum(t_obj[..., None] * ((xy - t_xy) ** 2 + (wh - t_wh) ** 2))
+        obj_term = jnp.sum(t_obj * (obj - 1.0) ** 2)
+        noobj = jnp.sum((1 - t_obj) * obj ** 2)
+        cls_term = jnp.sum(t_obj[..., None] * (cls - t_cls) ** 2)
+        return (lambda_coord * coord + obj_term + lambda_noobj * noobj
+                + cls_term) / n

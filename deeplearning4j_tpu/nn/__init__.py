@@ -33,6 +33,16 @@ from deeplearning4j_tpu.nn.conf import (
     RnnOutputLayer,
     LastTimeStep,
     SelfAttentionLayer,
+    AttentionVertex,
+    LearnedSelfAttentionLayer,
+    RecurrentAttentionLayer,
+    Convolution1D,
+    Convolution3D,
+    Subsampling3DLayer,
+    LocallyConnected2D,
+    LocallyConnected1D,
+    PReLULayer,
+    VariationalAutoencoder,
     dl4j_drop_out,
 )
 from deeplearning4j_tpu.nn.updater import (

@@ -45,6 +45,7 @@ class InputType:
     height: int = 0
     width: int = 0
     channels: int = 0
+    depth: int = 0  # convolutional3d only
     timesteps: int = -1  # -1: variable
 
     @staticmethod
@@ -60,6 +61,13 @@ class InputType:
         return InputType("convolutional", height=height, width=width, channels=channels)
 
     @staticmethod
+    def convolutional3d(depth: int, height: int, width: int,
+                        channels: int) -> "InputType":
+        """NDHWC volumetric input (InputType.InputTypeConvolutional3D)."""
+        return InputType("convolutional3d", depth=depth, height=height,
+                         width=width, channels=channels)
+
+    @staticmethod
     def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
         return InputType(
             "convolutionalflat",
@@ -72,6 +80,8 @@ class InputType:
     def flat_size(self) -> int:
         if self.kind in ("feedforward", "convolutionalflat", "recurrent"):
             return self.size if self.size else self.height * self.width * self.channels
+        if self.kind == "convolutional3d":
+            return self.depth * self.height * self.width * self.channels
         return self.height * self.width * self.channels
 
     def to_dict(self):
@@ -491,6 +501,206 @@ class SelfAttentionLayer(LayerConf):
         return True
 
 
+@dataclasses.dataclass(frozen=True)
+class LearnedSelfAttentionLayer(LayerConf):
+    """conf/layers/LearnedSelfAttentionLayer.java: a fixed set of LEARNED
+    query vectors attends over the input sequence — output has n_queries
+    timesteps regardless of input length (the reference's fixed-size
+    sequence summarizer)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    n_queries: int = 1
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, self.n_queries)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class RecurrentAttentionLayer(LayerConf):
+    """conf/layers/RecurrentAttentionLayer.java: RNN whose step input is
+    augmented with single-head attention over the whole input sequence,
+    queried by the previous hidden state — out_t = act(Wx·x_t + Wr·attn_t
+    + b)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    activation: str = "tanh"
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class AttentionVertex(LayerConf):
+    """conf/graph/AttentionVertex.java: multi-head attention as a GRAPH
+    vertex with PARAMS — inputs (queries, keys, values) or (queries,
+    keys=values). Registered through GraphBuilder.add_vertex (which routes
+    parameterized vertices onto the layer path)."""
+
+    n_out: int = 0
+    n_heads: int = 1
+    n_in_queries: int = 0
+    n_in_keys: int = 0
+    n_in_values: int = 0
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class Convolution1D(LayerConf):
+    """conf/layers/Convolution1DLayer.java: temporal conv over (N, T, C)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    convolution_mode: str = "same"  # same | valid (truncate)
+    dilation: int = 1
+
+    def output_type(self, itype):
+        t = itype.timesteps
+        if t and t > 0:
+            if self.convolution_mode == "same":
+                t = -(-t // self.stride)
+            else:
+                eff = (self.kernel - 1) * self.dilation + 1
+                t = (t - eff) // self.stride + 1
+        return InputType.recurrent(self.n_out, t)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class Convolution3D(LayerConf):
+    """conf/layers/Convolution3D.java: volumetric conv over (N, D, H, W, C)
+    (NDHWC — the TPU-friendly channels-last 3-D layout)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel: Tuple[int, int, int] = (3, 3, 3)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    convolution_mode: str = "same"
+
+    def output_type(self, itype):
+        def out(sz, k, s):
+            return -(-sz // s) if self.convolution_mode == "same" \
+                else (sz - k) // s + 1
+
+        k, s = self.kernel, self.stride
+        return InputType.convolutional3d(
+            out(itype.depth, k[0], s[0]), out(itype.height, k[1], s[1]),
+            out(itype.width, k[2], s[2]), self.n_out)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class Subsampling3DLayer(LayerConf):
+    """conf/layers/Subsampling3DLayer.java: 3-D pooling (NDHWC)."""
+
+    kernel: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (2, 2, 2)
+    pooling_type: str = "max"
+
+    def output_type(self, itype):
+        k, s = self.kernel, self.stride
+        return InputType.convolutional3d(
+            (itype.depth - k[0]) // s[0] + 1,
+            (itype.height - k[1]) // s[1] + 1,
+            (itype.width - k[2]) // s[2] + 1, itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class LocallyConnected2D(LayerConf):
+    """conf/layers/LocallyConnected2D.java: conv topology with UNSHARED
+    per-position weights."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    input_size: Tuple[int, int] = (0, 0)  # inferred at build when 0
+
+    def output_type(self, itype):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        return InputType.convolutional(
+            (itype.height - kh) // sh + 1, (itype.width - kw) // sw + 1,
+            self.n_out)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class LocallyConnected1D(LayerConf):
+    """conf/layers/LocallyConnected1D.java: temporal locally-connected."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    input_size: int = 0
+
+    def output_type(self, itype):
+        t = (itype.timesteps - self.kernel) // self.stride + 1 \
+            if itype.timesteps and itype.timesteps > 0 else itype.timesteps
+        return InputType.recurrent(self.n_out, t)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class PReLULayer(LayerConf):
+    """conf/layers/PReLULayer.java: y = max(0,x) + alpha·min(0,x) with a
+    LEARNED per-feature alpha."""
+
+    n_in: int = 0  # feature count (last-axis size)
+
+    def output_type(self, itype):
+        return itype
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class VariationalAutoencoder(LayerConf):
+    """conf/layers/variational/VariationalAutoencoder.java: pretrainable
+    VAE layer. Supervised forward emits the latent MEAN (the reference's
+    activate() semantics); reconstruction_log_prob / pretrain losses live
+    on the impl."""
+
+    n_in: int = 0
+    n_out: int = 0  # latent size
+    encoder_layer_sizes: Tuple[int, ...] = (256,)
+    decoder_layer_sizes: Tuple[int, ...] = (256,)
+    activation: str = "leakyrelu"
+    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out)
+
+    def has_params(self):
+        return True
+
+
 # ---------------------------------------------------------------------------
 # Preprocessors (conf/preprocessor/*) — shape adapters between layers
 # ---------------------------------------------------------------------------
@@ -527,6 +737,17 @@ class CnnToFeedForwardPreProcessor(InputPreProcessor):
     """(N, H, W, C) -> (N, H*W*C); flatten order matches reference NCHW
     flattening (C-major) so exported flat params/activations line up."""
 
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Cnn3DToFeedForwardPreProcessor(InputPreProcessor):
+    """(N, D, H, W, C) -> (N, D·H·W·C) (Cnn3DToFeedForwardPreProcessor.java;
+    C-major flatten matching the reference NCDHW ordering)."""
+
+    depth: int = 0
     height: int = 0
     width: int = 0
     channels: int = 0
@@ -579,6 +800,16 @@ LAYER_TYPES = {
         RnnOutputLayer,
         LastTimeStep,
         SelfAttentionLayer,
+        AttentionVertex,
+        LearnedSelfAttentionLayer,
+        RecurrentAttentionLayer,
+        Convolution1D,
+        Convolution3D,
+        Subsampling3DLayer,
+        LocallyConnected2D,
+        LocallyConnected1D,
+        PReLULayer,
+        VariationalAutoencoder,
     ]
 }
 
@@ -793,6 +1024,11 @@ def _adapt(conf, i, itype, lc) -> Tuple[InputType, LayerConf]:
                 itype.height, itype.width, itype.channels
             )
             itype = InputType.feed_forward(itype.flat_size())
+        elif itype.kind == "convolutional3d" and needs_ff:
+            conf.preprocessors[i] = Cnn3DToFeedForwardPreProcessor(
+                itype.depth, itype.height, itype.width, itype.channels
+            )
+            itype = InputType.feed_forward(itype.flat_size())
         elif itype.kind == "convolutionalflat" and needs_ff:
             itype = InputType.feed_forward(itype.size)
     else:
@@ -818,10 +1054,18 @@ def _adapt(conf, i, itype, lc) -> Tuple[InputType, LayerConf]:
             updates["n_in"] = itype.flat_size()
         elif itype.kind == "recurrent":
             updates["n_in"] = itype.size
-        elif itype.kind == "convolutional":
+        elif itype.kind in ("convolutional", "convolutional3d"):
             updates["n_in"] = itype.channels
     if isinstance(lc, BatchNormalization) and lc.n_out == 0:
         updates["n_out"] = itype.channels if itype.kind == "convolutional" else itype.flat_size()
+    if isinstance(lc, LocallyConnected2D) and tuple(lc.input_size) == (0, 0):
+        updates["input_size"] = (itype.height, itype.width)
+    if isinstance(lc, LocallyConnected1D) and lc.input_size == 0:
+        if not itype.timesteps or itype.timesteps < 0:
+            raise ValueError(
+                "LocallyConnected1D needs a fixed sequence length — set "
+                "input_size or use InputType.recurrent(size, timesteps)")
+        updates["input_size"] = itype.timesteps
     if updates:
         lc = dataclasses.replace(lc, **updates)
     return itype, lc

@@ -186,6 +186,50 @@ class ReshapeVertex(GraphVertex):
 
 
 @dataclasses.dataclass(frozen=True)
+class UnstackVertex(GraphVertex):
+    """UnstackVertex.java: inverse of StackVertex — slice subrange
+    [from·size : (from+1)·size] of the batch axis (stack_size = number of
+    stacked inputs the producing StackVertex concatenated)."""
+
+    from_idx: int = 0
+    stack_size: int = 1
+
+    def apply(self, inputs):
+        x = inputs[0]
+        n = x.shape[0] // self.stack_size
+        return x[self.from_idx * n:(self.from_idx + 1) * n]
+
+
+@dataclasses.dataclass(frozen=True)
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """DuplicateToTimeSeriesVertex.java: broadcast a (N, F) feed-forward
+    input across the timesteps of a reference recurrent input — inputs are
+    (value, time_reference)."""
+
+    def apply(self, inputs):
+        val, ref = inputs
+        t = ref.shape[1]
+        return jnp.broadcast_to(val[:, None, :], (val.shape[0], t, val.shape[1]))
+
+    def output_type(self, itypes):
+        return C.InputType.recurrent(itypes[0].flat_size(),
+                                     itypes[1].timesteps)
+
+
+@dataclasses.dataclass(frozen=True)
+class LastTimeStepVertex(GraphVertex):
+    """LastTimeStepVertex.java: (N, T, F) → (N, F) last step. NOTE: vertices
+    do not receive masks in this engine; for masked sequences use the
+    LastTimeStep LAYER wrapper (conf.LastTimeStep), which does."""
+
+    def apply(self, inputs):
+        return inputs[0][:, -1]
+
+    def output_type(self, itypes):
+        return C.InputType.feed_forward(itypes[0].size)
+
+
+@dataclasses.dataclass(frozen=True)
 class FlattenVertex(GraphVertex):
     """Batch-preserving flatten (PreprocessorVertex(CnnToFeedForward)
     analog, but feature-major order preserved — used by the Keras
@@ -203,7 +247,8 @@ VERTEX_TYPES = {
     c.__name__: c
     for c in [MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex,
               ShiftVertex, L2NormalizeVertex, StackVertex, ReshapeVertex,
-              FlattenVertex]
+              FlattenVertex, UnstackVertex, DuplicateToTimeSeriesVertex,
+              LastTimeStepVertex]
 }
 
 
@@ -366,7 +411,12 @@ class GraphBuilder:
                                            inputs=list(inputs)))
         return self
 
-    def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str):
+    def add_vertex(self, name: str, vertex, *inputs: str):
+        # parameterized vertices (reference AttentionVertex et al. extend
+        # SameDiffVertex WITH params) are LayerConf instances here — route
+        # them to the layer path, which owns params/state
+        if isinstance(vertex, C.LayerConf):
+            return self.add_layer(name, vertex, *inputs)
         self._conf.nodes.append(_GraphNode(name=name, kind="vertex", vertex=vertex,
                                            inputs=list(inputs)))
         return self
@@ -466,7 +516,7 @@ class ComputationGraph:
         auto preprocessor insertion)."""
         lc = node.layer
         needs_ff = isinstance(lc, (C.DenseLayer, C.OutputLayer, C.EmbeddingLayer))
-        if itype.kind == "convolutional" and needs_ff:
+        if itype.kind in ("convolutional", "convolutional3d") and needs_ff:
             itype = C.InputType.feed_forward(itype.flat_size())
             node.kind = "layer"  # unchanged; flattening applied at runtime
             setattr(node, "_flatten_input", True)
@@ -521,14 +571,24 @@ class ComputationGraph:
                     ms = [act_masks.get(i) for i in node.inputs]
                     act_masks[node.name] = next((m for m in ms if m is not None), None)
                 else:
-                    x = xs[0]
-                    if getattr(node, "_flatten_input", False) and x.ndim == 4:
-                        x = x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
                     layer = self.layers[node.name]
                     mask = act_masks.get(node.inputs[0])
-                    y, st, m2 = layer.apply(
-                        params[node.name], x, net_state[node.name],
-                        train=train, rng=rng_map[node.name], mask=mask)
+                    if hasattr(layer, "apply_multi"):
+                        # parameterized multi-input node (AttentionVertex
+                        # role): gets ALL wired inputs, not just the first
+                        y, st, m2 = layer.apply_multi(
+                            params[node.name], xs, net_state[node.name],
+                            train=train, rng=rng_map[node.name], mask=mask)
+                    else:
+                        x = xs[0]
+                        if getattr(node, "_flatten_input", False):
+                            if x.ndim == 4:  # NHWC → reference C-major flat
+                                x = x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
+                            elif x.ndim == 5:  # NDHWC → C-major flat
+                                x = x.transpose(0, 4, 1, 2, 3).reshape(x.shape[0], -1)
+                        y, st, m2 = layer.apply(
+                            params[node.name], x, net_state[node.name],
+                            train=train, rng=rng_map[node.name], mask=mask)
                     acts[node.name] = y
                     act_masks[node.name] = m2
                     new_state[node.name] = st
@@ -632,6 +692,34 @@ class ComputationGraph:
             self.epoch_count += 1
             for lst in self.listeners:
                 lst.on_epoch_end(self)
+
+    def fit_multi(self, inputs, labels) -> float:
+        """One training step with multiple inputs/outputs (the
+        ComputationGraph.fit(MultiDataSet) role). ``inputs``/``labels``:
+        lists aligned with network_inputs/network_outputs, or name dicts.
+        Returns the step loss."""
+        if not isinstance(inputs, dict):
+            inputs = dict(zip(self.conf.network_inputs, inputs))
+        if not isinstance(labels, dict):
+            labels = dict(zip(self.conf.network_outputs, labels))
+        step_fn = self._jit_cache.get("train_step")
+        if step_fn is None:
+            step_fn = self._make_train_step()
+            self._jit_cache["train_step"] = step_fn
+        self._key, sub = jax.random.split(self._key)
+        feeds = {k: jnp.asarray(v) for k, v in inputs.items()}
+        labs = {k: jnp.asarray(v) for k, v in labels.items()}
+        self.last_batch_size = next(iter(feeds.values())).shape[0]
+        self.params, self.opt_state, self.net_state, loss = step_fn(
+            self.params, self.opt_state, self.net_state,
+            jnp.asarray(self.iteration_count, jnp.int32), sub,
+            feeds, labs, None, None)
+        self._score = loss
+        self.iteration_count += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration_count, self.epoch_count,
+                               loss)
+        return float(loss)
 
     def fit_scanned(self, features, labels, steps: Optional[int] = None) -> np.ndarray:
         """Many fused train steps in ONE XLA call — lax.scan over the train

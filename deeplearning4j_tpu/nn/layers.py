@@ -604,6 +604,334 @@ class SelfAttentionLayerImpl(Layer):
         return out @ params["Wo"], state, mask
 
 
+class LearnedSelfAttentionLayerImpl(Layer):
+    """layers/LearnedSelfAttentionLayer.java: learned query matrix attends
+    over the input sequence → fixed n_queries output timesteps. Routes the
+    attention through the op registry so the Pallas flash helper fires on
+    TPU for long sequences."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        ks = jax.random.split(key, 4)
+        d = lc.n_out
+        return {
+            "Q": init_weights(ks[0], (lc.n_queries, d), self.winit, dtype=self.dtype),
+            "Wk": init_weights(ks[1], (lc.n_in, d), self.winit, dtype=self.dtype),
+            "Wv": init_weights(ks[2], (lc.n_in, d), self.winit, dtype=self.dtype),
+            "Wo": init_weights(ks[3], (d, d), self.winit, dtype=self.dtype),
+        }
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        from deeplearning4j_tpu.ops import exec_op
+
+        h = self.lc.n_heads
+        n, t, _ = x.shape
+        d = self.lc.n_out
+        dh = d // h
+        q = jnp.broadcast_to(params["Q"][None], (n,) + params["Q"].shape)
+        k = x @ params["Wk"]
+        v = x @ params["Wv"]
+
+        def split(a):
+            return a.reshape(n, a.shape[1], h, dh).transpose(0, 2, 1, 3)
+
+        m = None if mask is None else mask[:, None, None, :]
+        out = exec_op("dot_product_attention", split(q), split(k), split(v),
+                      m, scaled=True)
+        out = out.transpose(0, 2, 1, 3).reshape(n, self.lc.n_queries, d)
+        return out @ params["Wo"], state, None  # fixed-length output: no mask
+
+
+class RecurrentAttentionLayerImpl(Layer):
+    """layers/RecurrentAttentionLayer.java: out_t = act(Wx·x_t + Wr·attn_t
+    + b) where attn_t attends over the WHOLE input sequence queried by the
+    previous output — a lax.scan over timesteps (TPU-compilable; the
+    reference loops in Java)."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        ks = jax.random.split(key, 5)
+        return {
+            "Wx": init_weights(ks[0], (lc.n_in, lc.n_out), self.winit, dtype=self.dtype),
+            "Wr": init_weights(ks[1], (lc.n_in, lc.n_out), self.winit, dtype=self.dtype),
+            "Wq": init_weights(ks[2], (lc.n_out, lc.n_in), self.winit, dtype=self.dtype),
+            "b": jnp.zeros((lc.n_out,), self.dtype),
+        }
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        n, t, d_in = x.shape
+        heads = max(1, self.lc.n_heads)
+        if d_in % heads:
+            raise ValueError(
+                f"RecurrentAttentionLayer: n_in={d_in} not divisible by "
+                f"n_heads={heads}")
+        dh = d_in // heads
+        scale = 1.0 / float(dh) ** 0.5
+        key_mask = None if mask is None else (mask > 0)
+        xh = x.reshape(n, t, heads, dh)  # keys/values per head
+
+        def step(h, x_t):
+            q = (h @ params["Wq"]).reshape(n, heads, dh)
+            s = jnp.einsum("nhd,nthd->nht", q, xh) * scale
+            if key_mask is not None:
+                s = jnp.where(key_mask[:, None, :], s, -1e9)
+            a = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("nht,nthd->nhd", a, xh).reshape(n, d_in)
+            h_new = self.activation(x_t @ params["Wx"] + attn @ params["Wr"]
+                                    + params["b"])
+            return h_new, h_new
+
+        h0 = jnp.zeros((n, self.lc.n_out), x.dtype)
+        _, ys = jax.lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+        return jnp.swapaxes(ys, 0, 1), state, mask
+
+
+class AttentionVertexImpl(Layer):
+    """graph/vertex AttentionVertex: parameterized multi-input attention.
+    Routed through the op registry → Pallas flash helper on TPU."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        ks = jax.random.split(key, 4)
+        d = lc.n_out
+        nq = lc.n_in_queries or lc.n_in_keys
+        nk = lc.n_in_keys or nq
+        nv = lc.n_in_values or nk
+        return {
+            "Wq": init_weights(ks[0], (nq, d), self.winit, dtype=self.dtype),
+            "Wk": init_weights(ks[1], (nk, d), self.winit, dtype=self.dtype),
+            "Wv": init_weights(ks[2], (nv, d), self.winit, dtype=self.dtype),
+            "Wo": init_weights(ks[3], (d, d), self.winit, dtype=self.dtype),
+        }
+
+    def apply_multi(self, params, xs, state, *, train, rng, mask=None):
+        from deeplearning4j_tpu.ops import exec_op
+
+        queries = xs[0]
+        keys = xs[1] if len(xs) > 1 else xs[0]
+        values = xs[2] if len(xs) > 2 else keys
+        out = exec_op("multi_head_dot_product_attention",
+                      queries, keys, values,
+                      params["Wq"], params["Wk"], params["Wv"], params["Wo"],
+                      mask, num_heads=self.lc.n_heads)
+        return out, state, mask
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return self.apply_multi(params, [x], state, train=train, rng=rng,
+                                mask=mask)
+
+
+class Convolution1DImpl(Layer):
+    """layers/convolution/Convolution1DLayer.java over (N, T, C)."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        p = {"W": init_weights(key, (lc.kernel, lc.n_in, lc.n_out),
+                               self.winit, dtype=self.dtype)}
+        p["b"] = jnp.zeros((lc.n_out,), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        z = nn_ops.conv1d.fn(x, params["W"], params.get("b"),
+                             stride=lc.stride,
+                             padding=lc.convolution_mode,
+                             dilation=lc.dilation)
+        if mask is not None and z.shape[1] != mask.shape[1]:
+            # subsample the mask with the conv (reference Conv1D semantics:
+            # a timestep survives if its window START was valid)
+            mask = mask[:, ::lc.stride][:, :z.shape[1]]
+        return self.activation(z), state, mask
+
+
+class Convolution3DImpl(Layer):
+    """layers/convolution/Convolution3DLayer.java over (N, D, H, W, C)."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        kd, kh, kw = lc.kernel
+        return {
+            "W": init_weights(key, (kd, kh, kw, lc.n_in, lc.n_out),
+                              self.winit, dtype=self.dtype),
+            "b": jnp.zeros((lc.n_out,), self.dtype),
+        }
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        z = nn_ops.conv3d.fn(x, params["W"], params.get("b"),
+                             stride=lc.stride,
+                             padding=lc.convolution_mode)
+        return self.activation(z), state, mask
+
+
+class Subsampling3DLayerImpl(Layer):
+    """layers/convolution/Subsampling3DLayer.java (NDHWC pooling)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        k = (1,) + tuple(lc.kernel) + (1,)
+        s = (1,) + tuple(lc.stride) + (1,)
+        if lc.pooling_type == "max":
+            z = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, k, s, "VALID")
+        else:
+            z = jax.lax.reduce_window(x, 0.0, jax.lax.add, k, s, "VALID") \
+                / float(lc.kernel[0] * lc.kernel[1] * lc.kernel[2])
+        return z, state, mask
+
+
+class LocallyConnected2DImpl(Layer):
+    """layers/convolution/LocallyConnected2DLayer.java: per-position
+    (unshared) conv weights — patches × per-position kernels as ONE einsum,
+    which XLA maps onto the MXU as a batched matmul."""
+
+    def _out_hw(self):
+        lc = self.lc
+        kh, kw = C._pair(lc.kernel)
+        sh, sw = C._pair(lc.stride)
+        ih, iw = lc.input_size
+        return (ih - kh) // sh + 1, (iw - kw) // sw + 1
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        kh, kw = C._pair(lc.kernel)
+        oh, ow = self._out_hw()
+        return {
+            "W": init_weights(key, (oh * ow, kh * kw * lc.n_in, lc.n_out),
+                              self.winit, dtype=self.dtype),
+            "b": jnp.zeros((oh, ow, lc.n_out), self.dtype),
+        }
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        kh, kw = C._pair(lc.kernel)
+        sh, sw = C._pair(lc.stride)
+        oh, ow = self._out_hw()
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # patches feature order is (C, kh, kw); align W accordingly at init?
+        # no — keep W in patch order: reshape to (N, oh*ow, feat)
+        n = x.shape[0]
+        p = patches.reshape(n, oh * ow, -1)
+        z = jnp.einsum("npf,pfo->npo", p, params["W"])
+        z = z.reshape(n, oh, ow, lc.n_out) + params["b"]
+        return self.activation(z), state, mask
+
+
+class LocallyConnected1DImpl(Layer):
+    """layers/convolution/LocallyConnected1DLayer.java over (N, T, C)."""
+
+    def _out_t(self):
+        lc = self.lc
+        return (lc.input_size - lc.kernel) // lc.stride + 1
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        ot = self._out_t()
+        return {
+            "W": init_weights(key, (ot, lc.kernel * lc.n_in, lc.n_out),
+                              self.winit, dtype=self.dtype),
+            "b": jnp.zeros((ot, lc.n_out), self.dtype),
+        }
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        ot = self._out_t()
+        starts = jnp.arange(ot) * lc.stride
+        idx = starts[:, None] + jnp.arange(lc.kernel)[None, :]  # (ot, k)
+        windows = x[:, idx, :]  # (N, ot, k, C)
+        n = x.shape[0]
+        p = windows.reshape(n, ot, -1)
+        z = jnp.einsum("npf,pfo->npo", p, params["W"]) + params["b"]
+        if mask is not None and z.shape[1] != mask.shape[1]:
+            mask = None
+        return self.activation(z), state, mask
+
+
+class PReLULayerImpl(Layer):
+    """layers/feedforward/PReLULayer.java: learned per-feature slope."""
+
+    def init(self, key) -> Params:
+        return {"alpha": jnp.full((self.lc.n_in,), 0.25, self.dtype)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        a = params["alpha"]
+        return jnp.maximum(x, 0) + a * jnp.minimum(x, 0), state, mask
+
+
+class VariationalAutoencoderImpl(Layer):
+    """layers/variational/VariationalAutoencoder.java.
+
+    Supervised forward = encoder → latent mean (reference activate()
+    semantics). ``elbo_loss(params, x, rng)`` gives the pretrain objective
+    (reparameterized ELBO) for unsupervised fit — the reference's
+    pretrain-layer role."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        sizes_e = (lc.n_in,) + tuple(lc.encoder_layer_sizes)
+        sizes_d = (lc.n_out,) + tuple(lc.decoder_layer_sizes)
+        ks = jax.random.split(key, 2 * (len(sizes_e) + len(sizes_d)) + 3)
+        ki = iter(range(len(ks)))
+        p: Dict[str, Any] = {"enc": [], "dec": []}
+        for i in range(len(sizes_e) - 1):
+            p["enc"].append({
+                "W": init_weights(ks[next(ki)], (sizes_e[i], sizes_e[i + 1]),
+                                  self.winit, dtype=self.dtype),
+                "b": jnp.zeros((sizes_e[i + 1],), self.dtype)})
+        h = sizes_e[-1]
+        p["mean"] = {"W": init_weights(ks[next(ki)], (h, lc.n_out),
+                                       self.winit, dtype=self.dtype),
+                     "b": jnp.zeros((lc.n_out,), self.dtype)}
+        p["logvar"] = {"W": init_weights(ks[next(ki)], (h, lc.n_out),
+                                         self.winit, dtype=self.dtype),
+                       "b": jnp.zeros((lc.n_out,), self.dtype)}
+        for i in range(len(sizes_d) - 1):
+            p["dec"].append({
+                "W": init_weights(ks[next(ki)], (sizes_d[i], sizes_d[i + 1]),
+                                  self.winit, dtype=self.dtype),
+                "b": jnp.zeros((sizes_d[i + 1],), self.dtype)})
+        p["recon"] = {"W": init_weights(ks[next(ki)],
+                                        (sizes_d[-1], lc.n_in),
+                                        self.winit, dtype=self.dtype),
+                      "b": jnp.zeros((lc.n_in,), self.dtype)}
+        return p
+
+    def _encode(self, params, x):
+        h = x
+        for lp in params["enc"]:
+            h = self.activation(h @ lp["W"] + lp["b"])
+        mean = h @ params["mean"]["W"] + params["mean"]["b"]
+        logvar = h @ params["logvar"]["W"] + params["logvar"]["b"]
+        return mean, logvar
+
+    def _decode(self, params, z):
+        h = z
+        for lp in params["dec"]:
+            h = self.activation(h @ lp["W"] + lp["b"])
+        return h @ params["recon"]["W"] + params["recon"]["b"]
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        mean, _ = self._encode(params, x)
+        return mean, state, mask
+
+    def elbo_loss(self, params, x, rng):
+        mean, logvar = self._encode(params, x)
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        z = mean + jnp.exp(0.5 * logvar) * eps
+        recon = self._decode(params, z)
+        if self.lc.reconstruction_distribution == "bernoulli":
+            p = jax.nn.sigmoid(recon)
+            rec = -jnp.sum(x * jnp.log(p + 1e-8)
+                           + (1 - x) * jnp.log(1 - p + 1e-8), axis=-1)
+        else:
+            rec = 0.5 * jnp.sum((x - recon) ** 2, axis=-1)
+        kl = -0.5 * jnp.sum(1 + logvar - mean ** 2 - jnp.exp(logvar), axis=-1)
+        return jnp.mean(rec + kl)
+
+
 LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.DenseLayer: DenseLayerImpl,
     C.OutputLayer: OutputLayerImpl,
@@ -628,6 +956,16 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.RnnOutputLayer: RnnOutputLayerImpl,
     C.LastTimeStep: LastTimeStepImpl,
     C.SelfAttentionLayer: SelfAttentionLayerImpl,
+    C.AttentionVertex: AttentionVertexImpl,
+    C.LearnedSelfAttentionLayer: LearnedSelfAttentionLayerImpl,
+    C.RecurrentAttentionLayer: RecurrentAttentionLayerImpl,
+    C.Convolution1D: Convolution1DImpl,
+    C.Convolution3D: Convolution3DImpl,
+    C.Subsampling3DLayer: Subsampling3DLayerImpl,
+    C.LocallyConnected2D: LocallyConnected2DImpl,
+    C.LocallyConnected1D: LocallyConnected1DImpl,
+    C.PReLULayer: PReLULayerImpl,
+    C.VariationalAutoencoder: VariationalAutoencoderImpl,
 }
 
 
@@ -648,6 +986,9 @@ def apply_preprocessor(p: Optional[C.InputPreProcessor], x):
     if isinstance(p, C.CnnToFeedForwardPreProcessor):
         # inverse: NHWC -> NCHW-major flatten to match reference flat ordering
         return x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
+    if isinstance(p, C.Cnn3DToFeedForwardPreProcessor):
+        # NDHWC -> channel-major flatten (reference NCDHW ordering)
+        return x.transpose(0, 4, 1, 2, 3).reshape(x.shape[0], -1)
     if isinstance(p, C.RnnToFeedForwardPreProcessor):
         return x.reshape(-1, x.shape[-1])
     if isinstance(p, C.FeedForwardToRnnPreProcessor):

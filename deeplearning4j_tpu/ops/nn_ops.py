@@ -460,7 +460,9 @@ def multi_head_dot_product_attention(q, k, v, wq, wk, wv, wo, mask=None, *,
     m = None
     if mask is not None:
         m = mask[:, None, None, :].astype(bool)
-    out = dot_product_attention.fn(qh, kh, vh, m, scaled=scaled)
+    # route through the DESCRIPTOR so the Pallas flash platform helper can
+    # override on TPU (calling .fn would pin the generic XLA path)
+    out = dot_product_attention(qh, kh, vh, m, scaled=scaled)
     b, h, l, d = out.shape
     out = out.transpose(0, 2, 1, 3).reshape(b, l, h * d)
     return jnp.einsum("ble,ed->bld", out, wo)
