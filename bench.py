@@ -58,7 +58,10 @@ def _bench_bert(batch: int, iters: int, dtype: str):
     from deeplearning4j_tpu.models.bert import BertConfig, BertModel
 
     seq = int(os.environ.get("BENCH_SEQ", "512"))
-    cfg = BertConfig.base(dropout=0.0)  # prob-dropout off → flash helper fires
+    # default dropout=0.1 — the production fine-tune config; the Pallas flash
+    # helper handles attention-prob dropout IN-KERNEL since round 3, so the
+    # fast path no longer needs dropout disabled
+    cfg = BertConfig.base()
     model = BertModel(cfg, seed=0,
                       dtype=jnp.bfloat16 if dtype != "float32" else jnp.float32)
     rng = np.random.RandomState(0)
@@ -100,6 +103,59 @@ def _bench_lenet(batch: int, iters: int):
     return batch * iters / dt, "lenet5_mnist_train_images_per_sec"
 
 
+def _bench_attention(iters: int):
+    """Flash-vs-generic attention at T=8192 d=64 bf16 fwd+bwd (the Pallas
+    platform-helper headline; recorded as the BENCH_HISTORY 'attention'
+    entry the kernel docstring points at). Device-side lax.scan loop — wall
+    timing through the axon tunnel is unreliable for single dispatches."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas_attention import (
+        flash_attention, _reference_attention)
+
+    bh, t, d = 8, 8192, 64
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(bh, t, d).astype(np.float32)).astype(jnp.bfloat16)
+    k = jnp.asarray(r.randn(bh, t, d).astype(np.float32)).astype(jnp.bfloat16)
+    v = jnp.asarray(r.randn(bh, t, d).astype(np.float32)).astype(jnp.bfloat16)
+
+    def make(loss_fn):
+        grad = jax.grad(loss_fn, argnums=(0, 1, 2))
+
+        @jax.jit
+        def bench(q, k, v):
+            def body(carry, _):
+                dq, dk, dv = grad(carry, k, v)
+                z = jnp.asarray(0.0, carry.dtype)
+                return carry + z * dq + z * dk + z * dv, jnp.float32(0)
+
+            qf, _ = jax.lax.scan(body, q, None, length=iters)
+            return jnp.sum(qf.astype(jnp.float32))
+
+        return bench
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, None, None, None, True,
+                                       None, None, None, 0.0)
+                       .astype(jnp.float32) ** 2)
+
+    def gen_loss(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, scale=d ** -0.5,
+                                            causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    def run(bench):
+        _ = float(bench(q, k, v))  # compile
+        t0 = time.perf_counter()
+        _ = float(bench(q, k, v))
+        return (time.perf_counter() - t0) / iters
+
+    t_flash = run(make(flash_loss))
+    t_gen = run(make(gen_loss))
+    return t_gen / t_flash, "flash_attention_t8192_speedup_vs_generic"
+
+
 def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
@@ -109,6 +165,8 @@ def main() -> None:
 
     if model == "lenet":
         value, metric = _bench_lenet(batch, iters)
+    elif model == "attention":
+        value, metric = _bench_attention(iters)
     elif model == "bert":
         value, metric = _bench_bert(int(os.environ.get("BENCH_BERT_BATCH", "16")),
                                     iters, dtype)
@@ -122,18 +180,34 @@ def main() -> None:
             hist = json.load(open(hist_path))
         except Exception:
             hist = {}
-    prev = hist.get(metric)
-    vs_baseline = value / prev if prev else 1.0
+    # RATCHET against the max-watermark, not the previous run — a regression
+    # reports <1.0 on EVERY run until fixed instead of resetting its own
+    # baseline (round-2 verdict weak #7)
+    entry = hist.get(metric)
+    if isinstance(entry, dict):
+        watermark = entry.get("watermark", 0.0)
+        runs = entry.get("runs", [])
+    else:  # legacy scalar entry
+        watermark = float(entry) if entry else 0.0
+        runs = []
+    vs_baseline = value / watermark if watermark else 1.0
+    nd = 3 if value < 100 else 1  # keep ratio metrics' ratchet sensitive
+    runs = (runs + [round(value, nd)])[-20:]
     try:
-        hist[metric] = value
-        json.dump(hist, open(hist_path, "w"))
+        hist[metric] = {"watermark": round(max(watermark, value), nd),
+                        "runs": runs}
+        json.dump(hist, open(hist_path, "w"), indent=1)
     except Exception:
         pass
 
+    unit = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
+            "lenet5_mnist_train_images_per_sec": "images/sec/chip",
+            "bert_base_mlm_train_tokens_per_sec": "tokens/sec/chip",
+            "flash_attention_t8192_speedup_vs_generic": "x vs XLA generic"}[metric]
     print(json.dumps({
         "metric": metric,
-        "value": round(value, 1),
-        "unit": "images/sec/chip",
+        "value": round(value, 3 if value < 100 else 1),
+        "unit": unit,
         "vs_baseline": round(vs_baseline, 3),
     }))
 

@@ -768,6 +768,7 @@ PREPROCESSORS = {
     for c in [
         FeedForwardToCnnPreProcessor,
         CnnToFeedForwardPreProcessor,
+        Cnn3DToFeedForwardPreProcessor,
         RnnToFeedForwardPreProcessor,
         FeedForwardToRnnPreProcessor,
     ]

@@ -575,10 +575,14 @@ class ComputationGraph:
                     mask = act_masks.get(node.inputs[0])
                     if hasattr(layer, "apply_multi"):
                         # parameterized multi-input node (AttentionVertex
-                        # role): gets ALL wired inputs, not just the first
+                        # role): gets ALL wired inputs; the mask that
+                        # matters is the KEYS input's (the last wired
+                        # input) — it gates which positions are attended
+                        kmask = act_masks.get(node.inputs[-1]) \
+                            if len(node.inputs) > 1 else mask
                         y, st, m2 = layer.apply_multi(
                             params[node.name], xs, net_state[node.name],
-                            train=train, rng=rng_map[node.name], mask=mask)
+                            train=train, rng=rng_map[node.name], mask=kmask)
                     else:
                         x = xs[0]
                         if getattr(node, "_flatten_input", False):

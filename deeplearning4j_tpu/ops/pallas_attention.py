@@ -87,8 +87,13 @@ def _mm(a, b, dims):
     if a.dtype != b.dtype:
         narrow = a.dtype if a.dtype.itemsize <= b.dtype.itemsize else b.dtype
         a, b = a.astype(narrow), b.astype(narrow)
+    # precision pinned explicitly: an ambient default_matmul_precision
+    # context (the f32 dtype policy sets 'high') must not leak into the
+    # kernel — Mosaic only lowers DEFAULT/HIGHEST, and operand dtype plus
+    # the f32 accumulator already define this kernel's numerics
     return jax.lax.dot_general(a, b, (dims, ((), ())),
-                               preferred_element_type=jnp.float32)
+                               preferred_element_type=jnp.float32,
+                               precision=jax.lax.Precision.DEFAULT)
 
 
 def _mm_nt(a, b):  # a @ b.T

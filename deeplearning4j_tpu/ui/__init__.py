@@ -1,0 +1,3 @@
+"""Training UI server (deeplearning4j-ui role)."""
+
+from deeplearning4j_tpu.ui.server import UIServer

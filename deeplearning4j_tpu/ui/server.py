@@ -1,0 +1,202 @@
+"""Training UI server — the VertxUIServer + DL4J training dashboard role.
+
+Reference parity: deeplearning4j-ui's VertxUIServer serves the train
+dashboard (score chart, update:parameter ratio chart — "the signature
+debugging tool" per SURVEY §6.5) over attached StatsStorage instances
+(UIServer.getInstance().attach(statsStorage)).
+
+TPU-native realization: a stdlib http.server on a daemon thread (no web
+framework in the environment) serving
+
+  * ``/``                 — single-page dashboard, dependency-free inline
+                            SVG charts, auto-refreshing
+  * ``/train/sessions``   — attached session ids
+  * ``/train/overview``   — score-vs-iteration series
+  * ``/train/model``      — per-parameter update:param-ratio + norm series
+
+against the same StatsStorage records StatsListener emits, so the usage
+mirrors the reference exactly:
+
+    storage = StatsStorage()
+    UIServer.get_instance().attach(storage)
+    net.set_listeners(StatsListener(storage))
+    net.fit(...)   # browse http://localhost:9000
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.utils.stats import StatsStorage
+
+_INSTANCE: Optional["UIServer"] = None
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU Training UI</title>
+<style>
+ body { font-family: sans-serif; margin: 1.5em; background: #fafafa; }
+ h1 { font-size: 1.2em; } h2 { font-size: 1em; color: #444; }
+ .card { background: #fff; border: 1px solid #ddd; border-radius: 6px;
+         padding: 1em; margin-bottom: 1.2em; }
+ svg { width: 100%%; height: 260px; }
+ .legend { font-size: 0.8em; color: #666; }
+</style></head>
+<body>
+<h1>DL4J-TPU Training UI</h1>
+<div class="card"><h2>Model score vs. iteration</h2>
+ <svg id="score"></svg></div>
+<div class="card"><h2>Update : parameter ratio (log10) — healthy ≈ −3</h2>
+ <svg id="ratio"></svg><div id="ratio-legend" class="legend"></div></div>
+<script>
+const COLORS = ['#1976d2','#d32f2f','#388e3c','#f57c00','#7b1fa2',
+                '#00796b','#5d4037','#455a64','#c2185b','#afb42b'];
+function drawSeries(svgId, seriesMap, legendId) {
+  const svg = document.getElementById(svgId);
+  const W = svg.clientWidth || 800, H = svg.clientHeight || 260, P = 36;
+  let xs = [], ys = [];
+  for (const k in seriesMap) {
+    seriesMap[k].forEach(p => { xs.push(p[0]); ys.push(p[1]); });
+  }
+  if (!xs.length) { svg.innerHTML = '<text x="20" y="30">waiting for data…</text>'; return; }
+  const xmin = Math.min(...xs), xmax = Math.max(...xs) || 1;
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const sx = x => P + (x - xmin) / Math.max(xmax - xmin, 1e-9) * (W - 2*P);
+  const sy = y => H - P - (y - ymin) / Math.max(ymax - ymin, 1e-9) * (H - 2*P);
+  let out = `<line x1="${P}" y1="${H-P}" x2="${W-P}" y2="${H-P}" stroke="#999"/>` +
+            `<line x1="${P}" y1="${P}" x2="${P}" y2="${H-P}" stroke="#999"/>` +
+            `<text x="${P}" y="${H-6}" font-size="10">${xmin}</text>` +
+            `<text x="${W-P-30}" y="${H-6}" font-size="10">${xmax}</text>` +
+            `<text x="2" y="${H-P}" font-size="10">${ymin.toFixed(3)}</text>` +
+            `<text x="2" y="${P+4}" font-size="10">${ymax.toFixed(3)}</text>`;
+  let i = 0, legend = [];
+  for (const k in seriesMap) {
+    const c = COLORS[i++ % COLORS.length];
+    const pts = seriesMap[k].map(p => `${sx(p[0])},${sy(p[1])}`).join(' ');
+    out += `<polyline fill="none" stroke="${c}" stroke-width="1.5" points="${pts}"/>`;
+    legend.push(`<span style="color:${c}">■</span> ${k}`);
+  }
+  svg.innerHTML = out;
+  if (legendId) document.getElementById(legendId).innerHTML = legend.join(' &nbsp; ');
+}
+async function refresh() {
+  try {
+    const ov = await (await fetch('train/overview')).json();
+    drawSeries('score', {score: ov.score});
+    const m = await (await fetch('train/model')).json();
+    drawSeries('ratio', m.update_ratio_log10, 'ratio-legend');
+  } catch (e) {}
+  setTimeout(refresh, 2000);
+}
+refresh();
+</script></body></html>
+"""
+
+
+class UIServer:
+    """UIServer.java analog (singleton + attach)."""
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._storages: List[StatsStorage] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- reference API -------------------------------------------------------
+    @staticmethod
+    def get_instance(port: int = 9000) -> "UIServer":
+        global _INSTANCE
+        if _INSTANCE is None:
+            _INSTANCE = UIServer(port)
+            _INSTANCE.start()
+        return _INSTANCE
+
+    def attach(self, storage: StatsStorage) -> None:
+        self._storages.append(storage)
+
+    def detach(self, storage: StatsStorage) -> None:
+        if storage in self._storages:
+            self._storages.remove(storage)
+
+    # -- data assembly -------------------------------------------------------
+    def _records(self) -> List[Dict]:
+        recs: List[Dict] = []
+        for st in self._storages:
+            recs.extend(getattr(st, "records", []))
+        return sorted(recs, key=lambda r: r.get("iteration", 0))
+
+    def overview(self) -> Dict:
+        recs = self._records()
+        return {"score": [[r["iteration"], r["score"]] for r in recs]}
+
+    def model(self) -> Dict:
+        import math
+
+        recs = self._records()
+        ratios: Dict[str, List] = {}
+        norms: Dict[str, List] = {}
+        for r in recs:
+            for name, st in r.get("layers", {}).items():
+                if not name.endswith("_W"):
+                    continue  # the reference charts weight params
+                if "update_ratio" in st:
+                    ratios.setdefault(name, []).append(
+                        [r["iteration"],
+                         math.log10(max(st["update_ratio"], 1e-12))])
+                norms.setdefault(name, []).append(
+                    [r["iteration"], st.get("norm2", 0.0)])
+        return {"update_ratio_log10": ratios, "param_norm2": norms}
+
+    def sessions(self) -> Dict:
+        return {"sessions": list(range(len(self._storages))),
+                "records": len(self._records())}
+
+    # -- http ---------------------------------------------------------------
+    def start(self) -> "UIServer":
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                path = self.path.rstrip("/") or "/"
+                if path == "/" or path == "/train":
+                    body = _PAGE.encode()
+                    ctype = "text/html"
+                elif path.endswith("/train/sessions"):
+                    body = json.dumps(ui.sessions()).encode()
+                    ctype = "application/json"
+                elif path.endswith("/train/overview"):
+                    body = json.dumps(ui.overview()).encode()
+                    ctype = "application/json"
+                elif path.endswith("/train/model"):
+                    body = json.dumps(ui.model()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolves port=0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        global _INSTANCE
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if _INSTANCE is self:
+            _INSTANCE = None

@@ -310,3 +310,17 @@ class TestReviewRegressions:
         mask = np.asarray([[1] * 8, [1] * 5 + [0] * 3], np.float32)
         out = net.output(x, mask=mask)
         assert out.shape[1] == 4  # T=8 stride 2 → 4 steps, mask followed
+
+    def test_conv3d_network_serde_roundtrip(self):
+        net = _mln([
+            nn.Convolution3D(n_out=3, kernel=(2, 2, 2),
+                             convolution_mode="valid", activation="tanh"),
+            nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ], nn.InputType.convolutional3d(4, 5, 5, 2))
+        from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+        js = net.conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        net2 = nn.MultiLayerNetwork(conf2).init(net.params)
+        x = _rng(14).randn(2, 4, 5, 5, 2).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), net2.output(x), rtol=1e-6)

@@ -1,0 +1,81 @@
+"""UI server tests — the VertxUIServer role (SURVEY §6.5): attach a
+StatsStorage, train a LeNet, and assert the dashboard + JSON endpoints
+serve live score and update:param-ratio series over HTTP."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu import models, nn
+from deeplearning4j_tpu.ui import UIServer
+from deeplearning4j_tpu.utils.stats import StatsListener, StatsStorage
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read()
+
+
+class TestUIServer:
+    def test_dashboard_during_lenet_fit(self):
+        server = UIServer(port=0).start()  # ephemeral port
+        try:
+            storage = StatsStorage()
+            server.attach(storage)
+            net = models.LeNet(num_classes=10).init()
+            net.set_listeners(StatsListener(storage, frequency=1))
+            rng = np.random.RandomState(0)
+            x = rng.rand(64, 784).astype(np.float32)
+            y = np.eye(10)[rng.randint(0, 10, 64)].astype(np.float32)
+            net.fit(x, y, epochs=3, batch_size=32)
+
+            status, body = _get(server.port, "/")
+            assert status == 200 and b"Training UI" in body
+            assert b"update" in body.lower()  # the ratio chart is present
+
+            status, body = _get(server.port, "/train/overview")
+            ov = json.loads(body)
+            assert status == 200 and len(ov["score"]) >= 6
+            its = [p[0] for p in ov["score"]]
+            assert its == sorted(its)
+            assert all(np.isfinite(p[1]) for p in ov["score"])
+
+            status, body = _get(server.port, "/train/model")
+            m = json.loads(body)
+            assert status == 200
+            ratios = m["update_ratio_log10"]
+            assert ratios, "update:param ratio series missing"
+            # every weight series holds finite log10 ratios (≈ -8 … 0)
+            for name, series in ratios.items():
+                assert name.endswith("_W")
+                for _, v in series:
+                    assert -13 < v < 2
+
+            status, body = _get(server.port, "/train/sessions")
+            s = json.loads(body)
+            assert s["records"] >= 6
+
+            import urllib.error
+
+            try:
+                _get(server.port, "/nope")
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.stop()
+
+    def test_singleton_attach(self):
+        server = UIServer.get_instance(port=0)
+        try:
+            st = StatsStorage()
+            server.attach(st)
+            st.put({"iteration": 1, "epoch": 0, "score": 0.5, "layers": {}})
+            status, body = _get(server.port, "/train/overview")
+            assert json.loads(body)["score"] == [[1, 0.5]]
+            server.detach(st)
+            _, body = _get(server.port, "/train/overview")
+            assert json.loads(body)["score"] == []
+        finally:
+            server.stop()
