@@ -1,0 +1,20 @@
+# Convenience targets (the CI-role entry points — SURVEY §3.4).
+
+.PHONY: test gate gate-fast bench native
+
+test:
+	python -m pytest tests/ -q
+
+# full pre-snapshot gate: pytest + on-chip consistency + bench smoke +
+# multichip dryrun (tools/gate.py). Run before any round-end commit.
+gate:
+	python tools/gate.py
+
+gate-fast:
+	python tools/gate.py --fast
+
+bench:
+	python bench.py
+
+native:
+	cmake -S native -B native/build -G Ninja && cmake --build native/build

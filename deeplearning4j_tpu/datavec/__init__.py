@@ -12,3 +12,13 @@ from deeplearning4j_tpu.datavec.transform import (
     Reducer,
     records_to_dataset,
 )
+from deeplearning4j_tpu.datavec.analysis import (
+    Join,
+    convert_to_sequence,
+    convert_from_sequence,
+    sequence_to_dataset,
+    DataQualityAnalysis,
+    DataAnalysis,
+    analyze,
+    analyze_quality,
+)

@@ -1,0 +1,239 @@
+"""DataVec joins, sequence ops, and quality analysis.
+
+Reference parity:
+  * datavec-api transform/join/Join.java — Inner/LeftOuter/RightOuter/
+    FullOuter joins of two record sets on key columns.
+  * transform/sequence/** — ConvertToSequence (group by key, order by a
+    column), ConvertFromSequence, sequence comparators.
+  * analysis/AnalyzeLocal + DataQualityAnalysis / *QualityAnalysis —
+    per-column counts of missing/invalid entries and min/max/mean/stddev
+    for numeric columns.
+
+TPU-native note: these are host-side ETL (the reference runs them on
+Spark/local executors); numeric summaries vectorize through numpy. They
+feed the same records → DataSet bridge the rest of datavec uses.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import OrderedDict, defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.transform import Schema
+
+
+class Join:
+    """transform/join/Join.java analog (Builder: join type + key columns)."""
+
+    INNER = "Inner"
+    LEFT_OUTER = "LeftOuter"
+    RIGHT_OUTER = "RightOuter"
+    FULL_OUTER = "FullOuter"
+
+    def __init__(self, join_type: str, left_schema: Schema,
+                 right_schema: Schema, key_columns: Sequence[str]):
+        if join_type not in (self.INNER, self.LEFT_OUTER, self.RIGHT_OUTER,
+                             self.FULL_OUTER):
+            raise ValueError(f"unknown join type {join_type}")
+        self.join_type = join_type
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+        self.key_columns = list(key_columns)
+
+    def output_schema(self) -> Schema:
+        cols = list(self.left_schema.columns)
+        for c in self.right_schema.columns:
+            if c["name"] not in self.key_columns:
+                cols.append(c)
+        return Schema(cols)
+
+    def execute(self, left: List[List[Any]],
+                right: List[List[Any]]) -> List[List[Any]]:
+        lk = [self.left_schema.index_of(k) for k in self.key_columns]
+        rk = [self.right_schema.index_of(k) for k in self.key_columns]
+        r_other = [i for i in range(self.right_schema.num_columns())
+                   if i not in rk]
+        l_width = self.left_schema.num_columns()
+
+        rmap: Dict[Tuple, List[List[Any]]] = defaultdict(list)
+        for row in right:
+            rmap[tuple(row[i] for i in rk)].append(row)
+
+        out: List[List[Any]] = []
+        matched_right = set()
+        for row in left:
+            key = tuple(row[i] for i in lk)
+            matches = rmap.get(key, [])
+            if matches:
+                matched_right.add(key)
+                for r in matches:
+                    out.append(list(row) + [r[i] for i in r_other])
+            elif self.join_type in (self.LEFT_OUTER, self.FULL_OUTER):
+                out.append(list(row) + [None] * len(r_other))
+        if self.join_type in (self.RIGHT_OUTER, self.FULL_OUTER):
+            for key, rows in rmap.items():
+                if key in matched_right:
+                    continue
+                for r in rows:
+                    blank = [None] * l_width
+                    for li, ri in zip(lk, rk):
+                        blank[li] = r[ri]
+                    out.append(blank + [r[i] for i in r_other])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Sequences (transform/sequence/*)
+# ---------------------------------------------------------------------------
+
+
+def convert_to_sequence(records: List[List[Any]], schema: Schema,
+                        key_column: str,
+                        order_column: Optional[str] = None
+                        ) -> List[List[List[Any]]]:
+    """ConvertToSequence analog: group rows by key, order each group by the
+    order column (e.g. a timestamp) — records → list of sequences."""
+    ki = schema.index_of(key_column)
+    oi = None if order_column is None else schema.index_of(order_column)
+    groups: "OrderedDict[Any, List[List[Any]]]" = OrderedDict()
+    for row in records:
+        groups.setdefault(row[ki], []).append(row)
+    out = []
+    for rows in groups.values():
+        if oi is not None:
+            rows = sorted(rows, key=lambda r: r[oi])
+        out.append(rows)
+    return out
+
+
+def convert_from_sequence(sequences: List[List[List[Any]]]) -> List[List[Any]]:
+    """ConvertFromSequence analog: flatten sequences back to records."""
+    return [row for seq in sequences for row in seq]
+
+
+def sequence_to_dataset(sequences: List[List[List[Any]]], schema: Schema,
+                        feature_columns: Sequence[str], label_column: str,
+                        num_classes: int):
+    """SequenceRecordReaderDataSetIterator bridging role: equal-length
+    sequences → (features (N, T, F), one-hot labels per step (N, T, C))."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    fi = [schema.index_of(c) for c in feature_columns]
+    li = schema.index_of(label_column)
+    t = len(sequences[0])
+    if any(len(s) != t for s in sequences):
+        raise ValueError("sequence_to_dataset needs equal-length sequences — "
+                         "pad or window upstream")
+    feats = np.asarray([[[float(r[i]) for i in fi] for r in s]
+                        for s in sequences], np.float32)
+    labels = np.zeros((len(sequences), t, num_classes), np.float32)
+    for n, s in enumerate(sequences):
+        for ti, r in enumerate(s):
+            labels[n, ti, int(r[li])] = 1.0
+    return DataSet(feats, labels)
+
+
+# ---------------------------------------------------------------------------
+# Quality analysis (analysis/quality/* + AnalyzeLocal)
+# ---------------------------------------------------------------------------
+
+
+class ColumnQuality:
+    """(Numeric|Categorical|String)Quality analog."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count_total = 0
+        self.count_missing = 0
+        self.count_invalid = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"total": self.count_total, "missing": self.count_missing,
+                "invalid": self.count_invalid}
+
+
+class DataQualityAnalysis:
+    """DataQualityAnalysis analog: per-column quality counters."""
+
+    def __init__(self, columns: List[ColumnQuality]):
+        self.columns = {c.name: c for c in columns}
+
+    def quality_of(self, name: str) -> ColumnQuality:
+        return self.columns[name]
+
+    def __repr__(self):
+        rows = [f"  {n}: {c.as_dict()}" for n, c in self.columns.items()]
+        return "DataQualityAnalysis(\n" + "\n".join(rows) + "\n)"
+
+
+class DataAnalysis:
+    """DataAnalysis analog: numeric column summaries."""
+
+    def __init__(self, stats: Dict[str, Dict[str, float]]):
+        self.stats = stats
+
+    def min_of(self, name: str) -> float:
+        return self.stats[name]["min"]
+
+    def max_of(self, name: str) -> float:
+        return self.stats[name]["max"]
+
+    def mean_of(self, name: str) -> float:
+        return self.stats[name]["mean"]
+
+    def std_of(self, name: str) -> float:
+        return self.stats[name]["std"]
+
+
+def analyze_quality(records: List[List[Any]], schema: Schema
+                    ) -> DataQualityAnalysis:
+    """AnalyzeLocal.analyzeQuality analog."""
+    cols = [ColumnQuality(n) for n in schema.names]
+    for row in records:
+        for i, col in enumerate(cols):
+            col.count_total += 1
+            v = row[i] if i < len(row) else None
+            if v is None or (isinstance(v, str) and v == ""):
+                col.count_missing += 1
+                continue
+            t = schema.columns[i]["type"]
+            if t in ("integer", "long"):
+                ok = isinstance(v, (int, np.integer)) or \
+                    (isinstance(v, str) and v.lstrip("-").isdigit())
+            elif t in ("double", "float"):
+                try:
+                    ok = math.isfinite(float(v))
+                except (TypeError, ValueError):
+                    ok = False
+            else:
+                ok = True
+            if not ok:
+                col.count_invalid += 1
+    return DataQualityAnalysis(cols)
+
+
+def analyze(records: List[List[Any]], schema: Schema) -> DataAnalysis:
+    """AnalyzeLocal.analyze analog (numeric min/max/mean/std)."""
+    stats: Dict[str, Dict[str, float]] = {}
+    for i, c in enumerate(schema.columns):
+        if c["type"] not in ("integer", "long", "double", "float"):
+            continue
+        vals = []
+        for row in records:
+            try:
+                v = float(row[i])
+            except (TypeError, ValueError, IndexError):
+                continue
+            if math.isfinite(v):
+                vals.append(v)
+        a = np.asarray(vals, np.float64)
+        stats[c["name"]] = {
+            "min": float(a.min()) if a.size else float("nan"),
+            "max": float(a.max()) if a.size else float("nan"),
+            "mean": float(a.mean()) if a.size else float("nan"),
+            "std": float(a.std()) if a.size else float("nan"),
+        }
+    return DataAnalysis(stats)

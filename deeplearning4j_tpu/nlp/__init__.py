@@ -5,3 +5,9 @@ from deeplearning4j_tpu.nlp.wordpiece import (
     BertIterator,
     build_vocab,
 )
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.glove import GloVe
+from deeplearning4j_tpu.nlp.paragraph_vectors import (
+    LabelledDocument,
+    ParagraphVectors,
+)

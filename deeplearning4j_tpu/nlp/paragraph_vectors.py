@@ -1,0 +1,190 @@
+"""ParagraphVectors (doc2vec) on the Word2Vec SGNS substrate.
+
+Reference parity: deeplearning4j-nlp models/paragraphvectors/
+ParagraphVectors.java — Builder mirrors Word2Vec's plus labels; PV-DBOW
+(dbow=true, the reference default sequence-learning algorithm): a document
+vector is trained to predict the words of its document with negative
+sampling; ``inferVector`` gradient-fits a fresh vector for an unseen
+document against the FROZEN word output matrix.
+
+TPU-native realization: same collapse as Word2Vec — host-side mining of
+(doc, word, negatives) triples into large batches, one jitted batched
+SGNS step on-device (the reference's per-document threads disappear)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class LabelledDocument:
+    """nlp LabelledDocument analog: tokens + a label."""
+
+    def __init__(self, tokens: Sequence[str], label: str):
+        self.tokens = list(tokens)
+        self.label = label
+
+
+class ParagraphVectors:
+    """ParagraphVectors.java analog (PV-DBOW)."""
+
+    def __init__(self, layer_size: int = 100, min_word_frequency: int = 1,
+                 negative_samples: int = 5, learning_rate: float = 0.025,
+                 epochs: int = 5, batch_size: int = 2048, seed: int = 42,
+                 window_size: int = 5):
+        self.layer_size = layer_size
+        self.min_count = min_word_frequency
+        self.negative = negative_samples
+        self.lr = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.window = window_size
+        self.labels: Dict[str, int] = {}
+        self.inv_labels: List[str] = []
+        self._w2v = Word2Vec(layer_size=layer_size,
+                             min_word_frequency=min_word_frequency,
+                             negative_samples=negative_samples, seed=seed,
+                             window_size=window_size)
+        self.doc_vectors: Optional[np.ndarray] = None
+        self.syn1: Optional[jnp.ndarray] = None  # word OUTPUT matrix
+        self._neg_table: Optional[np.ndarray] = None
+
+    # ----------------------------------------------------------------- fit
+    def _mine(self, docs: List[LabelledDocument],
+              rng: np.random.RandomState) -> Tuple[np.ndarray, np.ndarray]:
+        vocab = self._w2v.vocab
+        d_idx, w_idx = [], []
+        for doc in docs:
+            di = self.labels[doc.label]
+            for w in doc.tokens:
+                i = vocab.get(w.lower())
+                if i is not None:
+                    d_idx.append(di)
+                    w_idx.append(i)
+        return np.asarray(d_idx, np.int32), np.asarray(w_idx, np.int32)
+
+    def _make_step(self):
+        @jax.jit
+        def step(docv, syn1, docs, words, negs, lr):
+            v = docv[docs]
+            u_pos = syn1[words]
+            u_neg = syn1[negs]
+            pos = jnp.sum(v * u_pos, axis=-1)
+            neg = jnp.einsum("bd,bkd->bk", v, u_neg)
+            g_pos = jax.nn.sigmoid(pos) - 1.0
+            g_neg = jax.nn.sigmoid(neg)
+            grad_v = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+            grad_upos = g_pos[:, None] * v
+            grad_uneg = g_neg[..., None] * v[:, None, :]
+            loss = -(jnp.mean(jax.nn.log_sigmoid(pos))
+                     + jnp.mean(jnp.sum(jax.nn.log_sigmoid(-neg), axis=-1)))
+            nd = docv.shape[0]
+            acc = jnp.zeros_like(docv).at[docs].add(grad_v)
+            cnt = jnp.zeros((nd,), v.dtype).at[docs].add(1.0)
+            docv = docv - lr * acc / jnp.maximum(cnt, 1.0)[:, None]
+            V = syn1.shape[0]
+            nf = negs.reshape(-1)
+            acc1 = (jnp.zeros_like(syn1).at[words].add(grad_upos)
+                    .at[nf].add(grad_uneg.reshape(-1, grad_uneg.shape[-1])))
+            cnt1 = (jnp.zeros((V,), v.dtype).at[words].add(1.0).at[nf].add(1.0))
+            syn1 = syn1 - lr * acc1 / jnp.maximum(cnt1, 1.0)[:, None]
+            return docv, syn1, loss
+
+        return step
+
+    def fit(self, docs: Iterable[LabelledDocument]) -> List[float]:
+        docs = list(docs)
+        self.labels = {}
+        self.inv_labels = []
+        for d in docs:
+            if d.label not in self.labels:
+                self.labels[d.label] = len(self.labels)
+                self.inv_labels.append(d.label)
+        self._w2v.build_vocab([d.tokens for d in docs])
+        rng = np.random.RandomState(self.seed)
+        V, D, ND = self._w2v.vocab_size(), self.layer_size, len(self.labels)
+        counts = self._w2v.counts
+        table = (counts ** 0.75)
+        self._neg_table = (table / table.sum()).astype(np.float64)
+        docv = jnp.asarray(((rng.rand(ND, D) - 0.5) / D).astype(np.float32))
+        syn1 = jnp.zeros((V, D), jnp.float32)
+        step = self._make_step()
+        d_idx, w_idx = self._mine(docs, rng)
+        n = len(d_idx)
+        bs = min(self.batch_size, max(n, 1))
+        losses: List[float] = []
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            ep = []
+            for s0 in range(0, n - bs + 1, bs):
+                sel = order[s0:s0 + bs]
+                negs = rng.choice(V, size=(len(sel), self.negative),
+                                  p=self._neg_table).astype(np.int32)
+                docv, syn1, loss = step(docv, syn1,
+                                        jnp.asarray(d_idx[sel]),
+                                        jnp.asarray(w_idx[sel]),
+                                        jnp.asarray(negs),
+                                        jnp.float32(self.lr))
+                ep.append(float(loss))
+            losses.append(float(np.mean(ep)) if ep else float("nan"))
+        self.doc_vectors = np.asarray(docv)
+        self.syn1 = syn1
+        return losses
+
+    # ----------------------------------------------------------- inference
+    def infer_vector(self, tokens: Sequence[str], steps: int = 25,
+                     lr: float = 0.05, seed: int = 0) -> np.ndarray:
+        """inferVector analog: gradient-fit a fresh doc vector against the
+        frozen word output matrix."""
+        rng = np.random.RandomState(seed)
+        ids = np.asarray([self._w2v.vocab[w.lower()] for w in tokens
+                          if w.lower() in self._w2v.vocab], np.int32)
+        D = self.layer_size
+        if ids.size == 0:
+            return np.zeros((D,), np.float32)
+        v = jnp.asarray(((rng.rand(D) - 0.5) / D).astype(np.float32))
+        syn1 = self.syn1
+        V = syn1.shape[0]
+
+        @jax.jit
+        def one(v, words, negs, lr):
+            u_pos = syn1[words]
+            u_neg = syn1[negs]
+            pos = u_pos @ v
+            neg = u_neg.reshape(-1, D) @ v
+            g_pos = jax.nn.sigmoid(pos) - 1.0
+            g_neg = jax.nn.sigmoid(neg)
+            grad = (g_pos[:, None] * u_pos).sum(0) + \
+                   (g_neg[:, None] * u_neg.reshape(-1, D)).sum(0)
+            return v - lr * grad / words.shape[0]
+
+        for _ in range(steps):
+            negs = rng.choice(V, size=(len(ids), self.negative),
+                              p=self._neg_table).astype(np.int32)
+            v = one(v, jnp.asarray(ids), jnp.asarray(negs), jnp.float32(lr))
+        return np.asarray(v)
+
+    # ------------------------------------------------------------- lookups
+    def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self.labels.get(label)
+        return None if i is None else self.doc_vectors[i]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_doc_vector(a), self.get_doc_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+    def nearest_labels(self, tokens: Sequence[str], n: int = 5) -> List[str]:
+        """docsNearest-style lookup for an unseen document."""
+        v = self.infer_vector(tokens)
+        W = self.doc_vectors / (np.linalg.norm(self.doc_vectors, axis=1,
+                                               keepdims=True) + 1e-12)
+        sims = W @ (v / (np.linalg.norm(v) + 1e-12))
+        return [self.inv_labels[i] for i in np.argsort(-sims)[:n]]

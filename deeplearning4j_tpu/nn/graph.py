@@ -770,9 +770,21 @@ class ComputationGraph:
         self.params, self.opt_state, self.net_state, losses = many(
             self.params, self.opt_state, self.net_state,
             jnp.asarray(self.iteration_count, jnp.int32), sub, feeds, labs)
+        start = self.iteration_count
         self.iteration_count += n_steps
         self._score = losses[-1]
-        return np.asarray(losses)
+        losses = np.asarray(losses)
+        # fire listeners after the fused chunk (per-step losses; params only
+        # current as of chunk end) — the fast path no longer skips them.
+        # Iteration-major order so multi-listener interleaving matches fit()
+        first_feed = next(iter(feeds.values()))
+        self.last_batch_size = int(first_feed.shape[1]) if per_step_data \
+            else int(first_feed.shape[0])
+        for k in range(n_steps):
+            for lst in self.listeners:
+                lst.iteration_done(self, start + k + 1, self.epoch_count,
+                                   float(losses[k]))
+        return losses
 
     def score(self) -> float:
         return float(getattr(self, "_score", float("nan")))

@@ -507,9 +507,22 @@ class MultiLayerNetwork:
         self.params, self.opt_state, self.net_state, losses = many(
             self.params, self.opt_state, self.net_state,
             jnp.asarray(self.iteration_count, jnp.int32), sub, xs, ys)
+        start = self.iteration_count
         self.iteration_count += n_steps
         self._score = losses[-1]
-        return np.asarray(losses)
+        losses = np.asarray(losses)
+        # listeners fire AFTER the fused chunk, once per inner step with the
+        # recorded loss — coarser timing than fit() (params are only current
+        # as of the chunk end) but checkpoint/score listeners keep working on
+        # the fast path instead of silently not firing (round-2 weak #8).
+        # Iteration-major order so multi-listener interleaving matches fit()
+        self.last_batch_size = int(xs.shape[1]) if per_step_data \
+            else int(xs.shape[0])
+        for k in range(n_steps):
+            for lst in self.listeners:
+                lst.iteration_done(self, start + k + 1, self.epoch_count,
+                                   float(losses[k]))
+        return losses
 
     def score(self, ds: Optional[DataSet] = None) -> float:
         """Loss on a dataset, or last training score (MultiLayerNetwork.score)."""

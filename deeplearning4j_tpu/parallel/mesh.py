@@ -53,13 +53,27 @@ def make_mesh(axes: Dict[str, int] = None, devices=None) -> Mesh:
 # Sharding rules (the TP story: regex on param path -> PartitionSpec)
 # ---------------------------------------------------------------------------
 
-# Default tensor-parallel rules for our layer param names: shard the output
-# feature axis of big weight matrices over 'model'; biases replicated.
+# Default tensor-parallel rules for our layer param names (Megatron-style
+# column/row split pairing so GSPMD inserts ONE all-reduce per block):
+#   * attention Wq/Wk/Wv: column-parallel (heads split over 'model');
+#     Wo row-parallel (input axis split → psum on the block output)
+#   * MLP/dense W: column-parallel on the output-feature axis; W2-style
+#     second projections named W2/Wo row-parallel
+#   * conv kernels (kh, kw, cin, cout): output-channel split
+#   * biases that follow a column-parallel weight: split to match
+#   * everything else (norm scales, running stats) replicated
 DEFAULT_TP_RULES: List[Tuple[str, P]] = [
-    (r".*/W$", P(None, "model")),       # dense/conv-ish weights: out axis
+    (r".*/(Wq|Wk|Wv|W1)$", P(None, "model")),   # column-parallel
+    (r".*/(Wo|W2)$", P("model", None)),          # row-parallel
+    (r".*/(bq|bk|bv|b1)$", P("model")),
+    (r".*/W$", P(None, None, None, "model")),    # conv HWIO: out channels
     (r".*/RW$", P(None, "model")),
-    (r".*", P()),                        # everything else replicated
+    (r".*", P()),                                 # everything else replicated
 ]
+
+
+def _is_conv_kernel(leaf) -> bool:
+    return np.ndim(leaf) == 4
 
 
 def _spec_for(path: str, rules: Sequence[Tuple[str, P]]) -> P:
@@ -94,7 +108,14 @@ def shard_params(params, mesh: Mesh, rules: Optional[Sequence[Tuple[str, P]]] = 
     specs = {}
     for path, leaf in flat:
         spec = _spec_for(path, rules)
-        # validate divisibility; fall back to replicated
+        if spec == P(None, None, None, "model") and not _is_conv_kernel(leaf):
+            # the conv rule matched a non-4D /W leaf: shard the
+            # output-feature (LAST) axis whatever the rank — dense (2D),
+            # Conv1D/locally-connected (3D), Conv3D (5D)
+            nd = np.ndim(leaf)
+            spec = P(*([None] * (nd - 1) + ["model"])) if nd >= 1 else P()
+        # validate divisibility; fall back to replication — LOUDLY, so a
+        # mis-sized layer doesn't silently train without TP
         ok = True
         for dim, axis in enumerate(spec):
             if axis is None:
@@ -103,6 +124,11 @@ def shard_params(params, mesh: Mesh, rules: Optional[Sequence[Tuple[str, P]]] = 
                 [mesh.shape[a] for a in axis])
             if dim >= np.ndim(leaf) or np.shape(leaf)[dim] % size != 0:
                 ok = False
+        if not ok and spec != P():
+            logger.warning(
+                "TP: param %s shape %s not divisible by spec %s on mesh %s — "
+                "replicating this leaf", path, np.shape(leaf), spec,
+                dict(mesh.shape))
         specs[path] = spec if ok else P()
 
     def put(path_leaf):

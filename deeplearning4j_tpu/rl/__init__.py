@@ -10,3 +10,8 @@ from deeplearning4j_tpu.rl.dqn import (
     QLearningDiscrete,
     ActorCritic,
 )
+from deeplearning4j_tpu.rl.async_rl import (
+    A3CDiscrete,
+    GymMDP,
+    HistoryProcessor,
+)

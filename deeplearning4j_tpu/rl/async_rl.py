@@ -1,0 +1,216 @@
+"""A3C-equivalent parallel-environment training + MDP adapters.
+
+Reference parity:
+  * rl4j-core async/** — AsyncLearning, A3CDiscrete(Dense/Conv),
+    AsyncGlobal + per-thread AsyncThread workers doing hogwild updates.
+  * rl4j-gym org.deeplearning4j.gym.GymEnv — the gym-API MDP adapter.
+  * HistoryProcessor.java — frame skip/stack preprocessing for pixel MDPs.
+
+TPU-native realization (documented divergence, same as the sync
+ActorCritic in rl/dqn.py): the reference's N async hogwild CPU threads
+become N SYNCHRONOUS parallel environments whose observations are stacked
+into ONE batch — policy/value forwards and the gradient step run as a
+single jitted computation over the (n_envs·n_steps) batch, which is how
+the same worker-parallelism maps onto a single accelerator (big batches
+on the MXU instead of lock-free tiny updates)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, apply_layer_updates
+from deeplearning4j_tpu.rl.dqn import MDP
+
+
+class GymMDP(MDP):
+    """GymEnv analog: wraps any gym-style env (reset() → obs | (obs, info);
+    step(a) → (obs, reward, done[, truncated], info]) into the rl4j MDP
+    interface."""
+
+    def __init__(self, env: Any, obs_size: Optional[int] = None,
+                 num_actions: Optional[int] = None):
+        self.env = env
+        self._obs_size = obs_size
+        self._num_actions = num_actions
+
+    def reset(self) -> np.ndarray:
+        out = self.env.reset()
+        obs = out[0] if isinstance(out, tuple) else out
+        return np.asarray(obs, np.float32).ravel()
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool]:
+        out = self.env.step(int(action))
+        if len(out) == 5:  # gymnasium: obs, reward, terminated, truncated, info
+            obs, reward, term, trunc, _ = out
+            done = bool(term or trunc)
+        else:  # classic gym: obs, reward, done, info
+            obs, reward, done = out[0], out[1], bool(out[2])
+        return np.asarray(obs, np.float32).ravel(), float(reward), done
+
+    @property
+    def num_actions(self) -> int:
+        if self._num_actions is not None:
+            return self._num_actions
+        return int(self.env.action_space.n)
+
+    @property
+    def obs_size(self) -> int:
+        if self._obs_size is not None:
+            return self._obs_size
+        space = self.env.observation_space
+        return int(np.prod(space.shape))
+
+
+class HistoryProcessor:
+    """HistoryProcessor.java analog: skip frames and stack the last
+    ``history_length`` kept frames into one observation (the DQN-on-pixels
+    preprocessing). ``record`` every raw frame; ``get_history`` returns the
+    (history_length, *frame_shape) stack (zero-padded until warm)."""
+
+    def __init__(self, history_length: int = 4, skip_frames: int = 4):
+        self.history_length = history_length
+        self.skip_frames = max(1, skip_frames)
+        self._frames: List[np.ndarray] = []
+        self._count = 0
+
+    def reset(self) -> None:
+        self._frames = []
+        self._count = 0
+
+    def record(self, frame: np.ndarray) -> bool:
+        """Returns True when the frame was KEPT (every skip_frames-th)."""
+        keep = self._count % self.skip_frames == 0
+        self._count += 1
+        if keep:
+            self._frames.append(np.asarray(frame, np.float32))
+            if len(self._frames) > self.history_length:
+                self._frames.pop(0)
+        return keep
+
+    def get_history(self) -> np.ndarray:
+        if not self._frames:
+            raise ValueError("record() at least one frame first")
+        shape = self._frames[0].shape
+        pad = self.history_length - len(self._frames)
+        frames = [np.zeros(shape, np.float32)] * pad + self._frames
+        return np.stack(frames)
+
+
+class A3CDiscrete:
+    """A3CDiscrete analog: n_envs parallel MDPs, batched advantage
+    actor-critic updates (one jitted step per rollout)."""
+
+    def __init__(self, mdp_factory: Callable[[], MDP],
+                 policy_net: MultiLayerNetwork,
+                 value_net: MultiLayerNetwork, n_envs: int = 8,
+                 n_steps: int = 8, gamma: float = 0.99,
+                 entropy_coef: float = 0.01, seed: int = 0):
+        self.envs = [mdp_factory() for _ in range(n_envs)]
+        self.policy_net = policy_net
+        self.value_net = value_net
+        self.n_envs = n_envs
+        self.n_steps = n_steps
+        self.gamma = gamma
+        self.entropy_coef = entropy_coef
+        self.rng = np.random.RandomState(seed)
+        self._obs = [e.reset() for e in self.envs]
+        self._ep_rewards = np.zeros(n_envs)
+        self.episode_rewards: List[float] = []
+        self._policy_fwd = jax.jit(
+            lambda p, s: policy_net._forward(p, policy_net.net_state, s, None,
+                                             train=False, rng=None)[0])
+        self._value_fwd = jax.jit(
+            lambda p, s: value_net._forward(p, value_net.net_state, s, None,
+                                            train=False, rng=None)[0][:, 0])
+        self._step = self._make_step()
+
+    def _make_step(self):
+        pnet, vnet = self.policy_net, self.value_net
+        ent_c = self.entropy_coef
+
+        def step_fn(p_params, v_params, p_opt, v_opt, step, s, a, ret):
+            def v_loss(vp):
+                v = vnet._forward(vp, vnet.net_state, s, None, train=False,
+                                  rng=None)[0][:, 0]
+                return jnp.mean((ret - v) ** 2)
+
+            v_l, v_grads = jax.value_and_grad(v_loss)(v_params)
+            v_now = vnet._forward(v_params, vnet.net_state, s, None,
+                                  train=False, rng=None)[0][:, 0]
+            adv = jax.lax.stop_gradient(ret - v_now)
+
+            def p_loss(pp):
+                probs = pnet._forward(pp, pnet.net_state, s, None,
+                                      train=False, rng=None)[0]
+                logp = jnp.log(probs + 1e-8)
+                chosen = jnp.take_along_axis(logp, a[:, None], axis=1)[:, 0]
+                entropy = -jnp.sum(probs * logp, axis=1)
+                return -jnp.mean(chosen * adv + ent_c * entropy)
+
+            p_l, p_grads = jax.value_and_grad(p_loss)(p_params)
+            pu = apply_layer_updates(pnet.conf,
+                                     zip(p_params, p_grads, p_opt,
+                                         pnet.updaters, pnet.conf.layers),
+                                     step, pnet._normalize_gradient)
+            vu = apply_layer_updates(vnet.conf,
+                                     zip(v_params, v_grads, v_opt,
+                                         vnet.updaters, vnet.conf.layers),
+                                     step, vnet._normalize_gradient)
+            return ([p for p, _ in pu], [st for _, st in pu],
+                    [p for p, _ in vu], [st for _, st in vu], p_l + v_l)
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
+
+    def _rollout(self):
+        """Step all envs n_steps with ONE batched policy forward per step."""
+        obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+        for _ in range(self.n_steps):
+            batch = jnp.asarray(np.stack(self._obs))
+            probs = np.asarray(self._policy_fwd(self.policy_net.params, batch))
+            acts = [int(self.rng.choice(len(p), p=p / p.sum())) for p in probs]
+            obs_buf.append(np.stack(self._obs))
+            act_buf.append(acts)
+            rews, dones = [], []
+            for k, env in enumerate(self.envs):
+                nxt, r, d = env.step(acts[k])
+                self._ep_rewards[k] += r
+                if d:
+                    self.episode_rewards.append(self._ep_rewards[k])
+                    self._ep_rewards[k] = 0.0
+                    nxt = env.reset()
+                self._obs[k] = nxt
+                rews.append(r)
+                dones.append(d)
+            rew_buf.append(rews)
+            done_buf.append(dones)
+        return (np.asarray(obs_buf, np.float32), np.asarray(act_buf, np.int32),
+                np.asarray(rew_buf, np.float32), np.asarray(done_buf))
+
+    def train_batch(self, step: int) -> float:
+        """One rollout + one batched update; returns the combined loss."""
+        obs, acts, rews, dones = self._rollout()
+        boot = np.asarray(self._value_fwd(
+            self.value_net.params, jnp.asarray(np.stack(self._obs))))
+        rets = np.zeros_like(rews)
+        running = boot
+        for t in reversed(range(self.n_steps)):
+            running = rews[t] + self.gamma * running * (~dones[t])
+            rets[t] = running
+        flat = lambda x: x.reshape((-1,) + x.shape[2:])
+        (self.policy_net.params, p_opt, self.value_net.params, v_opt,
+         loss) = self._step(self.policy_net.params, self.value_net.params,
+                            self.policy_net.opt_state,
+                            self.value_net.opt_state,
+                            jnp.asarray(step, jnp.int32),
+                            jnp.asarray(flat(obs)), jnp.asarray(flat(acts)),
+                            jnp.asarray(flat(rets)))
+        self.policy_net.opt_state = p_opt
+        self.value_net.opt_state = v_opt
+        return float(loss)
+
+    def train(self, batches: int = 100) -> List[float]:
+        return [self.train_batch(i) for i in range(batches)]

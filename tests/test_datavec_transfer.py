@@ -222,3 +222,69 @@ class TestTransferLearning:
         # head trained; full net output reflects it
         acc = (base.output(x).argmax(-1) == y_id).mean()
         assert np.isfinite(acc)
+
+
+class TestDataVecJoinsSequencesQuality:
+    """Round-3 datavec fill: Join types, sequence conversion, quality
+    analysis (datavec-api transform/join, transform/sequence, analysis)."""
+
+    def _schemas(self):
+        from deeplearning4j_tpu.datavec import Schema
+
+        left = (Schema.Builder().add_column_integer("id")
+                .add_column_string("name").build())
+        right = (Schema.Builder().add_column_integer("id")
+                 .add_column_double("score").build())
+        return left, right
+
+    def test_inner_and_left_outer_join(self):
+        from deeplearning4j_tpu.datavec import Join
+
+        left_s, right_s = self._schemas()
+        left = [[1, "a"], [2, "b"], [3, "c"]]
+        right = [[1, 0.5], [1, 0.7], [3, 0.9], [4, 1.1]]
+        inner = Join(Join.INNER, left_s, right_s, ["id"])
+        rows = inner.execute(left, right)
+        assert sorted(rows) == [[1, "a", 0.5], [1, "a", 0.7], [3, "c", 0.9]]
+        assert inner.output_schema().names == ["id", "name", "score"]
+
+        lo = Join(Join.LEFT_OUTER, left_s, right_s, ["id"]).execute(left, right)
+        assert [2, "b", None] in lo
+
+        fo = Join(Join.FULL_OUTER, left_s, right_s, ["id"]).execute(left, right)
+        assert [4, None, 1.1] in fo and [2, "b", None] in fo
+
+    def test_sequence_conversion_and_dataset(self):
+        from deeplearning4j_tpu.datavec import (
+            Schema, convert_from_sequence, convert_to_sequence,
+            sequence_to_dataset)
+
+        schema = (Schema.Builder().add_column_integer("key")
+                  .add_column_integer("t").add_column_double("x")
+                  .add_column_integer("label").build())
+        records = [[1, 2, 0.3, 1], [0, 0, 0.1, 0], [1, 1, 0.2, 0],
+                   [0, 1, 0.4, 1]]
+        seqs = convert_to_sequence(records, schema, "key", order_column="t")
+        assert len(seqs) == 2
+        assert [r[1] for r in seqs[0]] == sorted(r[1] for r in seqs[0])
+        flat = convert_from_sequence(seqs)
+        assert sorted(map(tuple, flat)) == sorted(map(tuple, records))
+
+        ds = sequence_to_dataset(seqs, schema, ["x"], "label", num_classes=2)
+        assert ds.features.shape == (2, 2, 1)
+        assert ds.labels.shape == (2, 2, 2)
+
+    def test_quality_and_analysis(self):
+        from deeplearning4j_tpu.datavec import (
+            Schema, analyze, analyze_quality)
+
+        schema = (Schema.Builder().add_column_integer("a")
+                  .add_column_double("b").build())
+        records = [[1, 2.0], [None, 3.0], ["oops", float("nan")], [4, 5.0]]
+        q = analyze_quality(records, schema)
+        assert q.quality_of("a").count_missing == 1
+        assert q.quality_of("a").count_invalid == 1
+        assert q.quality_of("b").count_invalid == 1
+        an = analyze(records, schema)
+        assert an.min_of("b") == 2.0 and an.max_of("b") == 5.0
+        np.testing.assert_allclose(an.mean_of("a"), (1 + 4) / 2)

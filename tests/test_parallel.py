@@ -162,3 +162,51 @@ class TestHostSharding:
         it = ShardedDataSetIterator(base, process_id=1, num_processes=2)
         batches = list(it)
         assert len(batches) == 2
+
+
+class TestTransformerTP:
+    """Round-3 weak-#6 fix: REAL-transformer tensor parallelism — BERT
+    attention + MLP blocks sharded Megatron-style (column Wq/Wk/Wv/W1, row
+    Wo/W2) over the virtual mesh, numerics matching the replicated run and
+    the TP all-reduce present in the compiled HLO."""
+
+    def test_bert_tp_matches_replicated(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from deeplearning4j_tpu.models.bert import (
+            BertConfig, bert_encoder, init_bert_params)
+        from deeplearning4j_tpu.parallel.mesh import (
+            DEFAULT_TP_RULES, shard_params)
+
+        cfg = BertConfig(vocab_size=211, hidden=64, layers=2, heads=4,
+                         intermediate=128, max_position=32, dropout=0.0)
+        params = init_bert_params(jax.random.key(0), cfg)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 211, (4, 16)).astype(np.int32))
+        seg = jnp.zeros_like(ids)
+        mask = jnp.ones_like(ids)
+
+        def fwd(p):
+            seq, pooled = bert_encoder(p, ids, seg, mask, cfg, train=False)
+            return seq
+
+        want = np.asarray(jax.jit(fwd)(params))
+
+        devs = jax.devices()[:4]
+        mesh = Mesh(np.array(devs).reshape(1, 4), ("data", "model"))
+        sharded = shard_params(params, mesh, DEFAULT_TP_RULES)
+        # the attention projections must actually BE sharded (not silently
+        # replicated) for this to test anything
+        wq = sharded["encoder"][0]["attn"]["Wq"]
+        assert not wq.sharding.is_fully_replicated
+        wo = sharded["encoder"][0]["attn"]["Wo"]
+        assert not wo.sharding.is_fully_replicated
+
+        jit_fwd = jax.jit(fwd)
+        got = np.asarray(jit_fwd(sharded))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+        # row-parallel Wo/W2 force a psum: all-reduce must appear in the HLO
+        hlo = jit_fwd.lower(sharded).compile().as_text()
+        assert "all-reduce" in hlo or "all_reduce" in hlo

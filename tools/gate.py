@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Pre-snapshot gate — the CI role (SURVEY §3.4).
+
+Round-2 shipped a red snapshot because nothing stood between `git commit`
+and a failing gradcheck; this gate is that something. Run before ANY
+snapshot/round-end commit:
+
+    python tools/gate.py            # full: pytest + consistency + bench smoke
+    python tools/gate.py --fast     # pytest only (pre-commit speed)
+
+Stages:
+  1. full pytest suite on the 8-device CPU harness (the unit/gradcheck bar)
+  2. CPU-vs-TPU consistency suite on the real chip (skipped with a WARNING
+     if no TPU is reachable — never silently)
+  3. bench smoke: LeNet BENCH_ITERS=3 must print one JSON line with a
+     finite value (catches "the benchmark itself is broken" regressions)
+  4. multichip dryrun (virtual 8-device CPU mesh via __graft_entry__)
+
+Exit code 0 = snapshot allowed; anything else = fix first.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(name: str, cmd, env=None, timeout=3600) -> bool:
+    print(f"== gate: {name} ==", flush=True)
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=e, timeout=timeout,
+                              capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"   FAIL ({name}: timeout after {timeout}s)")
+        return False
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-25:])
+        print(f"   FAIL ({name}, exit {proc.returncode})\n{tail}")
+        return False
+    print(f"   ok ({name})")
+    return True
+
+
+def has_tpu() -> bool:
+    probe = ("import jax\n"
+             "print(any(d.platform == 'tpu' for d in jax.devices()))")
+    try:
+        out = subprocess.run([sys.executable, "-c", probe], cwd=REPO,
+                             capture_output=True, text=True, timeout=180)
+        return "True" in out.stdout
+    except Exception:
+        return False
+
+
+def bench_smoke() -> bool:
+    print("== gate: bench smoke (lenet, 3 iters) ==", flush=True)
+    env = dict(os.environ, BENCH_MODEL="lenet", BENCH_ITERS="3",
+               BENCH_BATCH="64")
+    try:
+        proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                              capture_output=True, text=True, timeout=1800)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (bench smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and "metric" in l), None)
+    if proc.returncode != 0 or line is None:
+        print(f"   FAIL (bench exit {proc.returncode}; no JSON line)")
+        print("\n".join((proc.stdout + proc.stderr).splitlines()[-10:]))
+        return False
+    rec = json.loads(line)
+    ok = rec.get("value", 0) > 0
+    print(f"   {'ok' if ok else 'FAIL'} ({rec['metric']} = {rec['value']})")
+    return ok
+
+
+def main() -> int:
+    fast = "--fast" in sys.argv
+    results = {}
+
+    results["pytest"] = run(
+        "pytest (CPU harness)",
+        [sys.executable, "-m", "pytest", "tests/", "-q", "-x"],
+        timeout=2400)
+
+    if not fast:
+        if has_tpu():
+            results["consistency"] = run(
+                "CPU-vs-TPU consistency (real chip)",
+                [sys.executable, "-m", "deeplearning4j_tpu.testing.consistency"],
+                timeout=1800)
+            results["bench"] = bench_smoke()
+        else:
+            print("== gate: WARNING — no TPU reachable; consistency + bench "
+                  "smoke SKIPPED (do not snapshot a chip-affecting change "
+                  "from this state) ==")
+        results["multichip"] = run(
+            "multichip dryrun (8 virtual CPU devices)",
+            [sys.executable, "-c",
+             "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+            timeout=1200)
+
+    failed = [k for k, v in results.items() if not v]
+    if failed:
+        print(f"\nGATE RED: {failed} — fix before snapshotting")
+        return 1
+    print("\nGATE GREEN: snapshot allowed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
