@@ -6,3 +6,8 @@ from deeplearning4j_tpu.autodiff.gradcheck import (
     check_gradients_fn,
     check_samediff_gradients,
 )
+from deeplearning4j_tpu.autodiff.listeners import (
+    History,
+    HistoryListener,
+    UIListener,
+)

@@ -21,3 +21,10 @@ from deeplearning4j_tpu.datasets.image import (
     ColorJitterTransform,
     PipelineImageTransform,
 )
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.datasets.cifar import (
+    Cifar10DataSetIterator,
+    EmnistDataSetIterator,
+    EMNIST_SETS,
+    synthetic_images,
+)

@@ -198,6 +198,16 @@ def _assemble_sequential(specs, input_type) -> nn.MultiLayerNetwork:
                 w = (w.reshape(pre.height, pre.width, pre.channels, -1)
                      .transpose(2, 0, 1, 3)
                      .reshape(w.shape[0], -1))
+            if (k == "W"
+                    and isinstance(pre, C.Cnn3DToFeedForwardPreProcessor)
+                    and hasattr(w, "ndim") and w.ndim == 2
+                    and w.shape[0] == pre.depth * pre.height * pre.width
+                    * pre.channels):
+                # keras flattens NDHWC; our 3-D preprocessor is channel-major
+                w = (w.reshape(pre.depth, pre.height, pre.width,
+                               pre.channels, -1)
+                     .transpose(3, 0, 1, 2, 4)
+                     .reshape(w.shape[0], -1))
             net.params[i][k] = (
                 {kk: jnp.asarray(vv) for kk, vv in w.items()}
                 if isinstance(w, dict) else jnp.asarray(w))
@@ -417,6 +427,9 @@ def _infer_input_type_from_shape(shape):
         return C.InputType.convolutional(shape[1], shape[2], shape[3])
     if len(shape) == 3:
         return C.InputType.recurrent(shape[2])
+    if len(shape) == 5:
+        return C.InputType.convolutional3d(shape[1], shape[2], shape[3],
+                                           shape[4])
     raise ValueError(f"cannot infer InputType from {shape}")
 
 
@@ -552,3 +565,82 @@ def import_keras_model_and_weights(h5_path: str):
     if config.get("class_name") == "Sequential":
         return import_keras_sequential_config(config, weights)
     return import_keras_functional_config(config, weights)
+
+
+@KerasLayerMapper.register("Conv1D")
+def _conv1d(cfg, weights):
+    w = weights[0]  # (k, C_in, C_out) — matches our layout
+    k = cfg["kernel_size"]
+    k = int(k[0] if isinstance(k, (list, tuple)) else k)
+    st = cfg.get("strides", 1)
+    st = int(st[0] if isinstance(st, (list, tuple)) else st)
+    if cfg.get("padding") == "causal":
+        raise NotImplementedError("causal Conv1D import")
+    lc = C.Convolution1D(
+        n_in=w.shape[1], n_out=w.shape[2], kernel=k, stride=st,
+        convolution_mode=cfg.get("padding", "valid"),
+        dilation=int(np.atleast_1d(cfg.get("dilation_rate", 1))[0]),
+        activation=_act(cfg))
+    p = {"W": w}
+    if cfg.get("use_bias", True) and len(weights) > 1:
+        p["b"] = weights[1]
+    return lc, p
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
+
+
+@KerasLayerMapper.register("Conv3D")
+def _conv3d(cfg, weights):
+    w = weights[0]  # (kd, kh, kw, C_in, C_out) — matches our layout
+    lc = C.Convolution3D(
+        n_in=w.shape[3], n_out=w.shape[4],
+        kernel=tuple(int(x) for x in cfg["kernel_size"]),
+        stride=tuple(int(x) for x in _triple(cfg.get("strides", (1, 1, 1)))),
+        convolution_mode=cfg.get("padding", "valid"),
+        activation=_act(cfg))
+    p = {"W": w}
+    if cfg.get("use_bias", True) and len(weights) > 1:
+        p["b"] = weights[1]
+    return lc, p
+
+
+@KerasLayerMapper.register("MaxPooling3D")
+def _maxpool3d(cfg, weights):
+    return C.Subsampling3DLayer(
+        kernel=tuple(int(x) for x in _triple(cfg.get("pool_size", 2))),
+        stride=tuple(int(x) for x in _triple(cfg.get("strides")
+                                             or cfg.get("pool_size", 2))),
+        pooling_type="max"), {}
+
+
+@KerasLayerMapper.register("AveragePooling3D")
+def _avgpool3d(cfg, weights):
+    return C.Subsampling3DLayer(
+        kernel=tuple(int(x) for x in _triple(cfg.get("pool_size", 2))),
+        stride=tuple(int(x) for x in _triple(cfg.get("strides")
+                                             or cfg.get("pool_size", 2))),
+        pooling_type="avg"), {}
+
+
+@KerasLayerMapper.register("PReLU")
+def _prelu_keras(cfg, weights):
+    alpha = weights[0]
+    if alpha.ndim > 1:
+        if not np.allclose(alpha, alpha.reshape(-1, alpha.shape[-1])[0]):
+            raise NotImplementedError(
+                "PReLU with non-broadcast (per-position) alpha import")
+        alpha = alpha.reshape(-1, alpha.shape[-1])[0]
+    lc = C.PReLULayer(n_in=alpha.shape[-1])
+    return lc, {"alpha": alpha}
+
+
+@KerasLayerMapper.register("GlobalAveragePooling1D")
+def _gap1d(cfg, weights):
+    return C.GlobalPoolingLayer(pooling_type="avg"), {}
+
+
+@KerasLayerMapper.register("GlobalMaxPooling1D")
+def _gmp1d(cfg, weights):
+    return C.GlobalPoolingLayer(pooling_type="max"), {}

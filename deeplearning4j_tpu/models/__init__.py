@@ -14,4 +14,5 @@ from deeplearning4j_tpu.models.zoo import (
     SqueezeNet,
     Xception,
     TinyYOLO,
+    InceptionResNetV1,
 )

@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 from deeplearning4j_tpu import nn
 from deeplearning4j_tpu.nn import conf as C
 from deeplearning4j_tpu.nn.graph import (
-    ComputationGraph, ElementWiseVertex, GraphBuilder, MergeVertex, graph_builder,
+    ComputationGraph, ElementWiseVertex, GraphBuilder, MergeVertex, ScaleVertex, graph_builder,
 )
 
 
@@ -600,3 +600,135 @@ class TinyYOLO(ZooModel):
         cls_term = jnp.sum(t_obj[..., None] * (cls - t_cls) ** 2)
         return (lambda_coord * coord + obj_term + lambda_noobj * noobj
                 + cls_term) / n
+
+
+class InceptionResNetV1(ZooModel):
+    """zoo/model/InceptionResNetV1.java (the FaceNetNN4-family backbone):
+    stem → 5× Inception-ResNet-A → Reduction-A → 10× Inception-ResNet-B →
+    Reduction-B → 5× Inception-ResNet-C → avgpool → (dropout) → bottleneck
+    embedding + classifier. Block repeat counts are constructor-scaled so
+    tests run small."""
+
+    def __init__(self, num_classes: int = 128, seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (160, 160, 3),
+                 blocks: Tuple[int, int, int] = (5, 10, 5),
+                 embedding_size: int = 128):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or nn.RmsProp(learning_rate=0.1)
+        self.input_shape = input_shape
+        self.blocks = blocks
+        self.embedding_size = embedding_size
+
+    def _conv_bn(self, b, name, inp, n_out, kernel, stride=(1, 1),
+                 mode="same"):
+        b.add_layer(f"{name}_c", nn.ConvolutionLayer(
+            n_out=n_out, kernel=kernel, stride=stride, convolution_mode=mode,
+            activation="identity", has_bias=False), inp)
+        b.add_layer(f"{name}_bn", nn.BatchNormalization(activation="relu"),
+                    f"{name}_c")
+        return f"{name}_bn"
+
+    def _block_a(self, b, name, inp, channels):
+        b1 = self._conv_bn(b, f"{name}_b1", inp, 32, (1, 1))
+        b2 = self._conv_bn(b, f"{name}_b2a", inp, 32, (1, 1))
+        b2 = self._conv_bn(b, f"{name}_b2b", b2, 32, (3, 3))
+        b3 = self._conv_bn(b, f"{name}_b3a", inp, 32, (1, 1))
+        b3 = self._conv_bn(b, f"{name}_b3b", b3, 32, (3, 3))
+        b3 = self._conv_bn(b, f"{name}_b3c", b3, 32, (3, 3))
+        b.add_vertex(f"{name}_cat", MergeVertex(), b1, b2, b3)
+        b.add_layer(f"{name}_up", nn.ConvolutionLayer(
+            n_out=channels, kernel=(1, 1), convolution_mode="same",
+            activation="identity"), f"{name}_cat")
+        b.add_vertex(f"{name}_scale", ScaleVertex(scale=0.17), f"{name}_up")
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp,
+                     f"{name}_scale")
+        b.add_layer(f"{name}_out", nn.ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_out"
+
+    def _block_b(self, b, name, inp, channels):
+        b1 = self._conv_bn(b, f"{name}_b1", inp, 128, (1, 1))
+        b2 = self._conv_bn(b, f"{name}_b2a", inp, 128, (1, 1))
+        b2 = self._conv_bn(b, f"{name}_b2b", b2, 128, (1, 7))
+        b2 = self._conv_bn(b, f"{name}_b2c", b2, 128, (7, 1))
+        b.add_vertex(f"{name}_cat", MergeVertex(), b1, b2)
+        b.add_layer(f"{name}_up", nn.ConvolutionLayer(
+            n_out=channels, kernel=(1, 1), convolution_mode="same",
+            activation="identity"), f"{name}_cat")
+        b.add_vertex(f"{name}_scale", ScaleVertex(scale=0.10), f"{name}_up")
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp,
+                     f"{name}_scale")
+        b.add_layer(f"{name}_out", nn.ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_out"
+
+    def _block_c(self, b, name, inp, channels):
+        b1 = self._conv_bn(b, f"{name}_b1", inp, 192, (1, 1))
+        b2 = self._conv_bn(b, f"{name}_b2a", inp, 192, (1, 1))
+        b2 = self._conv_bn(b, f"{name}_b2b", b2, 192, (1, 3))
+        b2 = self._conv_bn(b, f"{name}_b2c", b2, 192, (3, 1))
+        b.add_vertex(f"{name}_cat", MergeVertex(), b1, b2)
+        b.add_layer(f"{name}_up", nn.ConvolutionLayer(
+            n_out=channels, kernel=(1, 1), convolution_mode="same",
+            activation="identity"), f"{name}_cat")
+        b.add_vertex(f"{name}_scale", ScaleVertex(scale=0.20), f"{name}_up")
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp,
+                     f"{name}_scale")
+        b.add_layer(f"{name}_out", nn.ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_out"
+
+    def init(self) -> ComputationGraph:
+        h, w, c = self.input_shape
+        na, nb_, nc = self.blocks
+        b = (graph_builder().seed(self.seed).updater(self.updater)
+             .weight_init("relu")
+             .add_inputs("input")
+             .set_input_types(input=nn.InputType.convolutional(h, w, c)))
+        node = self._conv_bn(b, "stem1", "input", 32, (3, 3), (2, 2), "valid")
+        node = self._conv_bn(b, "stem2", node, 32, (3, 3), mode="valid")
+        node = self._conv_bn(b, "stem3", node, 64, (3, 3))
+        b.add_layer("stem_pool", nn.SubsamplingLayer(kernel=(3, 3),
+                                                     stride=(2, 2)), node)
+        node = self._conv_bn(b, "stem4", "stem_pool", 80, (1, 1), mode="valid")
+        node = self._conv_bn(b, "stem5", node, 192, (3, 3), mode="valid")
+        node = self._conv_bn(b, "stem6", node, 256, (3, 3), (2, 2), "valid")
+        for i in range(na):
+            node = self._block_a(b, f"a{i}", node, 256)
+        # Reduction-A
+        r1 = self._conv_bn(b, "redA_b1", node, 384, (3, 3), (2, 2), "valid")
+        r2 = self._conv_bn(b, "redA_b2a", node, 192, (1, 1))
+        r2 = self._conv_bn(b, "redA_b2b", r2, 192, (3, 3))
+        r2 = self._conv_bn(b, "redA_b2c", r2, 256, (3, 3), (2, 2), "valid")
+        b.add_layer("redA_pool", nn.SubsamplingLayer(
+            kernel=(3, 3), stride=(2, 2)), node)
+        b.add_vertex("redA_cat", MergeVertex(), r1, r2, "redA_pool")
+        node = "redA_cat"  # 384+256+256 = 896 channels
+        for i in range(nb_):
+            node = self._block_b(b, f"b{i}", node, 896)
+        # Reduction-B
+        r1 = self._conv_bn(b, "redB_b1a", node, 256, (1, 1))
+        r1 = self._conv_bn(b, "redB_b1b", r1, 384, (3, 3), (2, 2), "valid")
+        r2 = self._conv_bn(b, "redB_b2a", node, 256, (1, 1))
+        r2 = self._conv_bn(b, "redB_b2b", r2, 256, (3, 3), (2, 2), "valid")
+        r3 = self._conv_bn(b, "redB_b3a", node, 256, (1, 1))
+        r3 = self._conv_bn(b, "redB_b3b", r3, 256, (3, 3))
+        r3 = self._conv_bn(b, "redB_b3c", r3, 256, (3, 3), (2, 2), "valid")
+        b.add_layer("redB_pool", nn.SubsamplingLayer(
+            kernel=(3, 3), stride=(2, 2)), node)
+        b.add_vertex("redB_cat", MergeVertex(), r1, r2, r3, "redB_pool")
+        node = "redB_cat"  # 384+256+256+896 = 1792 channels
+        for i in range(nc):
+            node = self._block_c(b, f"c{i}", node, 1792)
+        b.add_layer("gap", nn.GlobalPoolingLayer(pooling_type="avg"), node)
+        b.add_layer("bottleneck", nn.DenseLayer(
+            n_out=self.embedding_size, activation="identity",
+            has_bias=False), "gap")
+        b.add_layer("emb_norm", nn.BatchNormalization(activation="identity"),
+                    "bottleneck")
+        b.add_layer("out", nn.OutputLayer(n_out=self.num_classes,
+                                          activation="softmax",
+                                          loss="mcxent"), "emb_norm")
+        b.set_outputs("out")
+        return ComputationGraph(b.build()).init()

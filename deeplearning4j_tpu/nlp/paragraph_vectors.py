@@ -151,24 +151,36 @@ class ParagraphVectors:
         v = jnp.asarray(((rng.rand(D) - 0.5) / D).astype(np.float32))
         syn1 = self.syn1
         V = syn1.shape[0]
-
-        @jax.jit
-        def one(v, words, negs, lr):
-            u_pos = syn1[words]
-            u_neg = syn1[negs]
-            pos = u_pos @ v
-            neg = u_neg.reshape(-1, D) @ v
-            g_pos = jax.nn.sigmoid(pos) - 1.0
-            g_neg = jax.nn.sigmoid(neg)
-            grad = (g_pos[:, None] * u_pos).sum(0) + \
-                   (g_neg[:, None] * u_neg.reshape(-1, D)).sum(0)
-            return v - lr * grad / words.shape[0]
-
+        one = self._infer_step_cached()
         for _ in range(steps):
             negs = rng.choice(V, size=(len(ids), self.negative),
                               p=self._neg_table).astype(np.int32)
-            v = one(v, jnp.asarray(ids), jnp.asarray(negs), jnp.float32(lr))
+            v = one(v, syn1, jnp.asarray(ids), jnp.asarray(negs),
+                    jnp.float32(lr))
         return np.asarray(v)
+
+    def _infer_step_cached(self):
+        """One jitted infer step, built once — syn1 is an ARGUMENT so the
+        compiled function is reused across infer_vector calls (a closure
+        over syn1 would recompile per call)."""
+        fn = getattr(self, "_infer_step", None)
+        if fn is None:
+            D = self.layer_size
+
+            @jax.jit
+            def fn(v, syn1, words, negs, lr):
+                u_pos = syn1[words]
+                u_neg = syn1[negs]
+                pos = u_pos @ v
+                neg = u_neg.reshape(-1, D) @ v
+                g_pos = jax.nn.sigmoid(pos) - 1.0
+                g_neg = jax.nn.sigmoid(neg)
+                grad = (g_pos[:, None] * u_pos).sum(0) + \
+                       (g_neg[:, None] * u_neg.reshape(-1, D)).sum(0)
+                return v - lr * grad / words.shape[0]
+
+            self._infer_step = fn
+        return fn
 
     # ------------------------------------------------------------- lookups
     def get_doc_vector(self, label: str) -> Optional[np.ndarray]:

@@ -29,7 +29,8 @@ class Word2Vec:
                  min_word_frequency: int = 1, negative_samples: int = 5,
                  learning_rate: float = 0.025, epochs: int = 1,
                  batch_size: int = 512, seed: int = 42,
-                 subsample: float = 0.0):
+                 subsample: float = 0.0,
+                 use_hierarchic_softmax: bool = False):
         self.layer_size = layer_size
         self.window = window_size
         self.min_count = min_word_frequency
@@ -39,6 +40,7 @@ class Word2Vec:
         self.batch_size = batch_size
         self.seed = seed
         self.subsample = subsample
+        self.use_hierarchic_softmax = use_hierarchic_softmax
         self.vocab: Dict[str, int] = {}
         self.inv_vocab: List[str] = []
         self.counts: Optional[np.ndarray] = None
@@ -55,6 +57,77 @@ class Word2Vec:
         self.vocab = {w: i for i, (w, c) in enumerate(items)}
         self.inv_vocab = [w for w, _ in items]
         self.counts = np.array([c for _, c in items], np.float64)
+
+    # ----------------------------------------------------- hierarchic softmax
+    def _build_huffman(self):
+        """Huffman coding of the vocabulary by frequency (the reference's
+        useHierarchicSoftmax path — VocabWord points/codes): returns
+        (paths (V, L) inner-node ids, codes (V, L) 0/1 bits, mask (V, L))
+        padded to the longest code."""
+        import heapq
+
+        V = len(self.vocab)
+        heap = [(float(c), i) for i, c in enumerate(self.counts)]
+        heapq.heapify(heap)
+        parent = {}
+        bit = {}
+        next_id = V  # inner nodes numbered V..2V-2
+        while len(heap) > 1:
+            c1, n1 = heapq.heappop(heap)
+            c2, n2 = heapq.heappop(heap)
+            parent[n1], bit[n1] = next_id, 0
+            parent[n2], bit[n2] = next_id, 1
+            heapq.heappush(heap, (c1 + c2, next_id))
+            next_id += 1
+        root = heap[0][1] if heap else V
+        paths, codes = [], []
+        for w in range(V):
+            p, c = [], []
+            node = w
+            while node != root and node in parent:
+                c.append(bit[node])
+                p.append(parent[node] - V)  # inner-node table index
+                node = parent[node]
+            paths.append(p[::-1])
+            codes.append(c[::-1])
+        L = max((len(p) for p in paths), default=1)
+        pad_p = np.zeros((V, L), np.int32)
+        pad_c = np.zeros((V, L), np.float32)
+        mask = np.zeros((V, L), np.float32)
+        for w in range(V):
+            n = len(paths[w])
+            pad_p[w, :n] = paths[w]
+            pad_c[w, :n] = codes[w]
+            mask[w, :n] = 1.0
+        return pad_p, pad_c, mask
+
+    def _make_hs_step(self):
+        def step(syn0, syn1, centers, nodes, codes, mask, lr):
+            """Batched hierarchical-softmax update: along each context
+            word's Huffman path, L = -Σ log σ((1−2·code)·v·u_node)."""
+            v = syn0[centers]                        # (B, D)
+            u = syn1[nodes]                          # (B, L, D)
+            score = jnp.einsum("bd,bld->bl", v, u)   # (B, L)
+            sign = 1.0 - 2.0 * codes
+            # dL/dscore for L = -log σ(sign·s): σ(s) − 1 for code 0,
+            # σ(s) for code 1 → σ(s) − (1 − code)
+            g = (jax.nn.sigmoid(score) - (1.0 - codes)) * mask
+            loss = -jnp.sum(jax.nn.log_sigmoid(sign * score) * mask) /                 jnp.maximum(jnp.sum(mask), 1.0)
+            grad_v = jnp.einsum("bl,bld->bd", g, u)
+            grad_u = g[..., None] * v[:, None, :]
+            V = syn0.shape[0]
+            acc0 = jnp.zeros_like(syn0).at[centers].add(grad_v)
+            cnt0 = jnp.zeros((V,), v.dtype).at[centers].add(1.0)
+            syn0 = syn0 - lr * acc0 / jnp.maximum(cnt0, 1.0)[:, None]
+            flat_nodes = nodes.reshape(-1)
+            acc1 = jnp.zeros_like(syn1).at[flat_nodes].add(
+                grad_u.reshape(-1, grad_u.shape[-1]))
+            cnt1 = jnp.zeros((syn1.shape[0],), v.dtype).at[flat_nodes].add(
+                mask.reshape(-1))
+            syn1 = syn1 - lr * acc1 / jnp.maximum(cnt1, 1.0)[:, None]
+            return syn0, syn1, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------ fit
     def _make_step(self):
@@ -122,6 +195,8 @@ class Word2Vec:
             key = jax.random.key(self.seed)
             self.syn0 = (jax.random.uniform(key, (V, D)) - 0.5) / D
             self.syn1 = jnp.zeros((V, D))
+        if self.use_hierarchic_softmax:
+            return self._fit_hs(sentences)
         if self._step_fn is None:
             self._step_fn = self._make_step()
         # unigram^0.75 negative-sampling table (reference's table approach)
@@ -142,6 +217,35 @@ class Word2Vec:
                 self.syn0, self.syn1, loss = self._step_fn(
                     self.syn0, self.syn1, jnp.asarray(centers[idx]),
                     jnp.asarray(contexts[idx]), jnp.asarray(negs, jnp.int32),
+                    jnp.asarray(lr, jnp.float32))
+                losses.append(loss)
+            history.append(float(jnp.mean(jnp.stack(losses))) if losses else float("nan"))
+        return history
+
+    def _fit_hs(self, sentences: List[List[str]]) -> List[float]:
+        """Hierarchical-softmax training (useHierarchicSoftmax=true)."""
+        V, D = len(self.vocab), self.layer_size
+        paths, codes, mask = self._build_huffman()
+        # syn1 here is the INNER-NODE table (V-1 rows), reference syn1 role
+        self.syn1 = jnp.zeros((max(V - 1, 1), D))
+        step = self._make_hs_step()
+        rng = np.random.RandomState(self.seed)
+        paths_j, codes_j, mask_j = (jnp.asarray(paths), jnp.asarray(codes),
+                                    jnp.asarray(mask))
+        history = []
+        for ep in range(self.epochs):
+            centers, contexts = self._pairs(sentences, rng)
+            order = rng.permutation(len(centers))
+            losses = []
+            lr = self.lr * max(0.0001, 1.0 - ep / max(self.epochs, 1))
+            for i in range(0, len(order), self.batch_size):
+                idx = order[i : i + self.batch_size]
+                if len(idx) < 2:
+                    continue
+                ctx = contexts[idx]
+                self.syn0, self.syn1, loss = step(
+                    self.syn0, self.syn1, jnp.asarray(centers[idx]),
+                    paths_j[ctx], codes_j[ctx], mask_j[ctx],
                     jnp.asarray(lr, jnp.float32))
                 losses.append(loss)
             history.append(float(jnp.mean(jnp.stack(losses))) if losses else float("nan"))

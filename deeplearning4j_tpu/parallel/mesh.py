@@ -72,10 +72,6 @@ DEFAULT_TP_RULES: List[Tuple[str, P]] = [
 ]
 
 
-def _is_conv_kernel(leaf) -> bool:
-    return np.ndim(leaf) == 4
-
-
 def _spec_for(path: str, rules: Sequence[Tuple[str, P]]) -> P:
     for pat, spec in rules:
         if re.fullmatch(pat, path):
@@ -108,12 +104,16 @@ def shard_params(params, mesh: Mesh, rules: Optional[Sequence[Tuple[str, P]]] = 
     specs = {}
     for path, leaf in flat:
         spec = _spec_for(path, rules)
-        if spec == P(None, None, None, "model") and not _is_conv_kernel(leaf):
-            # the conv rule matched a non-4D /W leaf: shard the
-            # output-feature (LAST) axis whatever the rank — dense (2D),
-            # Conv1D/locally-connected (3D), Conv3D (5D)
+        if (len(spec) and len(spec) != np.ndim(leaf)
+                and spec[-1] is not None
+                and all(a is None for a in spec[:-1])):
+            # rank-agnostic last-axis sharding: a rule of the form
+            # P(None, ..., axis) means "shard the output-feature (LAST)
+            # axis" — adapt it to the leaf's actual rank (dense 2D,
+            # Conv1D/locally-connected 3D, conv 4D, Conv3D 5D) instead of
+            # silently replicating on rank mismatch
             nd = np.ndim(leaf)
-            spec = P(*([None] * (nd - 1) + ["model"])) if nd >= 1 else P()
+            spec = P(*([None] * (nd - 1) + [spec[-1]])) if nd >= 1 else P()
         # validate divisibility; fall back to replication — LOUDLY, so a
         # mis-sized layer doesn't silently train without TP
         ok = True

@@ -2,6 +2,8 @@
 
 import numpy as np
 
+from deeplearning4j_tpu import nn
+
 from deeplearning4j_tpu.datasets.image import (
     ColorJitterTransform, FlipImageTransform, PipelineImageTransform,
     RandomCropTransform, RotateImageTransform, SyntheticImageNetIterator,
@@ -70,3 +72,61 @@ class TestSyntheticImageNet:
         pred = np.argmin(
             ((feats[:, None, :] - cents[None]) ** 2).sum(-1), axis=1)
         assert (pred == y).mean() > 0.5
+
+
+class TestCifarEmnistIterators:
+    """Round-3 fetcher fill: CIFAR-10 + EMNIST iterators (deeplearning4j-
+    datasets role) — local files when present, deterministic synthetic
+    fallback otherwise (no egress in this environment)."""
+
+    def test_cifar10_iterator_shapes_and_determinism(self):
+        from deeplearning4j_tpu.datasets import Cifar10DataSetIterator
+
+        it = Cifar10DataSetIterator(batch_size=16, train=True,
+                                    num_examples=64, seed=5,
+                                    root="/nonexistent")  # force synthetic
+        assert it.synthetic
+        ds = next(iter(it))
+        assert ds.features.shape == (16, 32, 32, 3)
+        assert ds.labels.shape == (16, 10)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+        it2 = Cifar10DataSetIterator(batch_size=16, train=True,
+                                     num_examples=64, seed=5,
+                                     root="/nonexistent")
+        np.testing.assert_array_equal(ds.features,
+                                      next(iter(it2)).features)
+
+    def test_cifar10_is_learnable(self):
+        from deeplearning4j_tpu.datasets import Cifar10DataSetIterator
+
+        it = Cifar10DataSetIterator(batch_size=64, train=True,
+                                    num_examples=256, seed=1,
+                                    root="/nonexistent")
+        b = nn.builder().seed(3).updater(nn.Adam(learning_rate=1e-3)).list()
+        b.layer(nn.ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                    convolution_mode="same",
+                                    activation="relu"))
+        b.layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        b.layer(nn.OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        net = nn.MultiLayerNetwork(
+            b.set_input_type(nn.InputType.convolutional(32, 32, 3)).build()).init()
+        net.fit(it, epochs=6)
+        ev = net.evaluate(Cifar10DataSetIterator(batch_size=64, train=True,
+                                                 num_examples=256, seed=1,
+                                                 root="/nonexistent"))
+        assert ev.accuracy() > 0.3  # well above 10% chance
+
+    def test_emnist_sets(self):
+        from deeplearning4j_tpu.datasets import (
+            EMNIST_SETS, EmnistDataSetIterator)
+
+        it = EmnistDataSetIterator(batch_size=8, emnist_set="letters",
+                                   num_examples=32, root="/nonexistent")
+        ds = next(iter(it))
+        assert ds.features.shape == (8, 784)
+        assert ds.labels.shape == (8, 26)
+        assert EMNIST_SETS["balanced"] == 47
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            EmnistDataSetIterator(batch_size=8, emnist_set="nope")

@@ -106,9 +106,9 @@ class TestKerasImport:
     def test_unsupported_layer_raises(self):
         model = tf.keras.Sequential([
             tf.keras.layers.Input((4, 4)),
-            tf.keras.layers.Conv1D(2, 2),
+            tf.keras.layers.GaussianNoise(0.1),
         ])
-        with pytest.raises(NotImplementedError, match="Conv1D"):
+        with pytest.raises(NotImplementedError, match="GaussianNoise"):
             import_keras_model(model)
 
 
@@ -200,5 +200,46 @@ class TestKerasOwnH5:
         model.save(path)
         net = import_keras_model_and_weights(path)
         x = rng.rand(2, 10, 10, 3).astype(np.float32)
+        golden = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x), golden, rtol=1e-4, atol=1e-5)
+
+    def test_conv1d_prelu_pool_golden(self, tmp_path):
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights)
+
+        rng = np.random.RandomState(4)
+        model = keras.Sequential([
+            keras.layers.Input((12, 3)),
+            keras.layers.Conv1D(6, 3, activation="tanh", padding="same"),
+            keras.layers.PReLU(shared_axes=[1]),
+            keras.layers.GlobalAveragePooling1D(),
+            keras.layers.Dense(4, activation="softmax"),
+        ])
+        # nudge PReLU alphas off their init so the import actually carries them
+        ws = model.layers[1].get_weights()
+        model.layers[1].set_weights([np.abs(rng.rand(*ws[0].shape)) * 0.5])
+        path = str(tmp_path / "c1d.h5")
+        model.save(path)
+        net = import_keras_model_and_weights(path)
+        x = rng.rand(3, 12, 3).astype(np.float32)
+        golden = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x), golden, rtol=1e-4, atol=1e-5)
+
+    def test_conv3d_pool3d_golden(self, tmp_path):
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights)
+
+        rng = np.random.RandomState(5)
+        model = keras.Sequential([
+            keras.layers.Input((6, 8, 8, 2)),
+            keras.layers.Conv3D(4, 3, activation="relu", padding="valid"),
+            keras.layers.MaxPooling3D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        path = str(tmp_path / "c3d.h5")
+        model.save(path)
+        net = import_keras_model_and_weights(path)
+        x = rng.rand(2, 6, 8, 8, 2).astype(np.float32)
         golden = model.predict(x, verbose=0)
         np.testing.assert_allclose(net.output(x), golden, rtol=1e-4, atol=1e-5)

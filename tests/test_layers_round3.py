@@ -324,3 +324,13 @@ class TestReviewRegressions:
         net2 = nn.MultiLayerNetwork(conf2).init(net.params)
         x = _rng(14).randn(2, 4, 5, 5, 2).astype(np.float32)
         np.testing.assert_allclose(net.output(x), net2.output(x), rtol=1e-6)
+
+
+    def test_inception_resnet_v1_builds_and_runs(self):
+        from deeplearning4j_tpu.models import InceptionResNetV1
+
+        net = InceptionResNetV1(num_classes=4, input_shape=(96, 96, 3),
+                                blocks=(1, 1, 1)).init()
+        out = net.output(np.random.rand(1, 96, 96, 3).astype(np.float32))[0]
+        assert out.shape == (1, 4)
+        np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-4)

@@ -285,3 +285,25 @@ class TestAsyncRL:
         recent = a3c.episode_rewards[-20:]
         assert len(recent) >= 5
         assert np.mean(recent) > 0.8
+
+
+class TestHierarchicalSoftmax:
+    def test_huffman_codes_prefix_free_and_frequency_ordered(self):
+        w2v = Word2Vec(layer_size=8, use_hierarchic_softmax=True)
+        w2v.build_vocab(toy_corpus2())
+        paths, codes, mask = w2v._build_huffman()
+        lens = mask.sum(axis=1)
+        # most frequent word gets one of the SHORTEST codes
+        assert lens[0] == lens.min()
+        # codes are prefix-free: all (path, code) full sequences distinct
+        seqs = {tuple(zip(paths[i][:int(lens[i])], codes[i][:int(lens[i])]))
+                for i in range(len(lens))}
+        assert len(seqs) == len(lens)
+
+    def test_hs_training_learns(self):
+        w2v = Word2Vec(layer_size=16, window_size=3, epochs=8,
+                       use_hierarchic_softmax=True, seed=2,
+                       learning_rate=0.05)
+        losses = w2v.fit(toy_corpus2())
+        assert losses[-1] < losses[0]
+        assert np.isfinite(w2v.similarity("king", "queen"))

@@ -249,3 +249,67 @@ class TestLayerGraphEquivalence:
         xs = rng.randn(6, 5).astype(np.float32)
         np.testing.assert_allclose(
             sd.output({"x": xs}, "out")["out"], net.output(xs), rtol=1e-5, atol=1e-6)
+
+
+class TestSameDiffListeners:
+    """Round-3 listener-family completion: HistoryListener + UIListener
+    (autodiff/listeners/records/History + UIListener roles)."""
+
+    def _train_sd(self, listeners, epochs=2):
+        from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+        from deeplearning4j_tpu.nn.updater import Sgd
+
+        sd = SameDiff.create()
+        rng = np.random.RandomState(0)
+        x = sd.placeholder("x", shape=(None, 4))
+        y = sd.placeholder("y", shape=(None, 2))
+        w = sd.var("w", rng.randn(4, 2).astype(np.float32) * 0.1)
+        b = sd.var("b", np.zeros(2, np.float32))
+        out = sd.nn.softmax(x @ w + b)
+        loss = sd.loss.log_loss(out, y).rename("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Sgd(learning_rate=0.05),
+            data_set_feature_mapping=["x"],
+            data_set_label_mapping=["y"],
+            loss_variables=["loss"]))
+        sd.set_listeners(*listeners)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        feats = rng.rand(64, 4).astype(np.float32)
+        labels = np.eye(2)[rng.randint(0, 2, 64)].astype(np.float32)
+        sd.fit(DataSet(feats, labels), epochs=epochs)
+        return sd
+
+    def test_history_listener(self):
+        from deeplearning4j_tpu.autodiff import HistoryListener
+
+        hl = HistoryListener()
+        self._train_sd([hl], epochs=3)
+        h = hl.finalize()
+        assert len(h.epoch_losses) == 3
+        assert len(h.loss_curve) == 3 * 2  # 64/32 batches per epoch
+        assert np.isfinite(h.final_train_loss())
+        assert h.epoch_losses[-1] <= h.epoch_losses[0]
+        assert h.training_time_millis > 0
+
+    def test_ui_listener_feeds_dashboard(self):
+        import json
+        import urllib.request
+
+        from deeplearning4j_tpu.autodiff import UIListener
+        from deeplearning4j_tpu.ui import UIServer
+        from deeplearning4j_tpu.utils.stats import StatsStorage
+
+        server = UIServer(port=0).start()
+        try:
+            storage = StatsStorage()
+            server.attach(storage)
+            self._train_sd([UIListener(storage)], epochs=2)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/train/overview",
+                    timeout=5) as r:
+                ov = json.loads(r.read())
+            assert len(ov["score"]) == 4
+            assert all(np.isfinite(p[1]) for p in ov["score"])
+        finally:
+            server.stop()
