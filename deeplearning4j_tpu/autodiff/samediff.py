@@ -581,6 +581,21 @@ class SameDiff:
         self._arrays[name] = arr
         return v
 
+    def op(self, name: str, *inputs, **kwargs) -> SDVariable:
+        """Record ANY catalog op by name — the Nd4j.exec(DynamicCustomOp)
+        parity surface: every declarable-op-registry name (254 ops) plus the
+        graph-op table is recordable without a dedicated namespace method.
+
+            vals, idx = sd.op("top_k", x, k=5, n_out=2)
+
+        Multi-output ops take ``n_out`` (the DynamicCustomOp numOutputs
+        role) and return a list. Unknown names raise at graph build, not at
+        execution."""
+        n_out = int(kwargs.pop("n_out", 1))
+        resolve_graph_op(name, self._local_ops)  # existence check
+        ins = [self._lift(x) for x in inputs]
+        return self._record(name, ins, kwargs or None, n_out=n_out)
+
     def _lift(self, x) -> SDVariable:
         if isinstance(x, SDVariable):
             return x

@@ -28,12 +28,15 @@ _DEFAULT_ROOT = os.path.expanduser("~/.dl4jtpu/mnist")
 
 
 def _load_idx(path: str) -> np.ndarray:
+    """Read an IDX ubyte file through the shared (native-capable) parser
+    (native/record_loader.cpp via native_ops.record_loader); returns uint8
+    to preserve the historical contract for label files."""
+    from deeplearning4j_tpu.native_ops.record_loader import idx_to_array
+
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
-        magic = struct.unpack(">I", f.read(4))[0]
-        ndim = magic & 0xFF
-        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
-        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+        buf = f.read()
+    return idx_to_array(buf, scale=False).astype(np.uint8)
 
 
 def _find_idx(root: str, names) -> Optional[str]:

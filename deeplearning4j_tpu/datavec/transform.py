@@ -582,6 +582,23 @@ class CSVRecordReader:
                 out.append(v)
         return out
 
+    def read_matrix(self, source: Union[str, "io.TextIOBase"],
+                    cols: int) -> "np.ndarray":
+        """All-numeric fast path: CSV → (rows, cols) float32 with NaN for
+        non-numeric cells, through the NATIVE loader when built
+        (native/record_loader.cpp — the reference's native record-reader
+        role); numpy fallback otherwise."""
+        from deeplearning4j_tpu.native_ops.record_loader import (
+            csv_to_float_matrix)
+
+        if isinstance(source, str) and "\n" not in source:
+            with open(source) as f:
+                text = f.read()
+        else:
+            text = source if isinstance(source, str) else source.read()
+        return csv_to_float_matrix(text, cols, delimiter=self.delimiter,
+                                   skip_rows=self.skip_lines)
+
     def read(self, source: Union[str, io.TextIOBase]) -> List[List[Any]]:
         if isinstance(source, str) and "\n" not in source:
             with open(source, newline="") as f:

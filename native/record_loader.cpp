@@ -1,0 +1,107 @@
+// Native record loader — the datavec native-loader role.
+//
+// Reference parity: the reference's record readers bottom out in native
+// code (JavaCPP-wrapped loaders; libnd4j NativeOps I/O helpers) so Java
+// never parses bytes on the training path. Here the hot loaders are:
+//
+//   * csv_parse_floats: one-pass CSV → float32 matrix (delimiter
+//     configurable, quoted fields skipped as NaN), replacing Python
+//     csv.reader + float() per cell for numeric tables.
+//   * idx_parse: IDX (MNIST/EMNIST container) → float32 [0,1] array.
+//
+// Consumed via ctypes (deeplearning4j_tpu/native_ops/record_loader.py);
+// the Python CSVRecordReader keeps its general typed path and delegates
+// all-numeric schemas here.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// Parse CSV text into out[rows*cols] (caller-allocated, row-major).
+// Returns the number of rows parsed, or -1 if a row has != cols fields.
+// Empty/unparseable fields become NaN (quality analysis counts them).
+long long csv_parse_floats(const char* text, long long len, char delim,
+                           long long skip_rows, long long cols,
+                           long long max_rows, float* out) {
+    const char* p = text;
+    const char* end = text + len;
+    long long row = 0;
+    // skip header rows
+    for (long long s = 0; s < skip_rows && p < end; ++s) {
+        while (p < end && *p != '\n') ++p;
+        if (p < end) ++p;
+    }
+    while (p < end && row < max_rows) {
+        // skip blank lines (including whitespace-only ones)
+        if (*p == '\n' || *p == '\r') { ++p; continue; }
+        {
+            const char* scan = p;
+            while (scan < end && (*scan == ' ' || *scan == '\t')) ++scan;
+            if (scan == end) break;
+            if (*scan == '\n' || *scan == '\r') { p = scan + 1; continue; }
+        }
+        long long col = 0;
+        while (p <= end) {
+            const char* field = p;
+            while (p < end && *p != delim && *p != '\n' && *p != '\r') ++p;
+            if (col >= cols) return -1;
+            char* parse_end = nullptr;
+            double v = strtod(field, &parse_end);
+            bool ok = parse_end > field;
+            // match the Python fallback's accepted syntax: plain
+            // decimal/scientific only (strtod would accept 0x hex)
+            for (const char* h = field; ok && h < parse_end; ++h)
+                if (*h == 'x' || *h == 'X') ok = false;
+            // strtod must have consumed up to the delimiter (trailing
+            // spaces allowed); otherwise the field is non-numeric
+            if (ok) {
+                const char* q = parse_end;
+                while (q < p && (*q == ' ' || *q == '\t')) ++q;
+                ok = (q == p);
+            }
+            out[row * cols + col] = ok ? (float)v : NAN;
+            ++col;
+            if (p >= end || *p == '\n' || *p == '\r') break;
+            ++p;  // skip delimiter
+        }
+        if (col != cols) return -1;
+        ++row;
+        while (p < end && (*p == '\r')) ++p;
+        if (p < end && *p == '\n') ++p;
+    }
+    return row;
+}
+
+// Parse an IDX buffer (big-endian header: magic, dims...) of unsigned
+// bytes into out (scaled to [0,1] when scale != 0). Returns element count
+// or -1 on malformed input. shape_out receives up to 4 dims; ndim_out the
+// dimension count.
+long long idx_parse(const unsigned char* buf, long long len, int scale,
+                    float* out, long long out_capacity,
+                    long long* shape_out, int* ndim_out) {
+    if (len < 4) return -1;
+    if (buf[0] != 0 || buf[1] != 0) return -1;
+    int dtype = buf[2];
+    int ndim = buf[3];
+    if (dtype != 0x08 || ndim < 1 || ndim > 4) return -1;  // ubyte only
+    if (len < 4 + 4 * ndim) return -1;
+    long long total = 1;
+    for (int d = 0; d < ndim; ++d) {
+        const unsigned char* q = buf + 4 + 4 * d;
+        long long dim = ((long long)q[0] << 24) | ((long long)q[1] << 16) |
+                        ((long long)q[2] << 8) | (long long)q[3];
+        shape_out[d] = dim;
+        total *= dim;
+    }
+    *ndim_out = ndim;
+    if (total > out_capacity || len < 4 + 4 * ndim + total) return -1;
+    const unsigned char* data = buf + 4 + 4 * ndim;
+    const float k = scale ? (1.0f / 255.0f) : 1.0f;
+    for (long long i = 0; i < total; ++i) out[i] = data[i] * k;
+    return total;
+}
+
+}  // extern "C"

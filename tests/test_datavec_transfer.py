@@ -288,3 +288,12 @@ class TestDataVecJoinsSequencesQuality:
         an = analyze(records, schema)
         assert an.min_of("b") == 2.0 and an.max_of("b") == 5.0
         np.testing.assert_allclose(an.mean_of("a"), (1 + 4) / 2)
+
+
+class TestCSVNativeFastPath:
+    def test_read_matrix(self):
+        from deeplearning4j_tpu.datavec import CSVRecordReader
+
+        rr = CSVRecordReader(skip_lines=1)
+        m = rr.read_matrix("a,b\n1,2\n3.5,4\n", 2)
+        np.testing.assert_allclose(m, [[1, 2], [3.5, 4]])

@@ -313,3 +313,27 @@ class TestSameDiffListeners:
             assert all(np.isfinite(p[1]) for p in ov["score"])
         finally:
             server.stop()
+
+
+class TestGenericOpFacade:
+    """sd.op(name, ...) — Nd4j.exec(DynamicCustomOp) parity over the full
+    254-op declarable catalog."""
+
+    def test_catalog_op_records_and_executes(self):
+        from deeplearning4j_tpu.autodiff import SameDiff
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 8))
+        vals_v, idx_v = sd.op("top_k", x, k=3, n_out=2)
+        feats = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        vals = sd.output({"x": feats}, vals_v.name)[vals_v.name]
+        np.testing.assert_allclose(vals, np.sort(feats, axis=1)[:, ::-1][:, :3],
+                                   rtol=1e-6)
+
+    def test_unknown_op_fails_at_build(self):
+        from deeplearning4j_tpu.autodiff import SameDiff
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(2, 2))
+        with pytest.raises(Exception):
+            sd.op("definitely_not_an_op", x)

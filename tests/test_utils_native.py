@@ -154,3 +154,52 @@ class TestNativeCodec:
         idx_p, res_p = _py_encode(g.copy(), 0.8, 512)
         np.testing.assert_array_equal(idx_n, idx_p)
         np.testing.assert_allclose(res_n, res_p, rtol=1e-6)
+
+
+class TestNativeRecordLoader:
+    """Native CSV/IDX loader (native/record_loader.cpp) — native-vs-python
+    equality, the libnd4j-style two-impl check."""
+
+    def test_csv_native_matches_python(self):
+        from deeplearning4j_tpu.native_ops import record_loader as rl
+
+        text = "h1,h2,h3\n1.5,2,3\n4,,bad\n7,8.25,9\n"
+        out = rl.csv_to_float_matrix(text, 3, skip_rows=1)
+        assert out.shape == (3, 3)
+        np.testing.assert_allclose(out[0], [1.5, 2, 3])
+        assert np.isnan(out[1, 1]) and np.isnan(out[1, 2])
+        np.testing.assert_allclose(out[2], [7, 8.25, 9])
+        if rl.native_loader_available():
+            # force the python fallback and compare elementwise
+            import deeplearning4j_tpu.native_ops.record_loader as mod
+
+            orig = mod._loader_lib
+            try:
+                mod._loader_lib = lambda: None
+                py = rl.csv_to_float_matrix(text, 3, skip_rows=1)
+            finally:
+                mod._loader_lib = orig
+            np.testing.assert_array_equal(np.isnan(out), np.isnan(py))
+            np.testing.assert_allclose(out[~np.isnan(out)], py[~np.isnan(py)])
+
+    def test_csv_ragged_raises(self):
+        from deeplearning4j_tpu.native_ops import record_loader as rl
+
+        with pytest.raises(ValueError):
+            rl.csv_to_float_matrix("1,2\n3\n", 2)
+
+    def test_idx_round_trip(self):
+        import struct
+
+        from deeplearning4j_tpu.native_ops import record_loader as rl
+
+        rng = np.random.RandomState(0)
+        arr = rng.randint(0, 256, (4, 5, 6)).astype(np.uint8)
+        buf = struct.pack(">BBBB", 0, 0, 0x08, 3)
+        buf += struct.pack(">III", 4, 5, 6)
+        buf += arr.tobytes()
+        out = rl.idx_to_array(buf)
+        assert out.shape == (4, 5, 6)
+        np.testing.assert_allclose(out, arr.astype(np.float32) / 255.0)
+        out2 = rl.idx_to_array(buf, scale=False)
+        np.testing.assert_allclose(out2, arr.astype(np.float32))
