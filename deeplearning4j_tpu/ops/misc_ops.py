@@ -448,3 +448,264 @@ def _check_polygamma():
     np.testing.assert_allclose(
         np.asarray(_REG.exec("polygamma", jnp.asarray(n), jnp.asarray(x))),
         special.polygamma(n, x).astype(np.float32), rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# round-3 tail: ordering/layout ops completing the ~270-name catalog
+# (generic/parity_ops — sort, argsort [dynamic_]stitch done above, roll,
+# triu/tril, invert_permutation, meshgrid, stop_gradient, identity_n)
+# ---------------------------------------------------------------------------
+
+
+@_op("sort")
+def sort(x, *, axis: int = -1, descending: bool = False):
+    """sort along axis (generic/parity_ops/sort.cpp). Descending uses the
+    native stable descending sort (ties keep order; NaNs sort FIRST in
+    descending order, matching XLA's total order — not numpy's NaN-last)."""
+    return jnp.sort(x, axis=axis, descending=descending)
+
+
+@_op("argsort")
+def argsort(x, *, axis: int = -1, descending: bool = False):
+    """argsort along axis (Nd4j.sortWithIndices role); stable for ties in
+    both directions."""
+    return jnp.argsort(x, axis=axis, descending=descending)
+
+
+@_op("roll")
+def roll(x, *, shift, axis=None):
+    """cyclic roll (generic/transforms/roll.cpp)."""
+    return jnp.roll(x, shift, axis=axis)
+
+
+@_op("triu")
+def triu(x, *, diag: int = 0):
+    """upper triangle (generic/parity_ops/triu.cpp)."""
+    return jnp.triu(x, k=diag)
+
+
+@_op("tril")
+def tril(x, *, diag: int = 0):
+    """lower triangle (generic/parity_ops analog of triu)."""
+    return jnp.tril(x, k=diag)
+
+
+@_op("invert_permutation")
+def invert_permutation(x):
+    """inverse permutation vector (generic/parity_ops/invertPermutation)."""
+    n = x.shape[0]
+    return jnp.zeros((n,), x.dtype).at[x].set(jnp.arange(n, dtype=x.dtype))
+
+
+@_op("meshgrid")
+def meshgrid(*xs, indexing: str = "xy"):
+    """meshgrid (generic/parity_ops/meshgrid.cpp)."""
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+@_op("stop_gradient")
+def stop_gradient(x):
+    """gradient barrier (StopGradient op)."""
+    return jax.lax.stop_gradient(x)
+
+
+@_op("identity_n")
+def identity_n(*xs):
+    """identity over a tensor list (generic/parity_ops/identity_n.cpp)."""
+    return tuple(xs)
+
+
+@_op("mirror_pad")
+def mirror_pad(x, *, paddings, mode: str = "reflect"):
+    """mirror_pad (generic/parity_ops/mirror_pad.cpp): REFLECT|SYMMETRIC."""
+    return jnp.pad(x, paddings, mode=mode.lower())
+
+
+@_op("batch_gather")
+def batch_gather(params, indices):
+    """per-batch gather (TF batch_gather parity): gathers along axis
+    ``indices.ndim - 1`` of params, broadcasting over params' trailing
+    dims — params (B, N, ...) + indices (B, M) → (B, M, ...)."""
+    idx = indices.astype(jnp.int32)
+    axis = idx.ndim - 1
+    expanded = idx.reshape(idx.shape + (1,) * (params.ndim - idx.ndim))
+    return jnp.take_along_axis(params, expanded, axis=axis)
+
+
+@_op("log_sigmoid")
+def log_sigmoid(x):
+    """log σ(x) (legacy transform)."""
+    return jax.nn.log_sigmoid(x)
+
+
+@_op("cosine_similarity")
+def cosine_similarity(a, b, *, axis: int = -1, eps: float = 1e-12):
+    """reduce3 cosine similarity (libnd4j reduce3/CosineSimilarity)."""
+    num = jnp.sum(a * b, axis=axis)
+    den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+    return num / jnp.maximum(den, eps)
+
+
+@_op("euclidean_distance")
+def euclidean_distance(a, b, *, axis: int = -1):
+    """reduce3 EuclideanDistance."""
+    return jnp.sqrt(jnp.sum(jnp.square(a - b), axis=axis))
+
+
+@_op("manhattan_distance")
+def manhattan_distance(a, b, *, axis: int = -1):
+    """reduce3 ManhattanDistance."""
+    return jnp.sum(jnp.abs(a - b), axis=axis)
+
+
+@_op("hamming_distance")
+def hamming_distance(a, b, *, axis: int = -1):
+    """reduce3 HammingDistance (count of unequal entries)."""
+    return jnp.sum((a != b).astype(jnp.float32), axis=axis)
+
+
+@validation.case("sort")
+def _check_sort():
+    x = np.random.RandomState(20).randn(4, 7).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("sort", jnp.asarray(x))), np.sort(x, -1))
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("sort", jnp.asarray(x), descending=True)),
+        -np.sort(-x, -1))
+    # NaNs sort first in descending order (XLA total order); stable ties
+    got = np.asarray(_REG.exec("sort",
+                               jnp.asarray([1.0, np.nan, 3.0]),
+                               descending=True))
+    assert np.isnan(got[0]) and list(got[1:]) == [3.0, 1.0]
+    tie_idx = np.asarray(_REG.exec("argsort",
+                                   jnp.asarray([3.0, 1.0, 1.0]),
+                                   descending=True))
+    np.testing.assert_array_equal(tie_idx, [0, 1, 2])
+
+
+@validation.case("argsort")
+def _check_argsort():
+    x = np.random.RandomState(21).randn(3, 6).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("argsort", jnp.asarray(x))), np.argsort(x, -1))
+
+
+@validation.case("roll")
+def _check_roll():
+    x = np.arange(12).reshape(3, 4)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("roll", jnp.asarray(x), shift=2, axis=1)),
+        np.roll(x, 2, axis=1))
+
+
+@validation.case("triu")
+def _check_triu():
+    x = np.random.RandomState(22).randn(4, 4).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("triu", jnp.asarray(x), diag=1)), np.triu(x, 1))
+
+
+@validation.case("tril")
+def _check_tril():
+    x = np.random.RandomState(23).randn(4, 4).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("tril", jnp.asarray(x))), np.tril(x))
+
+
+@validation.case("invert_permutation")
+def _check_invperm():
+    p = np.asarray([2, 0, 3, 1], np.int32)
+    got = np.asarray(_REG.exec("invert_permutation", jnp.asarray(p)))
+    np.testing.assert_array_equal(got[p], np.arange(4))
+
+
+@validation.case("meshgrid")
+def _check_meshgrid():
+    a, b = _REG.exec("meshgrid", jnp.arange(3), jnp.arange(2))
+    wa, wb = np.meshgrid(np.arange(3), np.arange(2))
+    np.testing.assert_array_equal(np.asarray(a), wa)
+    np.testing.assert_array_equal(np.asarray(b), wb)
+
+
+@validation.case("stop_gradient")
+def _check_stopgrad():
+    g = jax.grad(lambda x: jnp.sum(_REG.exec("stop_gradient", x) * x))(
+        jnp.ones(3))
+    np.testing.assert_allclose(np.asarray(g), 1.0)  # only the outer x
+
+
+@validation.case("identity_n")
+def _check_idn():
+    a, b = _REG.exec("identity_n", jnp.ones(2), jnp.zeros(3))
+    assert np.asarray(a).shape == (2,) and np.asarray(b).shape == (3,)
+
+
+@validation.case("mirror_pad")
+def _check_mirror_pad():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_array_equal(
+        np.asarray(_REG.exec("mirror_pad", jnp.asarray(x),
+                             paddings=[(1, 1), (1, 1)], mode="symmetric")),
+        np.pad(x, [(1, 1), (1, 1)], mode="symmetric"))
+
+
+@validation.case("batch_gather")
+def _check_batch_gather():
+    x = np.random.RandomState(24).randn(3, 5).astype(np.float32)
+    idx = np.asarray([[0, 2], [1, 1], [4, 0]], np.int32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("batch_gather", jnp.asarray(x), jnp.asarray(idx))),
+        np.take_along_axis(x, idx, axis=-1))
+    # the canonical higher-rank case: (B, N, D) + (B, M) → (B, M, D)
+    p3 = np.random.RandomState(25).randn(2, 4, 3).astype(np.float32)
+    i2 = np.asarray([[0, 3], [2, 1]], np.int32)
+    got = np.asarray(_REG.exec("batch_gather", jnp.asarray(p3),
+                               jnp.asarray(i2)))
+    want = np.stack([p3[b][i2[b]] for b in range(2)])
+    np.testing.assert_allclose(got, want)
+
+
+@validation.case("log_sigmoid")
+def _check_log_sigmoid():
+    x = np.random.RandomState(25).randn(8).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("log_sigmoid", jnp.asarray(x))),
+        -np.log1p(np.exp(-x)), rtol=1e-3, atol=1e-5)  # chip-tolerant
+
+
+@validation.case("cosine_similarity")
+def _check_cos_sim():
+    r = np.random.RandomState(26)
+    a = r.randn(4, 8).astype(np.float32)
+    b = r.randn(4, 8).astype(np.float32)
+    want = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1)
+                              * np.linalg.norm(b, axis=-1))
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("cosine_similarity", jnp.asarray(a), jnp.asarray(b))),
+        want, rtol=1e-5, atol=1e-6)
+
+
+@validation.case("euclidean_distance")
+def _check_euclid():
+    a = np.asarray([[0.0, 0.0], [1.0, 1.0]], np.float32)
+    b = np.asarray([[3.0, 4.0], [1.0, 1.0]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("euclidean_distance", jnp.asarray(a), jnp.asarray(b))),
+        [5.0, 0.0], rtol=1e-6)
+
+
+@validation.case("manhattan_distance")
+def _check_manhattan():
+    a = np.asarray([[0.0, 0.0]], np.float32)
+    b = np.asarray([[3.0, -4.0]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_REG.exec("manhattan_distance", jnp.asarray(a), jnp.asarray(b))),
+        [7.0], rtol=1e-6)
+
+
+@validation.case("hamming_distance")
+def _check_hamming_dist():
+    a = np.asarray([1, 2, 3, 4], np.int32)
+    b = np.asarray([1, 0, 3, 0], np.int32)
+    assert float(_REG.exec("hamming_distance", jnp.asarray(a),
+                           jnp.asarray(b), axis=0)) == 2.0
