@@ -6,7 +6,11 @@ under the driver). The reference published no numbers
 (``BASELINE.json.published == {}``), so ``vs_baseline`` ratchets against the
 last recorded value in BENCH_HISTORY.json (1.0 on first run).
 
-Env knobs: BENCH_BATCH (default 128), BENCH_ITERS (default 20),
+Env knobs: BENCH_BATCH (default per model — 128 for resnet50, 4096 for
+lenet), BENCH_ITERS (default 60 — the whole
+multi-step loop is ONE device dispatch, and through the remote-chip tunnel a
+dispatch costs ~100ms, so a short window under-reports the device rate; 60
+steps puts the dispatch under 5% of the measurement),
 BENCH_MODEL (resnet50 | lenet), BENCH_IMAGE (default 224; resnet50 only —
 LeNet is fixed 28×28 MNIST), BENCH_DTYPE (default "mixed": bf16 compute /
 f32 params — the TPU-native policy; "float32" for the f32 baseline).
@@ -49,15 +53,13 @@ def _bench_resnet50(batch: int, iters: int, image: int, dtype: str):
     return batch * iters / dt, "resnet50_imagenet_train_images_per_sec"
 
 
-def _bench_bert(batch: int, iters: int, dtype: str):
+def _bench_bert(batch: int, iters: int, dtype: str, seq: int):
     """BERT-base MLM train step, seq 512 — the attention-bound workload where
     the Pallas flash platform helper carries the win (BENCH_MODEL=bert)."""
     import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.bert import BertConfig, BertModel
-
-    seq = int(os.environ.get("BENCH_SEQ", "512"))
     # default dropout=0.1 — the production fine-tune config; the Pallas flash
     # helper handles attention-prob dropout IN-KERNEL since round 3, so the
     # fast path no longer needs dropout disabled
@@ -157,22 +159,34 @@ def _bench_attention(iters: int):
 
 
 def main() -> None:
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    iters = int(os.environ.get("BENCH_ITERS", "60"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     model = os.environ.get("BENCH_MODEL", "resnet50")
     dtype = os.environ.get("BENCH_DTYPE", "mixed")
 
+    # Per-model default batch: the timed window must dwarf the ~100ms tunnel
+    # dispatch or the number measures jitter, not the device (LeNet at
+    # batch 128 × 60 steps is ~80ms of device work — pure noise). 4096 puts
+    # LeNet's window at ~2.5s; ResNet's 128×60 is already ~2.8s.
+    default_batch = {"lenet": 4096}.get(model, 128)
+    batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
+
     if model == "lenet":
         value, metric = _bench_lenet(batch, iters)
+        method = f"b{batch}i{iters}"
     elif model == "attention":
         value, metric = _bench_attention(iters)
+        method = f"i{iters}"
     elif model == "bert":
-        value, metric = _bench_bert(int(os.environ.get("BENCH_BERT_BATCH", "16")),
-                                    iters, dtype)
+        bb = int(os.environ.get("BENCH_BERT_BATCH", "16"))
+        seq = int(os.environ.get("BENCH_SEQ", "512"))
+        value, metric = _bench_bert(bb, iters, dtype, seq)
+        method = f"b{bb}s{seq}i{iters}{'' if dtype == 'mixed' else dtype}"
     else:
         value, metric = _bench_resnet50(batch, iters, image, dtype)
+        method = f"b{batch}x{image}i{iters}{'' if dtype == 'mixed' else dtype}"
 
+    record = os.environ.get("BENCH_RECORD", "1") != "0"
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.json")
     hist = {}
     if os.path.exists(hist_path):
@@ -187,18 +201,26 @@ def main() -> None:
     if isinstance(entry, dict):
         watermark = entry.get("watermark", 0.0)
         runs = entry.get("runs", [])
+        # A watermark is only comparable within one measurement methodology
+        # (batch/seq/iters/dtype). When the method changes, the old series
+        # would report nonsense ratios (e.g. a window-size change once read
+        # as a 60× "speedup"), so start a fresh series — the old one stays
+        # in git history.
+        if entry.get("method") != method:
+            watermark, runs = 0.0, []
     else:  # legacy scalar entry
         watermark = float(entry) if entry else 0.0
         runs = []
     vs_baseline = value / watermark if watermark else 1.0
     nd = 3 if value < 100 else 1  # keep ratio metrics' ratchet sensitive
-    runs = (runs + [round(value, nd)])[-20:]
-    try:
-        hist[metric] = {"watermark": round(max(watermark, value), nd),
-                        "runs": runs}
-        json.dump(hist, open(hist_path, "w"), indent=1)
-    except Exception:
-        pass
+    if record:
+        runs = (runs + [round(value, nd)])[-20:]
+        try:
+            hist[metric] = {"watermark": round(max(watermark, value), nd),
+                            "runs": runs, "method": method}
+            json.dump(hist, open(hist_path, "w"), indent=1)
+        except Exception:
+            pass
 
     unit = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
             "lenet5_mnist_train_images_per_sec": "images/sec/chip",

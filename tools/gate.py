@@ -61,8 +61,10 @@ def has_tpu() -> bool:
 
 def bench_smoke() -> bool:
     print("== gate: bench smoke (lenet, 3 iters) ==", flush=True)
+    # BENCH_RECORD=0: a 3-iter smoke is a liveness probe, not a measurement —
+    # it must not touch the BENCH_HISTORY ratchet series
     env = dict(os.environ, BENCH_MODEL="lenet", BENCH_ITERS="3",
-               BENCH_BATCH="64")
+               BENCH_BATCH="64", BENCH_RECORD="0")
     try:
         proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
                               capture_output=True, text=True, timeout=1800)
