@@ -533,6 +533,9 @@ class SameDiff:
         self._step = 0
         self._jit_cache: Dict[Any, Any] = {}
         self._grad_requested = False
+        # graph IO signature, populated by the import layer (imports/ir.py)
+        self.graph_inputs: List[str] = []
+        self.graph_outputs: List[str] = []
 
     # ------------------------------------------------------------- factories
     @staticmethod

@@ -198,6 +198,13 @@ class NormalizerStandardize:
         self.std = feats.std(axis=axes) + 1e-8
 
     def transform(self, ds: DataSet) -> None:
+        if (getattr(ds.features, "dtype", None) == np.uint8
+                and np.ndim(self.mean) == 1
+                and ds.features.shape[-1] == np.shape(self.mean)[0]):
+            from deeplearning4j_tpu.native_ops.pixops import u8_standardize
+
+            ds.features = u8_standardize(ds.features, self.mean, self.std)
+            return
         ds.features = (ds.features - self.mean) / self.std
 
     def revert(self, ds: DataSet) -> None:
@@ -226,6 +233,13 @@ class NormalizerMinMaxScaler:
 
     def transform(self, ds: DataSet) -> None:
         rng = max(self.fmax - self.fmin, 1e-8)
+        if getattr(ds.features, "dtype", None) == np.uint8:
+            from deeplearning4j_tpu.native_ops.pixops import u8_normalize
+
+            scale = (self.hi - self.lo) / rng
+            ds.features = u8_normalize(ds.features, scale,
+                                       self.lo - self.fmin * scale)
+            return
         ds.features = (ds.features - self.fmin) / rng * (self.hi - self.lo) + self.lo
 
     def state(self):
@@ -246,6 +260,13 @@ class ImagePreProcessingScaler:
         pass
 
     def transform(self, ds: DataSet) -> None:
+        if getattr(ds.features, "dtype", None) == np.uint8:
+            # uint8 batches take the native pixel loop (native/pixops.cpp)
+            from deeplearning4j_tpu.native_ops.pixops import u8_normalize
+
+            ds.features = u8_normalize(
+                ds.features, (self.hi - self.lo) / self.max_pixel, self.lo)
+            return
         ds.features = ds.features / self.max_pixel * (self.hi - self.lo) + self.lo
 
     def state(self):

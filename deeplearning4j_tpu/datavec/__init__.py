@@ -12,6 +12,20 @@ from deeplearning4j_tpu.datavec.transform import (
     Reducer,
     records_to_dataset,
 )
+from deeplearning4j_tpu.datavec.readers import (
+    LineRecordReader,
+    RegexLineRecordReader,
+    JacksonLineRecordReader,
+    SVMLightRecordReader,
+    CSVSequenceRecordReader,
+    ParallelTransformExecutor,
+)
+from deeplearning4j_tpu.datavec.columnar import (
+    ColumnarBatch,
+    to_columnar,
+    save_columnar,
+    load_columnar,
+)
 from deeplearning4j_tpu.datavec.analysis import (
     Join,
     convert_to_sequence,

@@ -1,0 +1,90 @@
+"""Columnar batch interchange — the datavec-arrow ArrowConverter ROLE.
+
+Reference parity: datavec-arrow ArrowConverter.java converts records ↔
+columnar Arrow batches and persists them so downstream systems read columns
+zero-copy. This module fulfils the same role for the TPU build: records ↔
+a column-major numpy batch with a compact persisted form.
+
+DIVERGENCE (documented, not hidden): the on-disk format is NOT Arrow IPC —
+producing real Arrow files without the pyarrow/Arrow C++ stack would mean
+reimplementing flatbuffers framing for no consumer in this environment.
+The format here is `npz` (numpy's standard container), readable by any
+numpy — the interchange property the reference actually uses Arrow for.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.transform import Schema
+
+_COL_DTYPES = {
+    "integer": np.int32, "long": np.int64, "double": np.float64,
+    "float": np.float32, "string": object, "categorical": object,
+    "boolean": np.bool_, "time": np.int64,
+}
+
+
+class ColumnarBatch:
+    """Column-major record batch (ArrowWritableRecordBatch analog):
+    one numpy array per column, zero-copy column access."""
+
+    def __init__(self, schema: Schema, columns: Dict[str, np.ndarray]):
+        self.schema = schema
+        self.columns = columns
+        sizes = {len(v) for v in columns.values()}
+        if len(sizes) > 1:
+            raise ValueError(f"ragged columns: {sizes}")
+        self.num_rows = sizes.pop() if sizes else 0
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def to_records(self) -> List[List[Any]]:
+        names = [c["name"] for c in self.schema.columns]
+        cols = [self.columns[n] for n in names]
+        return [[c[i].item() if hasattr(c[i], "item") else c[i]
+                 for c in cols] for i in range(self.num_rows)]
+
+    def to_matrix(self) -> np.ndarray:
+        """All-numeric columns → (rows, cols) float32 matrix (the
+        RecordReaderDataSetIterator bridge)."""
+        names = [c["name"] for c in self.schema.columns]
+        return np.stack([np.asarray(self.columns[n], np.float32)
+                         for n in names], axis=1)
+
+
+def to_columnar(records: List[List[Any]], schema: Schema) -> ColumnarBatch:
+    """ArrowConverter.toArrow analog: row records → ColumnarBatch."""
+    names = [c["name"] for c in schema.columns]
+    types = [c["type"] for c in schema.columns]
+    cols = {}
+    for j, (name, t) in enumerate(zip(names, types)):
+        dt = _COL_DTYPES.get(t, object)
+        cols[name] = np.asarray([r[j] for r in records], dtype=dt)
+    return ColumnarBatch(schema, cols)
+
+
+def save_columnar(batch: ColumnarBatch, path: str) -> None:
+    """Persist (ArrowConverter write analog; npz container, see module
+    docstring for the format divergence)."""
+    meta = json.dumps(batch.schema.to_dict())
+    arrays = {f"col_{k}": (v.astype("U") if v.dtype == object else v)
+              for k, v in batch.columns.items()}
+    np.savez(path, __schema__=np.asarray(meta), **arrays)
+
+
+def load_columnar(path: str) -> ColumnarBatch:
+    with np.load(path if path.endswith(".npz") else path + ".npz",
+                 allow_pickle=False) as z:
+        schema = Schema.from_dict(json.loads(str(z["__schema__"])))
+        cols = {}
+        for c in schema.columns:
+            arr = z[f"col_{c['name']}"]
+            if arr.dtype.kind == "U" and _COL_DTYPES.get(c["type"]) is object:
+                arr = arr.astype(object)
+            cols[c["name"]] = arr
+    return ColumnarBatch(schema, cols)

@@ -56,6 +56,25 @@ class Schema:
     def num_columns(self) -> int:
         return len(self.columns)
 
+    # Schema.toJson/fromJson parity
+    def to_dict(self) -> Dict[str, Any]:
+        return {"columns": [dict(c) for c in self.columns]}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Schema":
+        return Schema([dict(c) for c in d["columns"]])
+
+    def to_json(self) -> str:
+        import json
+
+        return json.dumps(self.to_dict())
+
+    @staticmethod
+    def from_json(s: str) -> "Schema":
+        import json
+
+        return Schema.from_dict(json.loads(s))
+
     class Builder:
         def __init__(self):
             self._cols: List[Dict[str, Any]] = []

@@ -12,3 +12,4 @@ from deeplearning4j_tpu.imports.onnx_import import (
     import_onnx,
     register_onnx_op,
 )
+from deeplearning4j_tpu.imports.graph_runner import GraphRunner

@@ -101,4 +101,15 @@ class IRImporter:
                 produced[oname] = o
             # the node's own name also resolves (TF addressing convention)
             produced.setdefault(node.name, outs[0])
+        # record the graph IO signature (GraphRunner uses it for default
+        # fetches; TF GraphDefs carry no explicit outputs → terminal nodes)
+        outs = list(ir.outputs)
+        if not outs:
+            consumed = {i for node in ir.nodes for i in node.inputs}
+            # only nodes that actually produced a value — rules may return
+            # None for utility nodes (NoOp/init), which never materialize
+            outs = [n.name for n in ir.nodes
+                    if n.name not in consumed and n.name in produced]
+        sd.graph_inputs = [n for n, _ in ir.inputs]
+        sd.graph_outputs = outs
         return sd

@@ -644,3 +644,157 @@ def _gap1d(cfg, weights):
 @KerasLayerMapper.register("GlobalMaxPooling1D")
 def _gmp1d(cfg, weights):
     return C.GlobalPoolingLayer(pooling_type="max"), {}
+
+
+# ---------------------------------------------------------------------------
+# Mapper table, round 3 continued: padding/cropping/upsampling, 1-D pooling,
+# Conv3DTranspose, RepeatVector, Masking, TimeDistributed, noise dropouts.
+# ---------------------------------------------------------------------------
+
+
+@KerasLayerMapper.register("ZeroPadding1D")
+def _zeropad1d(cfg, weights):
+    return C.ZeroPadding1DLayer(padding=_pair(cfg.get("padding", 1))), {}
+
+
+@KerasLayerMapper.register("ZeroPadding2D")
+def _zeropad2d(cfg, weights):
+    p = cfg.get("padding", 1)
+    if isinstance(p, (list, tuple)):
+        (t, b), (l, r) = (_pair(p[0]), _pair(p[1]))
+    else:
+        t = b = l = r = int(p)
+    return C.ZeroPaddingLayer(padding=(t, b, l, r)), {}
+
+
+@KerasLayerMapper.register("ZeroPadding3D")
+def _zeropad3d(cfg, weights):
+    p = cfg.get("padding", 1)
+    if isinstance(p, (list, tuple)):
+        (a, b), (c, d), (e, f) = (_pair(p[0]), _pair(p[1]), _pair(p[2]))
+    else:
+        a = b = c = d = e = f = int(p)
+    return C.ZeroPadding3DLayer(padding=(a, b, c, d, e, f)), {}
+
+
+@KerasLayerMapper.register("Cropping1D")
+def _crop1d(cfg, weights):
+    return C.Cropping1D(cropping=_pair(cfg.get("cropping", 1))), {}
+
+
+@KerasLayerMapper.register("Cropping2D")
+def _crop2d(cfg, weights):
+    p = cfg.get("cropping", 1)
+    if isinstance(p, (list, tuple)):
+        (t, b), (l, r) = (_pair(p[0]), _pair(p[1]))
+    else:
+        t = b = l = r = int(p)
+    return C.Cropping2D(cropping=(t, b, l, r)), {}
+
+
+@KerasLayerMapper.register("Cropping3D")
+def _crop3d(cfg, weights):
+    p = cfg.get("cropping", 1)
+    if isinstance(p, (list, tuple)):
+        (a, b), (c, d), (e, f) = (_pair(p[0]), _pair(p[1]), _pair(p[2]))
+    else:
+        a = b = c = d = e = f = int(p)
+    return C.Cropping3D(cropping=(a, b, c, d, e, f)), {}
+
+
+@KerasLayerMapper.register("UpSampling1D")
+def _upsampling1d(cfg, weights):
+    return C.Upsampling1D(size=int(cfg.get("size", 2))), {}
+
+
+@KerasLayerMapper.register("UpSampling3D")
+def _upsampling3d(cfg, weights):
+    return C.Upsampling3D(size=_triple(cfg.get("size", 2))), {}
+
+
+@KerasLayerMapper.register("MaxPooling1D")
+def _maxpool1d(cfg, weights):
+    ps = cfg.get("pool_size", 2)
+    ps = int(ps[0] if isinstance(ps, (list, tuple)) else ps)
+    st = cfg.get("strides") or ps
+    st = int(st[0] if isinstance(st, (list, tuple)) else st)
+    return C.Subsampling1DLayer(
+        kernel=ps, stride=st, pooling_type="max",
+        convolution_mode=cfg.get("padding", "valid")), {}
+
+
+@KerasLayerMapper.register("AveragePooling1D")
+def _avgpool1d(cfg, weights):
+    ps = cfg.get("pool_size", 2)
+    ps = int(ps[0] if isinstance(ps, (list, tuple)) else ps)
+    st = cfg.get("strides") or ps
+    st = int(st[0] if isinstance(st, (list, tuple)) else st)
+    return C.Subsampling1DLayer(
+        kernel=ps, stride=st, pooling_type="avg",
+        convolution_mode=cfg.get("padding", "valid")), {}
+
+
+@KerasLayerMapper.register("GlobalAveragePooling3D")
+def _gap3d(cfg, weights):
+    return C.GlobalPoolingLayer(pooling_type="avg"), {}
+
+
+@KerasLayerMapper.register("GlobalMaxPooling3D")
+def _gmp3d(cfg, weights):
+    return C.GlobalPoolingLayer(pooling_type="max"), {}
+
+
+@KerasLayerMapper.register("Conv3DTranspose")
+def _deconv3d(cfg, weights):
+    w = weights[0]  # keras: (kd, kh, kw, out, in) → ours: (kd, kh, kw, in, out)
+    lc = C.Deconvolution3D(
+        n_in=w.shape[4], n_out=w.shape[3],
+        kernel=tuple(int(x) for x in cfg["kernel_size"]),
+        stride=tuple(int(x) for x in _triple(cfg.get("strides", (1, 1, 1)))),
+        convolution_mode=cfg.get("padding", "valid"),
+        activation=_act(cfg))
+    p = {"W": w.transpose(0, 1, 2, 4, 3)}
+    if cfg.get("use_bias", True) and len(weights) > 1:
+        p["b"] = weights[1]
+    return lc, p
+
+
+@KerasLayerMapper.register("RepeatVector")
+def _repeat_vector(cfg, weights):
+    return C.RepeatVector(n=int(cfg["n"])), {}
+
+
+@KerasLayerMapper.register("Masking")
+def _masking(cfg, weights):
+    # keras Masking emits a downstream mask for steps != mask_value; our
+    # MaskZeroLayer derives the same mask — wrap an identity layer so the
+    # mask propagates through the sequential stack
+    return C.MaskZeroLayer(
+        underlying=C.ActivationLayer(activation="identity"),
+        mask_value=float(cfg.get("mask_value", 0.0))), {"inner": {}}
+
+
+@KerasLayerMapper.register("TimeDistributed")
+def _time_distributed(cfg, weights):
+    inner = cfg["layer"]
+    if inner["class_name"] != "Dense":
+        raise NotImplementedError(
+            f"TimeDistributed({inner['class_name']}) import — only Dense is "
+            "time-broadcastable in a sequential stack")
+    # our DenseLayer broadcasts over (N, T, F) natively
+    return KerasLayerMapper.MAPPERS["Dense"](inner["config"], weights)
+
+
+@KerasLayerMapper.register("SpatialDropout1D")
+@KerasLayerMapper.register("SpatialDropout3D")
+@KerasLayerMapper.register("AlphaDropout")
+def _spatial_dropout_1d3d(cfg, weights):
+    return C.DropoutLayer(rate=float(cfg.get("rate", 0.5))), {}
+
+
+@KerasLayerMapper.register("GaussianNoise")
+def _gaussian_noise(cfg, weights):
+    # train-time-only additive noise: identity at inference (import targets
+    # inference parity; DL4J maps this to its GaussianNoise IDropout the
+    # same way)
+    return C.ActivationLayer(activation="identity"), {}

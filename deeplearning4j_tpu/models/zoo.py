@@ -580,26 +580,13 @@ class TinyYOLO(ZooModel):
     def yolo_loss(self, pred, target, *, lambda_coord: float = 5.0,
                   lambda_noobj: float = 0.5):
         """YOLOv2-style sum-squared loss (Yolo2OutputLayer.computeScore
-        analog). pred: (N, H, W, B*(5+C)) raw head output; target:
+        analog) — delegates to THE shared implementation (ops/losses.yolo2).
+        pred: (N, H, W, B*(5+C)) raw head output; target:
         (N, H, W, B, 5+C) with [x, y, w, h, obj, class-onehot...]."""
-        import jax
-        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops.losses import yolo2
 
-        n, gh, gw, _ = pred.shape
-        bx = self.num_boxes
-        p = pred.reshape(n, gh, gw, bx, 5 + self.num_classes)
-        xy = jax.nn.sigmoid(p[..., 0:2])
-        wh = p[..., 2:4]
-        obj = jax.nn.sigmoid(p[..., 4])
-        cls = jax.nn.softmax(p[..., 5:], axis=-1)
-        t_xy, t_wh = target[..., 0:2], target[..., 2:4]
-        t_obj, t_cls = target[..., 4], target[..., 5:]
-        coord = jnp.sum(t_obj[..., None] * ((xy - t_xy) ** 2 + (wh - t_wh) ** 2))
-        obj_term = jnp.sum(t_obj * (obj - 1.0) ** 2)
-        noobj = jnp.sum((1 - t_obj) * obj ** 2)
-        cls_term = jnp.sum(t_obj[..., None] * (cls - t_cls) ** 2)
-        return (lambda_coord * coord + obj_term + lambda_noobj * noobj
-                + cls_term) / n
+        return yolo2(pred, target, None, lambda_coord=lambda_coord,
+                     lambda_noobj=lambda_noobj)
 
 
 class InceptionResNetV1(ZooModel):

@@ -154,13 +154,16 @@ class LayerConf:
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "LayerConf":
+        def tuplify(v):
+            return tuple(tuplify(x) for x in v) if isinstance(v, list) else v
+
         d = dict(d)
         cls = LAYER_TYPES[d.pop("@type")]
         for k, v in list(d.items()):
             if isinstance(v, dict) and "__updater__" in v:
                 d[k] = Updater.from_dict(v["__updater__"])
             elif isinstance(v, list):
-                d[k] = tuple(v)
+                d[k] = tuplify(v)
         return cls(**d)
 
 
@@ -701,6 +704,337 @@ class VariationalAutoencoder(LayerConf):
         return True
 
 
+@dataclasses.dataclass(frozen=True)
+class ZeroPadding1DLayer(LayerConf):
+    """conf/layers/ZeroPadding1DLayer.java: pad the time axis of (N, T, C)."""
+
+    padding: Tuple[int, int] = (1, 1)
+
+    def output_type(self, itype):
+        t = itype.timesteps
+        p = _pair(self.padding)
+        return InputType.recurrent(itype.size, t + p[0] + p[1] if t and t > 0 else t)
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroPaddingLayer(LayerConf):
+    """conf/layers/ZeroPaddingLayer.java: spatial zero-pad, NHWC.
+    ``padding`` = (top, bottom, left, right)."""
+
+    padding: Tuple[int, int, int, int] = (1, 1, 1, 1)
+
+    def output_type(self, itype):
+        t, b, l, r = self.padding
+        return InputType.convolutional(itype.height + t + b,
+                                       itype.width + l + r, itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroPadding3DLayer(LayerConf):
+    """conf/layers/ZeroPadding3DLayer.java: NDHWC zero-pad.
+    ``padding`` = (d_lo, d_hi, h_lo, h_hi, w_lo, w_hi)."""
+
+    padding: Tuple[int, int, int, int, int, int] = (1, 1, 1, 1, 1, 1)
+
+    def output_type(self, itype):
+        p = self.padding
+        return InputType.convolutional3d(
+            itype.depth + p[0] + p[1], itype.height + p[2] + p[3],
+            itype.width + p[4] + p[5], itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class Cropping1D(LayerConf):
+    """conf/layers/convolutional/Cropping1D.java: crop the time axis."""
+
+    cropping: Tuple[int, int] = (1, 1)
+
+    def output_type(self, itype):
+        t = itype.timesteps
+        c = _pair(self.cropping)
+        return InputType.recurrent(itype.size, t - c[0] - c[1] if t and t > 0 else t)
+
+
+@dataclasses.dataclass(frozen=True)
+class Cropping2D(LayerConf):
+    """conf/layers/convolutional/Cropping2D.java: spatial crop, NHWC.
+    ``cropping`` = (top, bottom, left, right)."""
+
+    cropping: Tuple[int, int, int, int] = (1, 1, 1, 1)
+
+    def output_type(self, itype):
+        t, b, l, r = self.cropping
+        return InputType.convolutional(itype.height - t - b,
+                                       itype.width - l - r, itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class Cropping3D(LayerConf):
+    """conf/layers/convolutional/Cropping3D.java: NDHWC crop.
+    ``cropping`` = (d_lo, d_hi, h_lo, h_hi, w_lo, w_hi)."""
+
+    cropping: Tuple[int, int, int, int, int, int] = (1, 1, 1, 1, 1, 1)
+
+    def output_type(self, itype):
+        c = self.cropping
+        return InputType.convolutional3d(
+            itype.depth - c[0] - c[1], itype.height - c[2] - c[3],
+            itype.width - c[4] - c[5], itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class Upsampling1D(LayerConf):
+    """conf/layers/Upsampling1D.java: repeat each timestep ``size`` times."""
+
+    size: int = 2
+
+    def output_type(self, itype):
+        t = itype.timesteps
+        return InputType.recurrent(itype.size, t * self.size if t and t > 0 else t)
+
+
+@dataclasses.dataclass(frozen=True)
+class Upsampling3D(LayerConf):
+    """conf/layers/Upsampling3D.java: nearest-neighbour ×size, NDHWC."""
+
+    size: Tuple[int, int, int] = (2, 2, 2)
+
+    def output_type(self, itype):
+        s = self.size
+        return InputType.convolutional3d(itype.depth * s[0], itype.height * s[1],
+                                         itype.width * s[2], itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class Subsampling1DLayer(LayerConf):
+    """conf/layers/Subsampling1DLayer.java: temporal pooling over (N, T, C)."""
+
+    kernel: int = 2
+    stride: int = 2
+    pooling_type: str = "max"  # max | avg
+    convolution_mode: str = "valid"
+
+    def output_type(self, itype):
+        t = itype.timesteps
+        if t and t > 0:
+            if self.convolution_mode == "same":
+                t = -(-t // self.stride)
+            else:
+                t = (t - self.kernel) // self.stride + 1
+        return InputType.recurrent(itype.size, t)
+
+
+@dataclasses.dataclass(frozen=True)
+class Deconvolution3D(LayerConf):
+    """conf/layers/Deconvolution3D.java: transposed volumetric conv, NDHWC."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (2, 2, 2)
+    convolution_mode: str = "valid"
+
+    def output_type(self, itype):
+        def out(sz, k, s):
+            return sz * s if self.convolution_mode == "same" else (sz - 1) * s + k
+
+        k, s = self.kernel, self.stride
+        return InputType.convolutional3d(
+            out(itype.depth, k[0], s[0]), out(itype.height, k[1], s[1]),
+            out(itype.width, k[2], s[2]), self.n_out)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class CnnLossLayer(LayerConf):
+    """conf/layers/CnnLossLayer.java: per-position 2-D loss (segmentation).
+    No params; activation applied; labels shaped (N, H, W, C)."""
+
+    loss: str = "mcxent"
+
+
+@dataclasses.dataclass(frozen=True)
+class RnnLossLayer(LayerConf):
+    """conf/layers/RnnLossLayer.java: per-timestep loss over (N, T, C)."""
+
+    loss: str = "mcxent"
+
+
+@dataclasses.dataclass(frozen=True)
+class MaskLayer(LayerConf):
+    """conf/layers/util/MaskLayer.java: apply the current mask to the
+    activations (zero masked timesteps), pass everything else through."""
+
+
+@dataclasses.dataclass(frozen=True)
+class MaskZeroLayer(LayerConf):
+    """conf/layers/recurrent/MaskZeroLayer.java: derive a timestep mask from
+    the input (steps where ALL features == mask_value are masked) before
+    running the wrapped recurrent layer."""
+
+    underlying: Optional[Any] = None  # LayerConf
+    mask_value: float = 0.0
+
+    def inner(self) -> "LayerConf":
+        u = self.underlying
+        return LayerConf.from_dict(u) if isinstance(u, dict) else u
+
+    def output_type(self, itype):
+        return self.inner().output_type(itype)
+
+    def has_params(self):
+        return self.inner().has_params()
+
+    def to_dict(self):
+        d = super().to_dict()
+        if isinstance(d.get("underlying"), LayerConf):
+            d["underlying"] = d["underlying"].to_dict()
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class RepeatVector(LayerConf):
+    """conf/layers/misc/RepeatVector.java: (N, F) -> (N, n, F)."""
+
+    n: int = 1
+
+    def output_type(self, itype):
+        return InputType.recurrent(itype.flat_size(), self.n)
+
+
+@dataclasses.dataclass(frozen=True)
+class ElementWiseMultiplicationLayer(LayerConf):
+    """conf/layers/misc/ElementWiseMultiplicationLayer.java:
+    out = act(x ⊙ w + b) with a learned per-feature scale."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out or itype.flat_size())
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class FrozenLayerWithBackprop(LayerConf):
+    """conf/layers/misc/FrozenLayerWithBackprop.java: wrapped layer gets NO
+    parameter updates but still backprops gradients to earlier layers
+    (FrozenLayer, by contrast, also blocks the flow — that variant lives in
+    nn/transfer.py as the TransferLearning freeze mechanism)."""
+
+    underlying: Optional[Any] = None
+
+    def inner(self) -> "LayerConf":
+        u = self.underlying
+        return LayerConf.from_dict(u) if isinstance(u, dict) else u
+
+    def output_type(self, itype):
+        return self.inner().output_type(itype)
+
+    def has_params(self):
+        return self.inner().has_params()
+
+    def to_dict(self):
+        d = super().to_dict()
+        if isinstance(d.get("underlying"), LayerConf):
+            d["underlying"] = d["underlying"].to_dict()
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class CenterLossOutputLayer(DenseLayer):
+    """conf/layers/CenterLossOutputLayer.java: softmax classification plus
+    a decoupled center loss — λ·½‖f − sg(c_y)‖² pulls FEATURES toward their
+    class center, α·½‖sg(f) − c_y‖² pulls CENTERS toward the batch features
+    (its gradient α(c_y − f̄) is the reference's moving-average center
+    update c ← c − α(c − f̄), realized through the optimizer)."""
+
+    loss: str = "mcxent"
+    alpha: float = 0.05     # center pull rate (reference `alpha`)
+    lambda_: float = 2e-4   # feature-pull weight (reference `lambda`)
+
+
+@dataclasses.dataclass(frozen=True)
+class Yolo2OutputLayer(LayerConf):
+    """conf/layers/objdetect/Yolo2OutputLayer.java: YOLOv2 anchor-box output.
+    Forward is identity (activations are decoded inside the loss); the loss
+    is the multi-part sum-squared objective (models/zoo.py TinyYOLO
+    yolo_loss). Labels: (N, H, W, B, 5 + C) matching the prediction grid."""
+
+    anchors: Tuple[Tuple[float, float], ...] = ()
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+    loss: str = "yolo2"
+
+    def loss_fn(self):
+        """Bind THIS conf's lambdas/anchors into the shared yolo2 loss —
+        networks check for a conf-provided loss_fn before get_loss(name)."""
+        import functools
+
+        from deeplearning4j_tpu.ops.losses import yolo2
+
+        return functools.partial(
+            yolo2, lambda_coord=self.lambda_coord,
+            lambda_noobj=self.lambda_noobj,
+            anchors=[list(a) for a in self.anchors] or None)
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["anchors"] = [list(a) for a in self.anchors]
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class PrimaryCapsules(LayerConf):
+    """conf/layers/PrimaryCapsules.java (CapsNet): conv into
+    (N, capsules, capsule_dim) with squash nonlinearity."""
+
+    capsules: int = 8          # number of capsule CHANNELS (per spatial pos)
+    capsule_dim: int = 8
+    kernel: Tuple[int, int] = (9, 9)
+    stride: Tuple[int, int] = (2, 2)
+
+    def output_type(self, itype):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        oh = (itype.height - kh) // sh + 1
+        ow = (itype.width - kw) // sw + 1
+        return InputType.recurrent(self.capsule_dim, oh * ow * self.capsules)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class CapsuleLayer(LayerConf):
+    """conf/layers/CapsuleLayer.java: dynamic-routing capsules.
+    Input (N, in_caps, in_dim) -> (N, capsules, capsule_dim)."""
+
+    capsules: int = 10
+    capsule_dim: int = 16
+    routings: int = 3
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.capsule_dim, self.capsules)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class CapsuleStrengthLayer(LayerConf):
+    """conf/layers/CapsuleStrengthLayer.java: ‖capsule‖₂ per capsule —
+    (N, caps, dim) -> (N, caps)."""
+
+    def output_type(self, itype):
+        return InputType.feed_forward(itype.timesteps if itype.timesteps > 0
+                                      else itype.size)
+
+
 # ---------------------------------------------------------------------------
 # Preprocessors (conf/preprocessor/*) — shape adapters between layers
 # ---------------------------------------------------------------------------
@@ -811,6 +1145,28 @@ LAYER_TYPES = {
         LocallyConnected1D,
         PReLULayer,
         VariationalAutoencoder,
+        ZeroPadding1DLayer,
+        ZeroPaddingLayer,
+        ZeroPadding3DLayer,
+        Cropping1D,
+        Cropping2D,
+        Cropping3D,
+        Upsampling1D,
+        Upsampling3D,
+        Subsampling1DLayer,
+        Deconvolution3D,
+        CnnLossLayer,
+        RnnLossLayer,
+        MaskLayer,
+        MaskZeroLayer,
+        RepeatVector,
+        ElementWiseMultiplicationLayer,
+        FrozenLayerWithBackprop,
+        CenterLossOutputLayer,
+        Yolo2OutputLayer,
+        PrimaryCapsules,
+        CapsuleLayer,
+        CapsuleStrengthLayer,
     ]
 }
 

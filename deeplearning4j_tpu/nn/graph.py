@@ -625,7 +625,10 @@ class ComputationGraph:
         total = jnp.zeros(())
         for name in self._output_layers:
             node = self._node(name)
-            loss_fn = get_loss(node.layer.loss)
+            if hasattr(node.layer, "loss_fn"):
+                loss_fn = node.layer.loss_fn()  # conf-bound hyperparams (YOLO2)
+            else:
+                loss_fn = get_loss(node.layer.loss)
             lm = None if lmasks is None else lmasks.get(name)
             total = total + loss_fn(acts[name], labels[name], lm)
         return total

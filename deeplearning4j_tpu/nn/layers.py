@@ -262,7 +262,10 @@ class GlobalPoolingLayerImpl(Layer):
 
     def apply(self, params, x, state, *, train, rng, mask=None):
         pt = self.lc.pooling_type
-        if x.ndim == 4:  # NHWC
+        if x.ndim == 5:  # NDHWC
+            axes = (1, 2, 3)
+            m = None
+        elif x.ndim == 4:  # NHWC
             axes = (1, 2)
             m = None
         else:  # (N, T, F)
@@ -932,6 +935,298 @@ class VariationalAutoencoderImpl(Layer):
         return jnp.mean(rec + kl)
 
 
+class ZeroPadding1DLayerImpl(Layer):
+    """layers/convolution/ZeroPadding1DLayer.java: pad time axis of (N,T,C)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        a, b = C._pair(self.lc.padding)
+        y = jnp.pad(x, ((0, 0), (a, b), (0, 0)))
+        if mask is not None:
+            mask = jnp.pad(mask, ((0, 0), (a, b)))
+        return y, state, mask
+
+
+class ZeroPaddingLayerImpl(Layer):
+    """layers/convolution/ZeroPaddingLayer.java: NHWC spatial pad."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        t, b, l, r = self.lc.padding
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state, mask
+
+
+class ZeroPadding3DLayerImpl(Layer):
+    """layers/convolution/ZeroPadding3DLayer.java: NDHWC pad."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        p = self.lc.padding
+        return jnp.pad(x, ((0, 0), (p[0], p[1]), (p[2], p[3]),
+                           (p[4], p[5]), (0, 0))), state, mask
+
+
+class Cropping1DImpl(Layer):
+    """layers/convolution/Cropping1DLayer.java."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        a, b = C._pair(self.lc.cropping)
+        t = x.shape[1]
+        y = x[:, a:t - b, :]
+        if mask is not None:
+            mask = mask[:, a:t - b]
+        return y, state, mask
+
+
+class Cropping2DImpl(Layer):
+    """layers/convolution/Cropping2DLayer.java."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        t, b, l, r = self.lc.cropping
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b, l:w - r, :], state, mask
+
+
+class Cropping3DImpl(Layer):
+    """layers/convolution/Cropping3DLayer.java."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        c = self.lc.cropping
+        d, h, w = x.shape[1], x.shape[2], x.shape[3]
+        return x[:, c[0]:d - c[1], c[2]:h - c[3], c[4]:w - c[5], :], state, mask
+
+
+class Upsampling1DImpl(Layer):
+    """layers/convolution/upsampling/Upsampling1D.java: repeat timesteps."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        y = jnp.repeat(x, self.lc.size, axis=1)
+        if mask is not None:
+            mask = jnp.repeat(mask, self.lc.size, axis=1)
+        return y, state, mask
+
+
+class Upsampling3DImpl(Layer):
+    """layers/convolution/upsampling/Upsampling3D.java: NN-upsample NDHWC."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        s = self.lc.size
+        y = jnp.repeat(jnp.repeat(jnp.repeat(x, s[0], axis=1), s[1], axis=2),
+                       s[2], axis=3)
+        return y, state, mask
+
+
+class Subsampling1DLayerImpl(Layer):
+    """layers/convolution/subsampling/Subsampling1DLayer.java: temporal pool."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        k, s = int(lc.kernel), int(lc.stride)
+        pad = "SAME" if lc.convolution_mode == "same" else "VALID"
+        if lc.pooling_type == "max":
+            y = jax.lax.reduce_window(
+                x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+                jax.lax.max, (1, k, 1), (1, s, 1), pad)
+        else:
+            ones = jnp.ones_like(x)
+            tot = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, k, 1), (1, s, 1), pad)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, (1, k, 1), (1, s, 1), pad)
+            y = tot / cnt
+        if mask is not None:
+            mask = jax.lax.reduce_window(
+                mask.astype(x.dtype), 0.0, jax.lax.max, (1, k), (1, s), pad)
+        return y, state, mask
+
+
+class Deconvolution3DImpl(Layer):
+    """layers/convolution/Deconvolution3DLayer.java: transposed 3-D conv."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        kd, kh, kw = lc.kernel
+        p = {"W": init_weights(key, (kd, kh, kw, lc.n_in, lc.n_out),
+                               self.winit, dtype=self.dtype)}
+        p["b"] = jnp.zeros((lc.n_out,), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        pad = "SAME" if lc.convolution_mode == "same" else "VALID"
+        y = jax.lax.conv_transpose(
+            x, params["W"], strides=tuple(lc.stride), padding=pad,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        y = y + params["b"]
+        return self.activation(y), state, mask
+
+
+class CnnLossLayerImpl(Layer):
+    """layers/convolution/CnnLossLayer.java: activation only — per-position
+    loss applied by the network against (N, H, W, C) labels."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return self.activation(x), state, mask
+
+
+class RnnLossLayerImpl(CnnLossLayerImpl):
+    """layers/recurrent/RnnLossLayer.java: per-timestep loss (N, T, C)."""
+
+
+class MaskLayerImpl(Layer):
+    """layers/util/MaskLayer.java: zero masked timesteps explicitly."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        if mask is not None:
+            m = mask.astype(x.dtype)
+            while m.ndim < x.ndim:
+                m = m[..., None]
+            x = x * m
+        return x, state, mask
+
+
+class MaskZeroLayerImpl(Layer):
+    """layers/recurrent/MaskZeroLayer.java: derive the timestep mask from
+    the input values, then run the wrapped layer under it."""
+
+    def __init__(self, net_conf, lc, itype):
+        super().__init__(net_conf, lc, itype)
+        self.inner_layer = build_layer(net_conf, lc.inner(), itype)
+
+    def init(self, key) -> Params:
+        return {"inner": self.inner_layer.init(key)}
+
+    def init_state(self) -> State:
+        return self.inner_layer.init_state()
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        derived = jnp.any(x != self.lc.mask_value, axis=-1).astype(x.dtype)
+        if mask is not None:
+            derived = derived * mask.astype(x.dtype)
+        x = x * derived[..., None]
+        y, st, _ = self.inner_layer.apply(params["inner"], x, state,
+                                          train=train, rng=rng, mask=derived)
+        return y, st, derived
+
+
+class RepeatVectorImpl(Layer):
+    """layers/RepeatVector.java: (N, F) -> (N, n, F)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return jnp.broadcast_to(x[:, None, :],
+                                (x.shape[0], self.lc.n, x.shape[-1])), state, None
+
+
+class ElementWiseMultiplicationLayerImpl(Layer):
+    """layers/feedforward/elementwise/ElementWiseMultiplicationLayer.java."""
+
+    def init(self, key) -> Params:
+        n = self.lc.n_out or self.lc.n_in
+        return {"W": jnp.ones((n,), self.dtype), "b": jnp.zeros((n,), self.dtype)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        return self.activation(x * params["W"] + params["b"]), state, mask
+
+
+class FrozenLayerWithBackpropImpl(Layer):
+    """layers/FrozenLayerWithBackprop.java: stop-gradient on the wrapped
+    layer's PARAMS (they never update) while activations and upstream
+    gradients flow normally."""
+
+    def __init__(self, net_conf, lc, itype):
+        super().__init__(net_conf, lc, itype)
+        self.inner_layer = build_layer(net_conf, lc.inner(), itype)
+
+    def init(self, key) -> Params:
+        return {"inner": self.inner_layer.init(key)}
+
+    def init_state(self) -> State:
+        return self.inner_layer.init_state()
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        frozen = jax.tree.map(jax.lax.stop_gradient, params["inner"])
+        return self.inner_layer.apply(frozen, x, state, train=train, rng=rng,
+                                      mask=mask)
+
+
+class CenterLossOutputLayerImpl(DenseLayerImpl):
+    """layers/training/CenterLossOutputLayer.java: dense+softmax forward;
+    per-class centers live in params["centers"] and enter through the loss
+    (the network adds λ·½‖features − c_y‖² — see MultiLayerNetwork)."""
+
+    def init(self, key) -> Params:
+        p = super().init(key)
+        p["centers"] = jnp.zeros((self.lc.n_out, self.lc.n_in), self.dtype)
+        return p
+
+
+class Yolo2OutputLayerImpl(Layer):
+    """layers/objdetect/Yolo2OutputLayer.java: identity forward — the raw
+    head output is decoded inside the 'yolo2' loss."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return x, state, mask
+
+
+def _squash(v, axis=-1, eps=1e-8):
+    """CapsNet squash: (‖v‖²/(1+‖v‖²)) · v/‖v‖ (Sabour et al. 2017)."""
+    sq = jnp.sum(jnp.square(v), axis=axis, keepdims=True)
+    return (sq / (1.0 + sq)) * v * jax.lax.rsqrt(sq + eps)
+
+
+class PrimaryCapsulesImpl(Layer):
+    """layers/PrimaryCapsules.java: conv → capsule channels → squash."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        kh, kw = lc.kernel
+        out_ch = lc.capsules * lc.capsule_dim
+        p = {"W": init_weights(key, (kh, kw, self.itype.channels, out_ch),
+                               self.winit, dtype=self.dtype),
+             "b": jnp.zeros((out_ch,), self.dtype)}
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        y = jax.lax.conv_general_dilated(
+            x, params["W"], tuple(lc.stride), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + params["b"]
+        n = y.shape[0]
+        y = y.reshape(n, -1, lc.capsule_dim)
+        return _squash(y), state, None
+
+
+class CapsuleLayerImpl(Layer):
+    """layers/CapsuleLayer.java: dynamic routing between capsule layers."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        in_caps, in_dim = self.itype.timesteps, self.itype.size
+        return {"W": init_weights(key, (in_caps, lc.capsules,
+                                        lc.capsule_dim, in_dim),
+                                  self.winit, dtype=self.dtype)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        # u_hat[n,i,j,k] = W[i,j,k,:] · x[n,i,:]
+        u_hat = jnp.einsum("nid,ijkd->nijk", x, params["W"])
+        b = jnp.zeros(u_hat.shape[:3], u_hat.dtype)
+        v = None
+        for it in range(max(int(lc.routings), 1)):
+            c = jax.nn.softmax(b, axis=2)
+            s = jnp.sum(c[..., None] * u_hat, axis=1)
+            v = _squash(s)
+            if it + 1 < lc.routings:
+                # routing agreement uses detached predictions (standard
+                # CapsNet practice: gradients flow only through the last pass)
+                b = b + jnp.einsum("njk,nijk->nij",
+                                   jax.lax.stop_gradient(v), u_hat)
+        return v, state, None
+
+
+class CapsuleStrengthLayerImpl(Layer):
+    """layers/CapsuleStrengthLayer.java: per-capsule L2 norm."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=-1) + 1e-12), state, mask
+
+
 LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.DenseLayer: DenseLayerImpl,
     C.OutputLayer: OutputLayerImpl,
@@ -966,6 +1261,28 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.LocallyConnected1D: LocallyConnected1DImpl,
     C.PReLULayer: PReLULayerImpl,
     C.VariationalAutoencoder: VariationalAutoencoderImpl,
+    C.ZeroPadding1DLayer: ZeroPadding1DLayerImpl,
+    C.ZeroPaddingLayer: ZeroPaddingLayerImpl,
+    C.ZeroPadding3DLayer: ZeroPadding3DLayerImpl,
+    C.Cropping1D: Cropping1DImpl,
+    C.Cropping2D: Cropping2DImpl,
+    C.Cropping3D: Cropping3DImpl,
+    C.Upsampling1D: Upsampling1DImpl,
+    C.Upsampling3D: Upsampling3DImpl,
+    C.Subsampling1DLayer: Subsampling1DLayerImpl,
+    C.Deconvolution3D: Deconvolution3DImpl,
+    C.CnnLossLayer: CnnLossLayerImpl,
+    C.RnnLossLayer: RnnLossLayerImpl,
+    C.MaskLayer: MaskLayerImpl,
+    C.MaskZeroLayer: MaskZeroLayerImpl,
+    C.RepeatVector: RepeatVectorImpl,
+    C.ElementWiseMultiplicationLayer: ElementWiseMultiplicationLayerImpl,
+    C.FrozenLayerWithBackprop: FrozenLayerWithBackpropImpl,
+    C.CenterLossOutputLayer: CenterLossOutputLayerImpl,
+    C.Yolo2OutputLayer: Yolo2OutputLayerImpl,
+    C.PrimaryCapsules: PrimaryCapsulesImpl,
+    C.CapsuleLayer: CapsuleLayerImpl,
+    C.CapsuleStrengthLayer: CapsuleStrengthLayerImpl,
 }
 
 

@@ -157,7 +157,10 @@ class MultiLayerNetwork:
         # loss comes from the terminal layer config
         last = conf.layers[-1] if conf.layers else None
         self._loss_name = getattr(last, "loss", None)
-        self._loss_fn = get_loss(self._loss_name) if self._loss_name else None
+        if hasattr(last, "loss_fn"):  # conf binds its own hyperparameters
+            self._loss_fn = last.loss_fn()
+        else:
+            self._loss_fn = get_loss(self._loss_name) if self._loss_name else None
 
     # ------------------------------------------------------------------ init
     def init(self, params: Optional[List[Dict[str, Any]]] = None) -> "MultiLayerNetwork":
@@ -183,7 +186,7 @@ class MultiLayerNetwork:
 
     # --------------------------------------------------------------- forward
     def _forward(self, params, net_state, x, mask, *, train: bool, rng,
-                 rnn_states=None):
+                 rnn_states=None, tap_input_of: Optional[int] = None):
         """Run preprocessors + layers; returns (out, new_net_state) — or,
         when ``rnn_states`` is given (a list, one entry per layer, None for
         non-recurrent layers), (out, new_net_state, new_rnn_states): the
@@ -202,9 +205,12 @@ class MultiLayerNetwork:
                     rnn_states = DT.cast_floats(rnn_states, cd)
             new_state = []
             new_rnn = [] if rnn_states is not None else None
+            tapped = None
             rngs = jax.random.split(rng, max(len(self.layers), 1)) if rng is not None else [None] * len(self.layers)
             for i, layer in enumerate(self.layers):
                 x = apply_preprocessor(self.conf.preprocessors.get(i), x)
+                if i == tap_input_of:
+                    tapped = x
                 if rnn_states is not None and hasattr(layer, "apply_with_state"):
                     x = layer._maybe_dropout(x, train=train, rng=rngs[i])
                     x, last = layer.apply_with_state(
@@ -221,6 +227,8 @@ class MultiLayerNetwork:
                 x = DT.cast_floats(x, jnp.float32)  # loss/eval math stays f32
         if rnn_states is not None:
             return x, new_state, new_rnn
+        if tap_input_of is not None:
+            return x, new_state, tapped
         return x, new_state
 
     def feed_forward(self, x, train: bool = False) -> List[np.ndarray]:
@@ -324,8 +332,39 @@ class MultiLayerNetwork:
         return reg_penalty(self.conf, zip(params, self.conf.layers))
 
     def _make_train_step(self):
+        last_lc = self.conf.layers[-1] if self.conf.layers else None
+        center = isinstance(last_lc, C.CenterLossOutputLayer)
+
         def train_step(params, opt_state, net_state, step, key, features, labels, fmask, lmask):
             def loss_fn(p):
+                if center:
+                    # CenterLossOutputLayer: tap the features feeding the
+                    # output layer and add λ·½‖f − c_y‖²; gradients flow both
+                    # into the centers (params[-1]["centers"]) and back into
+                    # the feature extractor — reference semantics.
+                    out, new_state, feats = self._forward(
+                        p, net_state, features, fmask, train=True, rng=key,
+                        tap_input_of=len(self.layers) - 1)
+                    loss = self._loss_from_out(out, labels, lmask)
+                    f32 = jnp.promote_types(jnp.float32, feats.dtype)
+                    f = feats.astype(f32)
+                    centers = p[-1]["centers"].astype(f32)
+                    y_idx = jnp.argmax(labels, axis=-1)
+                    # decoupled center loss: λ weighs the FEATURE pull toward
+                    # (detached) centers; α weighs the CENTER pull toward
+                    # (detached) features — the gradient α(c_y − f̄) is the
+                    # reference's moving-average center update c←c−α(c−f̄)
+                    # realized through the optimizer (CenterLossOutputLayer
+                    # alpha/lambda semantics).
+                    sg = jax.lax.stop_gradient
+                    d_feat = f - sg(centers[y_idx])
+                    d_ctr = sg(f) - centers[y_idx]
+                    loss = (loss
+                            + 0.5 * last_lc.lambda_ * jnp.mean(
+                                jnp.sum(jnp.square(d_feat), axis=-1))
+                            + 0.5 * last_lc.alpha * jnp.mean(
+                                jnp.sum(jnp.square(d_ctr), axis=-1)))
+                    return loss, new_state
                 out, new_state = self._forward(p, net_state, features, fmask, train=True, rng=key)
                 loss = self._loss_from_out(out, labels, lmask)
                 return loss, new_state

@@ -151,6 +151,49 @@ def wasserstein(preds, labels, mask=None, weights=None):
 
 
 # Name table mirrors DL4J's LossFunctions.LossFunction enum.
+def yolo2(pred, target, mask=None, *, lambda_coord: float = 5.0,
+          lambda_noobj: float = 0.5, anchors=None):
+    """YOLOv2 multi-part sum-squared objective
+    (conf/layers/objdetect/Yolo2OutputLayer.java computeScore analog) —
+    THE single implementation; Yolo2OutputLayer and the zoo TinyYOLO both
+    route here.
+
+    pred: raw head output (N, H, W, B*(5+C)) or (N, H, W, B, 5+C);
+    target: (N, H, W, B, 5+C) with [x, y, w, h, objectness, class-onehot…].
+    Box count B and class count C are taken from the target shape. ``mask``
+    (N, H, W) optionally excludes grid cells entirely. When ``anchors``
+    ((B, 2) prior sizes) are given, predicted w/h decode as
+    anchor·exp(t) (the reference's anchor-box parameterization); without
+    them the raw activations are compared directly."""
+    import jax
+
+    n, gh, gw = target.shape[0], target.shape[1], target.shape[2]
+    bx, depth = target.shape[3], target.shape[4]
+    p = pred.reshape(n, gh, gw, bx, depth)
+    xy = jax.nn.sigmoid(p[..., 0:2])
+    if anchors is not None:
+        a = jnp.asarray(anchors, p.dtype).reshape(1, 1, 1, bx, 2)
+        wh = a * jnp.exp(p[..., 2:4])
+    else:
+        wh = p[..., 2:4]
+    obj = jax.nn.sigmoid(p[..., 4])
+    cls = jax.nn.softmax(p[..., 5:], axis=-1)
+    t_obj = target[..., 4]
+    if mask is not None:
+        cell = mask.reshape(n, gh, gw, 1)
+        t_obj = t_obj * cell
+        noobj_w = (1 - target[..., 4]) * cell
+    else:
+        noobj_w = 1 - t_obj
+    coord = jnp.sum(t_obj[..., None] * ((xy - target[..., 0:2]) ** 2
+                                        + (wh - target[..., 2:4]) ** 2))
+    obj_term = jnp.sum(t_obj * (obj - 1.0) ** 2)
+    noobj = jnp.sum(noobj_w * obj ** 2)
+    cls_term = jnp.sum(t_obj[..., None] * (cls - target[..., 5:]) ** 2)
+    return (lambda_coord * coord + obj_term + lambda_noobj * noobj
+            + cls_term) / n
+
+
 LOSSES: Dict[str, Callable] = {
     "mcxent": mcxent,
     "negativeloglikelihood": negative_log_likelihood,
@@ -170,6 +213,7 @@ LOSSES: Dict[str, Callable] = {
     "squared_hinge": squared_hinge,
     "cosine_proximity": cosine_proximity,
     "wasserstein": wasserstein,
+    "yolo2": yolo2,
 }
 
 

@@ -12,6 +12,10 @@ void threshold_decode(const int32_t*, int64_t, float, float*, int64_t);
 int64_t bitmap_encode(const float*, int64_t, float, uint8_t*, float*);
 void bitmap_decode(const uint8_t*, int64_t, float, float*);
 int32_t codec_abi_version();
+void u8_normalize(const uint8_t*, long long, float, float, float*);
+void u8_standardize(const uint8_t*, long long, long long, const float*,
+                    const float*, float*);
+uint32_t murmur3_32(const uint8_t*, long long, uint32_t);
 }
 
 static bool feq(float a, float b) { return std::fabs(a - b) < 1e-6f; }
@@ -53,6 +57,28 @@ int main() {
   for (size_t i = 0; i < g.size(); ++i) {
     assert(feq(dec3[i] + res3[i], g[i]));
   }
+
+  // u8_normalize: [0,255] -> [0,1] scaler semantics
+  std::vector<uint8_t> px = {0, 128, 255};
+  std::vector<float> out(px.size());
+  u8_normalize(px.data(), px.size(), 1.0f / 255.0f, 0.0f, out.data());
+  assert(feq(out[0], 0.0f) && feq(out[2], 1.0f));
+  assert(std::fabs(out[1] - 128.0f / 255.0f) < 1e-6f);
+
+  // u8_standardize: channel-last z-score
+  std::vector<uint8_t> img = {10, 20, 30, 40};  // 2 px, c=2
+  float mean[2] = {20.0f, 30.0f};
+  float inv_std[2] = {0.5f, 0.25f};
+  std::vector<float> st(4);
+  u8_standardize(img.data(), 4, 2, mean, inv_std, st.data());
+  assert(feq(st[0], -5.0f) && feq(st[1], -2.5f));
+  assert(feq(st[2], 5.0f) && feq(st[3], 2.5f));
+
+  // murmur3 x86-32 known vectors
+  assert(murmur3_32((const uint8_t*)"", 0, 0) == 0u);
+  assert(murmur3_32((const uint8_t*)"abc", 3, 0) == 0xB3DD93FAu);
+  assert(murmur3_32((const uint8_t*)"hello", 5, 0) == 0x248BFA47u);
+  assert(murmur3_32((const uint8_t*)"", 0, 1) == 0x514E28B7u);
 
   std::printf("codec_test OK\n");
   return 0;

@@ -1,0 +1,73 @@
+// Native pixel/normalization kernels + string hashing.
+//
+// Reference parity:
+//   * ImagePreProcessingScaler / NormalizerStandardize bottom out in native
+//     elementwise loops in the reference (libnd4j legacy transform kernels);
+//     on the TPU build the DEVICE side is XLA, but the HOST-side input
+//     pipeline (uint8 images → normalized f32 batches, before device_put)
+//     is exactly the loop below — keeping byte-wrangling off Python.
+//   * murmur3_32: nd4j-common HashUtil role (stable string/bytes hashing
+//     for vocab bucketing and shard assignment).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// out[i] = in[i] * scale + shift  (ImagePreProcessingScaler hot path)
+void u8_normalize(const uint8_t* in, long long n, float scale, float shift,
+                  float* out) {
+#pragma omp parallel for schedule(static)
+    for (long long i = 0; i < n; ++i) {
+        out[i] = (float)in[i] * scale + shift;
+    }
+}
+
+// Channel-last standardize: out[i] = (in[i] - mean[i % c]) * inv_std[i % c]
+// (NormalizerStandardize on NHWC uint8 images; c = trailing channel count)
+void u8_standardize(const uint8_t* in, long long n, long long c,
+                    const float* mean, const float* inv_std, float* out) {
+#pragma omp parallel for schedule(static)
+    for (long long i = 0; i < n; ++i) {
+        long long ch = i % c;
+        out[i] = ((float)in[i] - mean[ch]) * inv_std[ch];
+    }
+}
+
+// MurmurHash3 x86 32-bit (public domain reference algorithm, Austin Appleby)
+uint32_t murmur3_32(const uint8_t* data, long long len, uint32_t seed) {
+    const uint32_t c1 = 0xcc9e2d51u, c2 = 0x1b873593u;
+    uint32_t h = seed;
+    const long long nblocks = len / 4;
+    for (long long i = 0; i < nblocks; ++i) {
+        uint32_t k;
+        std::memcpy(&k, data + i * 4, 4);
+        k *= c1;
+        k = (k << 15) | (k >> 17);
+        k *= c2;
+        h ^= k;
+        h = (h << 13) | (h >> 19);
+        h = h * 5 + 0xe6546b64u;
+    }
+    uint32_t k = 0;
+    const uint8_t* tail = data + nblocks * 4;
+    switch (len & 3) {
+        case 3: k ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+        case 2: k ^= (uint32_t)tail[1] << 8; [[fallthrough]];
+        case 1:
+            k ^= tail[0];
+            k *= c1;
+            k = (k << 15) | (k >> 17);
+            k *= c2;
+            h ^= k;
+    }
+    h ^= (uint32_t)len;
+    h ^= h >> 16;
+    h *= 0x85ebca6bu;
+    h ^= h >> 13;
+    h *= 0xc2b2ae35u;
+    h ^= h >> 16;
+    return h;
+}
+
+}  // extern "C"

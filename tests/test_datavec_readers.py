@@ -1,0 +1,124 @@
+"""Record readers (line/regex/json/svmlight/sequence), the columnar
+(Arrow-role) converter, and the parallel transform executor."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec import (
+    Schema, TransformProcess, LineRecordReader, RegexLineRecordReader,
+    JacksonLineRecordReader, SVMLightRecordReader, CSVSequenceRecordReader,
+    ParallelTransformExecutor, ColumnarBatch, to_columnar, save_columnar,
+    load_columnar,
+)
+
+
+class TestReaders:
+    def test_line_reader(self):
+        recs = LineRecordReader(skip_lines=1).read("header\nfoo\nbar\n")
+        assert recs == [["foo"], ["bar"]]
+
+    def test_regex_reader(self):
+        text = "2024-01-01 INFO start\n2024-01-02 WARN slow\n"
+        recs = RegexLineRecordReader(r"(\S+) (\S+) (.*)").read(text)
+        assert recs == [["2024-01-01", "INFO", "start"],
+                        ["2024-01-02", "WARN", "slow"]]
+
+    def test_regex_reader_mismatch_raises(self):
+        with pytest.raises(ValueError, match="does not match"):
+            RegexLineRecordReader(r"(\d+)").read("abc\n")
+
+    def test_jackson_reader(self):
+        text = '{"a": 1, "b": "x"}\n{"a": 2, "c": true}\n'
+        recs = JacksonLineRecordReader(["a", "b"]).read(text)
+        assert recs == [[1, "x"], [2, None]]
+
+    def test_svmlight_reader(self):
+        text = "1 1:0.5 3:2.0\n-1 2:1.5 # comment\n"
+        feats, labels = SVMLightRecordReader(num_features=3).read_dataset(text)
+        np.testing.assert_allclose(feats, [[0.5, 0, 2.0], [0, 1.5, 0]])
+        np.testing.assert_allclose(labels, [1, -1])
+
+    def test_svmlight_out_of_range(self):
+        with pytest.raises(ValueError, match="out of range"):
+            SVMLightRecordReader(num_features=2).read("1 3:1.0\n")
+
+    def test_csv_sequence_reader_blocks(self):
+        text = "1,2\n3,4\n\n5,6\n7,8\n9,10\n"
+        seqs = CSVSequenceRecordReader().read(text)
+        assert len(seqs) == 2
+        assert seqs[0] == [["1", "2"], ["3", "4"]]
+        assert len(seqs[1]) == 3
+
+
+class TestColumnar:
+    def _schema(self):
+        return (Schema.Builder()
+                .add_column_integer("id")
+                .add_column_double("score")
+                .add_column_string("tag")
+                .build())
+
+    def test_round_trip_records(self):
+        schema = self._schema()
+        records = [[1, 0.5, "a"], [2, 1.5, "b"], [3, -1.0, "c"]]
+        batch = to_columnar(records, schema)
+        assert batch.num_rows == 3
+        np.testing.assert_array_equal(batch.column("id"), [1, 2, 3])
+        assert batch.to_records() == records
+
+    def test_save_load(self, tmp_path):
+        schema = self._schema()
+        records = [[1, 0.5, "a"], [2, 1.5, "b"]]
+        batch = to_columnar(records, schema)
+        p = str(tmp_path / "batch.npz")
+        save_columnar(batch, p)
+        back = load_columnar(p)
+        assert back.to_records() == records
+        assert back.schema.names == schema.names
+
+    def test_to_matrix(self):
+        schema = (Schema.Builder().add_column_double("x")
+                  .add_column_double("y").build())
+        batch = to_columnar([[1.0, 2.0], [3.0, 4.0]], schema)
+        np.testing.assert_allclose(batch.to_matrix(),
+                                   [[1, 2], [3, 4]])
+
+    def test_ragged_raises(self):
+        schema = self._schema()
+        with pytest.raises(ValueError, match="ragged"):
+            ColumnarBatch(schema, {"id": np.arange(3), "score": np.arange(2),
+                                   "tag": np.asarray(["a", "b", "c"])})
+
+
+class TestParallelExecutor:
+    def _tp(self):
+        schema = (Schema.Builder().add_column_integer("v").build())
+        return (TransformProcess.builder(schema)
+                .math_op("v", "Add", 10)
+                .build())
+
+    def test_matches_serial(self):
+        records = [[i] for i in range(2000)]
+        tp = self._tp()
+        serial = tp.execute([list(r) for r in records])
+        par = ParallelTransformExecutor(workers=4).execute(
+            [list(r) for r in records], tp)
+        assert par == serial
+
+    def test_small_input_runs_inline(self):
+        records = [[i] for i in range(10)]
+        tp = self._tp()
+        out = ParallelTransformExecutor(workers=4).execute(records, tp)
+        assert out == [[i + 10] for i in range(10)]
+
+    def test_order_preserved_with_filter(self):
+        schema = Schema.Builder().add_column_integer("v").build()
+        from deeplearning4j_tpu.datavec import ColumnCondition
+        # filter REMOVES matching records (ConditionFilter semantics)
+        tp = (TransformProcess.builder(schema)
+              .filter(ColumnCondition("v", "LessThan", 1000))
+              .build())
+        records = [[i] for i in range(3000)]
+        out = ParallelTransformExecutor(workers=3).execute(
+            [list(r) for r in records], tp)
+        assert out == [[i] for i in range(1000, 3000)]

@@ -1,0 +1,95 @@
+"""GraphRunner interop tests — nd4j-tensorflow GraphRunner /
+nd4j-onnxruntime parity: load a foreign graph (file or bytes), run it with
+named feeds/fetches, match the source framework's own output elementwise."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.imports import GraphRunner
+from deeplearning4j_tpu.imports.graph_runner import _sniff_framework
+
+tf = pytest.importorskip("tensorflow")
+
+from tests.test_tf_import import freeze
+from tests.test_onnx_import import build_model, node_proto
+
+
+def _tf_mlp():
+    rng = np.random.RandomState(0)
+    w0 = tf.Variable(rng.randn(4, 8).astype(np.float32))
+    b0 = tf.Variable(np.zeros(8, np.float32))
+    w1 = tf.Variable(rng.randn(8, 3).astype(np.float32))
+
+    def model(x):
+        h = tf.nn.relu(tf.matmul(x, w0) + b0)
+        return tf.nn.softmax(tf.matmul(h, w1))
+
+    gd, ins, outs = freeze(model, tf.TensorSpec([None, 4], tf.float32))
+    return model, gd, ins, outs
+
+
+class TestGraphRunnerTF:
+    def test_tf_bytes_sniffed(self):
+        model, gd, ins, outs = _tf_mlp()
+        data = gd.SerializeToString()
+        assert _sniff_framework(data) == "tensorflow"
+        runner = GraphRunner(data)
+        x = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+        res = runner.run({ins[0]: x})
+        np.testing.assert_allclose(res[outs[0]],
+                                   model(tf.constant(x)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_tf_file_by_extension(self, tmp_path):
+        model, gd, ins, outs = _tf_mlp()
+        p = tmp_path / "frozen.pb"
+        p.write_bytes(gd.SerializeToString())
+        runner = GraphRunner(str(p))
+        assert runner.framework == "tensorflow"
+        assert ins[0] in runner.input_names
+        x = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+        res = runner({ins[0]: x})  # __call__ alias
+        np.testing.assert_allclose(res[outs[0]],
+                                   model(tf.constant(x)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_explicit_fetches(self):
+        model, gd, ins, outs = _tf_mlp()
+        runner = GraphRunner(gd.SerializeToString(), outputs=outs)
+        assert runner.output_names == list(outs)
+
+
+class TestGraphRunnerOnnx:
+    def _onnx_mlp(self):
+        r = np.random.RandomState(0)
+        w = r.randn(4, 6).astype(np.float32)
+        nodes = [node_proto("MatMul", ["x", "w"], ["h"]),
+                 node_proto("Relu", ["h"], ["y"])]
+        model = build_model(nodes, [("x", (2, 4))], [("y", (2, 6))],
+                            {"w": w})
+        return bytes(model), w
+
+    def test_onnx_bytes_sniffed(self):
+        data, w = self._onnx_mlp()
+        assert _sniff_framework(data) == "onnx"
+        runner = GraphRunner(data)
+        assert runner.framework == "onnx"
+        x = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+        res = runner.run({"x": x})
+        np.testing.assert_allclose(res["y"], np.maximum(x @ w, 0),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_onnx_file_by_extension(self, tmp_path):
+        data, w = self._onnx_mlp()
+        p = tmp_path / "model.onnx"
+        p.write_bytes(data)
+        runner = GraphRunner(str(p))
+        assert runner.framework == "onnx"
+        assert runner.output_names == ["y"]
+        x = np.zeros((2, 4), np.float32)
+        res = runner.run({"x": x})
+        np.testing.assert_allclose(res["y"], np.zeros((2, 6), np.float32))
+
+    def test_empty_bytes_raise(self):
+        with pytest.raises(ValueError, match="empty"):
+            _sniff_framework(b"")

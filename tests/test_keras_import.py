@@ -106,9 +106,12 @@ class TestKerasImport:
     def test_unsupported_layer_raises(self):
         model = tf.keras.Sequential([
             tf.keras.layers.Input((4, 4)),
-            tf.keras.layers.GaussianNoise(0.1),
+            tf.keras.layers.LocallyConnected1D(2, 2)
+            if hasattr(tf.keras.layers, "LocallyConnected1D")
+            else tf.keras.layers.Lambda(lambda t: t),
         ])
-        with pytest.raises(NotImplementedError, match="GaussianNoise"):
+        with pytest.raises(NotImplementedError,
+                           match="LocallyConnected1D|Lambda"):
             import_keras_model(model)
 
 
@@ -243,3 +246,104 @@ class TestKerasOwnH5:
         x = rng.rand(2, 6, 8, 8, 2).astype(np.float32)
         golden = model.predict(x, verbose=0)
         np.testing.assert_allclose(net.output(x), golden, rtol=1e-4, atol=1e-5)
+
+
+class TestKerasImportRound3b:
+    """Golden tests for the pad/crop/upsample/1-D-pool/transpose/utility
+    mappers added with the round-3b layer catalog."""
+
+    def test_pad_crop_upsample_2d_golden(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((8, 8, 2)),
+            tf.keras.layers.ZeroPadding2D(((1, 2), (3, 4))),
+            tf.keras.layers.Cropping2D(((1, 2), (3, 4))),
+            tf.keras.layers.UpSampling2D(2),
+            tf.keras.layers.Conv2D(3, 3, activation="relu"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(0).rand(2, 8, 8, 2).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_temporal_pipeline_golden(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((10, 4)),
+            tf.keras.layers.ZeroPadding1D((1, 2)),
+            tf.keras.layers.Conv1D(6, 3, activation="tanh"),
+            tf.keras.layers.MaxPooling1D(2),
+            tf.keras.layers.Cropping1D((0, 1)),
+            tf.keras.layers.UpSampling1D(2),
+            tf.keras.layers.AveragePooling1D(2),
+            tf.keras.layers.GlobalMaxPooling1D(),
+            tf.keras.layers.Dense(3, activation="softmax"),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(1).randn(3, 10, 4).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_pad_crop_3d_golden(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 4, 4, 2)),
+            tf.keras.layers.ZeroPadding3D(((1, 1), (0, 2), (2, 0))),
+            tf.keras.layers.Cropping3D(((1, 1), (0, 2), (2, 0))),
+            tf.keras.layers.Conv3D(3, 2, activation="relu"),
+            tf.keras.layers.GlobalAveragePooling3D(),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(2).rand(2, 4, 4, 4, 2).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_conv3d_transpose_golden(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((3, 3, 3, 2)),
+            tf.keras.layers.Conv3DTranspose(4, 2, strides=2,
+                                            activation="tanh"),
+            tf.keras.layers.GlobalMaxPooling3D(),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(3).randn(2, 3, 3, 3, 2).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_repeat_vector_timedistributed_golden(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((5,)),
+            tf.keras.layers.Dense(4, activation="relu"),
+            tf.keras.layers.RepeatVector(6),
+            tf.keras.layers.TimeDistributed(
+                tf.keras.layers.Dense(3, activation="softmax")),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(4).randn(3, 5).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_masking_and_noise_inference_golden(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((6, 3)),
+            tf.keras.layers.Masking(mask_value=0.0),
+            tf.keras.layers.GaussianNoise(0.5),
+            tf.keras.layers.SimpleRNN(5, activation="tanh",
+                                      return_sequences=False),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(5).randn(2, 6, 3).astype(np.float32)
+        x[:, 4:, :] = 0.0  # masked tail
+        golden = model(x, training=False).numpy()
+        # our SimpleRnn returns the full sequence; keras returns last step.
+        got = net.output(x)
+        if got.ndim == 3:
+            got = got[:, -1]  # but masked: the LAST VALID step
+        # keras masking makes the RNN skip masked steps, carrying the state
+        # from step 3 — our masked scan does the same, so last-step state
+        # must match
+        np.testing.assert_allclose(got[:, :], golden, rtol=1e-4, atol=1e-5)
+
+    def test_spatial_dropout_variants_identity_at_inference(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((8, 4)),
+            tf.keras.layers.SpatialDropout1D(0.4),
+            tf.keras.layers.Conv1D(3, 3, padding="same"),
+            tf.keras.layers.GlobalAveragePooling1D(),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(6).randn(2, 8, 4).astype(np.float32)
+        assert_outputs_match(model, net, x)
