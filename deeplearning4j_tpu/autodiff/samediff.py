@@ -490,6 +490,150 @@ class SDLoss(_Namespace):
         return self._sd._record("huber_loss", [pred, labels], {"delta": delta})
 
 
+class SDImage(_Namespace):
+    """sd.image() — SDImage.java op factory over the catalog's image family."""
+
+    def resize_bilinear(self, x, height, width):
+        return self._sd.op("resize_bilinear", x, size=(height, width))
+
+    def resize_nearest_neighbor(self, x, height, width):
+        return self._sd.op("resize_nearest_neighbor", x, size=(height, width))
+
+    def resize_bicubic(self, x, height, width):
+        return self._sd.op("resize_bicubic", x, size=(height, width))
+
+    def crop_and_resize(self, image, boxes, box_indices, crop_size):
+        return self._sd.op("crop_and_resize", image, boxes, box_indices,
+                           crop_size=tuple(crop_size))
+
+    def non_max_suppression(self, boxes, scores, max_out_size,
+                            iou_threshold=0.5, score_threshold=float("-inf")):
+        """Returns (indices, valid_mask) — the op is two-output."""
+        return self._sd.op("non_max_suppression", boxes, scores,
+                           max_output_size=max_out_size,
+                           iou_threshold=iou_threshold,
+                           score_threshold=score_threshold, n_out=2)
+
+    def adjust_contrast(self, x, factor):
+        return self._sd.op("adjust_contrast", x, factor=factor)
+
+    def adjust_hue(self, x, delta):
+        return self._sd.op("adjust_hue", x, delta=delta)
+
+    def adjust_saturation(self, x, factor):
+        return self._sd.op("adjust_saturation", x, factor=factor)
+
+    def rgb_to_hsv(self, x):
+        return self._sd.op("rgb_to_hsv", x)
+
+    def hsv_to_rgb(self, x):
+        return self._sd.op("hsv_to_rgb", x)
+
+
+class SDLinalg(_Namespace):
+    """sd.linalg() — SDLinalg.java op factory."""
+
+    def cholesky(self, x):
+        return self._sd.op("cholesky", x)
+
+    def qr(self, x, full_matrices=False):
+        return self._sd.op("qr", x, full_matrices=full_matrices, n_out=2)
+
+    def svd(self, x, full_uv=False, compute_uv=True):
+        return self._sd.op("svd", x, full_matrices=full_uv,
+                           compute_uv=compute_uv, n_out=3 if compute_uv else 1)
+
+    def solve(self, a, b):
+        return self._sd.op("solve", a, b)
+
+    def triangular_solve(self, a, b, lower=True, adjoint=False):
+        return self._sd.op("triangular_solve", a, b, lower=lower,
+                           adjoint=adjoint)
+
+    def lu(self, x):
+        return self._sd.op("lu", x, n_out=2)
+
+    def matrix_determinant(self, x):
+        return self._sd.op("matrix_determinant", x)
+
+    def matrix_inverse(self, x):
+        return self._sd.op("matrix_inverse", x)
+
+    def matrix_band_part(self, x, lower, upper):
+        return self._sd.op("matrix_band_part", x, num_lower=lower,
+                           num_upper=upper)
+
+    def diag(self, x):
+        return self._sd.op("matrix_diag", x)
+
+
+class SDBitwise(_Namespace):
+    """sd.bitwise() — SDBitwise.java op factory."""
+
+    def and_(self, a, b):
+        return self._sd.op("bitwise_and", a, b)
+
+    def or_(self, a, b):
+        return self._sd.op("bitwise_or", a, b)
+
+    def xor(self, a, b):
+        return self._sd.op("bitwise_xor", a, b)
+
+    def left_shift(self, x, n):
+        return self._sd.op("shift_bits", x, shift=int(n))
+
+    def right_shift(self, x, n):
+        return self._sd.op("rshift_bits", x, shift=int(n))
+
+    def left_shift_cyclic(self, x, n):
+        return self._sd.op("cyclic_shift_bits", x, shift=int(n))
+
+    def right_shift_cyclic(self, x, n):
+        return self._sd.op("cyclic_rshift_bits", x, shift=int(n))
+
+    def toggle_bits(self, x):
+        return self._sd.op("toggle_bits", x)
+
+    def bits_hamming_distance(self, a, b):
+        return self._sd.op("bits_hamming_distance", a, b)
+
+
+class SDRandom(_Namespace):
+    """sd.random() — SDRandom.java op factory. Every draw takes an explicit
+    ``seed`` that becomes a functional PRNG key constant (jax discipline:
+    same seed → same stream, across backends)."""
+
+    def _key(self, seed):
+        import jax as _jax
+
+        return self._sd.constant(self._sd._fresh("rng_key"),
+                                 _jax.random.PRNGKey(seed))
+
+    def uniform(self, lo, hi, shape, seed=0):
+        return self._sd.op("random_uniform", self._key(seed),
+                           shape=tuple(shape), minval=lo, maxval=hi)
+
+    def normal(self, mean, stddev, shape, seed=0):
+        return self._sd.op("random_normal", self._key(seed),
+                           shape=tuple(shape), mean=mean, stddev=stddev)
+
+    def truncated_normal(self, mean, stddev, shape, seed=0):
+        return self._sd.op("random_truncated_normal", self._key(seed),
+                           shape=tuple(shape), mean=mean, stddev=stddev)
+
+    def bernoulli(self, p, shape, seed=0):
+        return self._sd.op("random_bernoulli", self._key(seed),
+                           shape=tuple(shape), prob=p)
+
+    def exponential(self, rate, shape, seed=0):
+        return self._sd.op("random_exponential", self._key(seed),
+                           shape=tuple(shape), rate=rate)
+
+    def gamma(self, alpha, shape, seed=0, beta=1.0):
+        return self._sd.op("random_gamma", self._key(seed),
+                           shape=tuple(shape), alpha=alpha, beta=beta)
+
+
 # ---------------------------------------------------------------------------
 # TrainingConfig (org/nd4j/autodiff/samediff/TrainingConfig.java)
 # ---------------------------------------------------------------------------
@@ -526,6 +670,10 @@ class SameDiff:
         self.math = SDMath(self)
         self.nn = SDNN(self)
         self.cnn = SDCNN(self)
+        self.image = SDImage(self)
+        self.linalg = SDLinalg(self)
+        self.bitwise = SDBitwise(self)
+        self.random = SDRandom(self)
         self.rnn = SDRNN(self)
         self.loss = SDLoss(self)
         self.training_config: Optional[TrainingConfig] = None

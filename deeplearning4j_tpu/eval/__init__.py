@@ -4,6 +4,7 @@ from deeplearning4j_tpu.eval.evaluation import (
     Evaluation,
     EvaluationBinary,
     ROC,
+    ROCBinary,
     ROCMultiClass,
     RegressionEvaluation,
     EvaluationCalibration,

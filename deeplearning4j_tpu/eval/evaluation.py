@@ -198,6 +198,47 @@ class ROC:
         return float(np.trapezoid(precision, recall))
 
 
+class ROCBinary:
+    """ROCBinary.java: an independent ROC per OUTPUT of a multi-label
+    binary network (sigmoid outputs), unlike ROCMultiClass's one-vs-all
+    over a softmax."""
+
+    def __init__(self):
+        self.per_output: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        orig_shape = labels.shape
+        labels = labels.reshape(-1, labels.shape[-1])
+        predictions = predictions.reshape(-1, labels.shape[-1])
+        per_output_mask = None
+        m = None
+        if mask is not None:
+            mk = np.asarray(mask)
+            # per-output mask iff it matches the labels' FULL shape — a
+            # last-dim-only match would misread a per-timestep (N, T) mask
+            # whenever T == nOut
+            if mk.shape == orig_shape:
+                per_output_mask = mk.reshape(-1, labels.shape[-1])
+            else:
+                m = mk.reshape(-1)  # per-example/timestep mask, all outputs
+        for c in range(labels.shape[-1]):
+            mc = per_output_mask[:, c] if per_output_mask is not None else m
+            self.per_output.setdefault(c, ROC()).eval(
+                labels[:, c], predictions[:, c], mc)
+
+    def calculate_auc(self, output: int) -> float:
+        return self.per_output[output].calculate_auc()
+
+    def calculate_auprc(self, output: int) -> float:
+        return self.per_output[output].calculate_auprc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.nanmean(
+            [r.calculate_auc() for r in self.per_output.values()]))
+
+
 class ROCMultiClass:
     """ROCMultiClass.java: one-vs-all ROC per class."""
 

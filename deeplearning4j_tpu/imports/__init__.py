@@ -13,3 +13,10 @@ from deeplearning4j_tpu.imports.onnx_import import (
     register_onnx_op,
 )
 from deeplearning4j_tpu.imports.graph_runner import GraphRunner
+from deeplearning4j_tpu.imports.keras_import import (
+    KerasLayerMapper,
+    import_keras_model,
+    import_keras_sequential_model_and_weights,
+    import_keras_model_and_weights,
+    register_custom_layer,
+)

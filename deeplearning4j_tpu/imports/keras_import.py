@@ -798,3 +798,15 @@ def _gaussian_noise(cfg, weights):
     # inference parity; DL4J maps this to its GaussianNoise IDropout the
     # same way)
     return C.ActivationLayer(activation="identity"), {}
+
+
+def register_custom_layer(name: str):
+    """KerasLayer.registerCustomLayer analog — decorate a mapper
+    ``fn(cfg, weights) -> (LayerConf, params)`` for a custom Keras layer
+    class name so import resolves it like a built-in:
+
+        @register_custom_layer("MyAttention")
+        def _my_attention(cfg, weights):
+            return nn.SelfAttentionLayer(...), {"Wq": weights[0], ...}
+    """
+    return KerasLayerMapper.register(name)

@@ -11,3 +11,11 @@ from deeplearning4j_tpu.nlp.paragraph_vectors import (
     LabelledDocument,
     ParagraphVectors,
 )
+from deeplearning4j_tpu.nlp.serde import (
+    StaticWordVectors,
+    load_static_model,
+    read_word2vec_binary,
+    read_word2vec_text,
+    write_word2vec_binary,
+    write_word2vec_text,
+)

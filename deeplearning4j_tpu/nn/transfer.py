@@ -38,6 +38,23 @@ class FineTuneConfiguration:
     seed: Optional[int] = None
 
 
+def _apply_fine_tune(conf, ftc: Optional[FineTuneConfiguration]) -> None:
+    """Apply FineTuneConfiguration overrides to a network conf (shared by
+    the MultiLayerNetwork and ComputationGraph builders)."""
+    if ftc is None:
+        return
+    if ftc.updater is not None:
+        conf.updater = get_updater(ftc.updater)
+    if ftc.l1 is not None:
+        conf.l1 = ftc.l1
+    if ftc.l2 is not None:
+        conf.l2 = ftc.l2
+    if ftc.weight_decay is not None:
+        conf.weight_decay = ftc.weight_decay
+    if ftc.seed is not None:
+        conf.seed = ftc.seed
+
+
 class TransferLearningBuilder:
     """TransferLearning.Builder analog for MultiLayerNetwork."""
 
@@ -99,18 +116,7 @@ class TransferLearningBuilder:
 
         # fine-tune overrides on non-frozen kept layers
         new_conf = copy.deepcopy(old_conf)
-        if self._fine_tune is not None:
-            ftc = self._fine_tune
-            if ftc.updater is not None:
-                new_conf.updater = get_updater(ftc.updater)
-            if ftc.l1 is not None:
-                new_conf.l1 = ftc.l1
-            if ftc.l2 is not None:
-                new_conf.l2 = ftc.l2
-            if ftc.weight_decay is not None:
-                new_conf.weight_decay = ftc.weight_decay
-            if ftc.seed is not None:
-                new_conf.seed = ftc.seed
+        _apply_fine_tune(new_conf, self._fine_tune)
 
         # appended layers: infer n_in from the previous output type
         for lc in self._added:
@@ -209,3 +215,130 @@ class TransferLearningHelper:
 
     def unfrozen_mln(self) -> MultiLayerNetwork:
         return self.head
+
+
+class GraphTransferLearningBuilder:
+    """TransferLearning.GraphBuilder analog for ComputationGraph: freeze a
+    feature extractor by vertex name, remove/replace heads, graft new
+    layers/vertices, and keep the source's params for untouched layers."""
+
+    def __init__(self, net):
+        from deeplearning4j_tpu.nn import graph as G
+
+        self._G = G
+        self._src = net
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_at: List[str] = []
+        self._removed: List[str] = []
+        self._added: List[Any] = []  # _GraphNode
+        self._n_out_replace: dict = {}
+        self._outputs: Optional[List[str]] = None
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, *names: str):
+        """Freeze the named vertices and every ancestor feeding them."""
+        self._freeze_at.extend(names)
+        return self
+
+    def remove_vertex_and_connections(self, name: str):
+        self._removed.append(name)
+        return self
+
+    def n_out_replace(self, layer_name: str, n_out: int,
+                      weight_init: str = "xavier"):
+        self._n_out_replace[layer_name] = (n_out, weight_init)
+        return self
+
+    def add_layer(self, name: str, lc: C.LayerConf, *inputs: str):
+        self._added.append(self._G._GraphNode(name=name, kind="layer",
+                                              layer=lc, inputs=list(inputs)))
+        return self
+
+    def add_vertex(self, name: str, vertex, *inputs: str):
+        if isinstance(vertex, C.LayerConf):
+            return self.add_layer(name, vertex, *inputs)
+        self._added.append(self._G._GraphNode(name=name, kind="vertex",
+                                              vertex=vertex,
+                                              inputs=list(inputs)))
+        return self
+
+    def set_outputs(self, *names: str):
+        self._outputs = list(names)
+        return self
+
+    def build(self):
+        G = self._G
+        src = self._src
+        conf = copy.deepcopy(src.conf)
+
+        # removals: the final produced-set validation below catches any
+        # kept node left consuming a removed name (re-added names satisfy
+        # it), and ComputationGraph.__init__ re-toposorts, so no ordering
+        # pass is needed here
+        removed = set(self._removed)
+        kept = [n for n in conf.nodes if n.name not in removed]
+
+        reinit = set()
+        # n_out replacement + consumer n_in fix-up (by graph edges)
+        by_name = {n.name: n for n in kept}
+        for lname, (n_out, winit) in self._n_out_replace.items():
+            node = by_name[lname]
+            node.layer = dataclasses.replace(node.layer, n_out=n_out,
+                                             weight_init=winit)
+            reinit.add(lname)
+            for n in kept:
+                if lname in n.inputs and n.layer is not None and hasattr(n.layer, "n_in"):
+                    n.layer = dataclasses.replace(n.layer, n_in=n_out)
+                    reinit.add(n.name)
+
+        # freeze: named vertices + all ancestors
+        if self._freeze_at:
+            frozen = set()
+            stack = list(self._freeze_at)
+            while stack:
+                cur = stack.pop()
+                if cur in frozen or cur in conf.network_inputs:
+                    continue
+                frozen.add(cur)
+                if cur in by_name:
+                    stack.extend(by_name[cur].inputs)
+            for n in kept:
+                if n.name in frozen and n.layer is not None:
+                    n.layer = dataclasses.replace(n.layer, updater=Frozen())
+
+        _apply_fine_tune(conf, self._fine_tune)
+
+        conf.nodes = kept + list(self._added)
+        for a in self._added:
+            reinit.add(a.name)
+        if self._outputs is not None:
+            conf.network_outputs = self._outputs
+        produced = ({n.name for n in conf.nodes} | set(conf.network_inputs))
+        for n in conf.nodes:
+            for i in n.inputs:
+                if i not in produced:
+                    raise ValueError(
+                        f"vertex '{n.name}' consumes '{i}', which no longer "
+                        f"exists — re-add it or remove '{n.name}' too")
+        for o in conf.network_outputs:
+            if o not in produced:
+                raise ValueError(
+                    f"network output '{o}' no longer exists — call "
+                    f"set_outputs() with the new head name(s)")
+
+        out = G.ComputationGraph(conf).init()
+        # copy params for kept, untouched layers
+        for n in kept:
+            if (n.kind == "layer" and n.name not in reinit
+                    and src.params is not None and n.name in src.params):
+                out.params[n.name] = copy.deepcopy(src.params[n.name])
+                out.net_state[n.name] = copy.deepcopy(src.net_state[n.name])
+        return out
+
+
+def graph_transfer_builder(net) -> GraphTransferLearningBuilder:
+    """TransferLearning.GraphBuilder(net) entry point."""
+    return GraphTransferLearningBuilder(net)

@@ -1,0 +1,343 @@
+"""WordVectorSerializer formats, ROCBinary, graph transfer learning, and
+the Keras custom-layer registry."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.nn import graph as G
+
+
+class TestWordVectorSerializer:
+    def _vectors(self):
+        r = np.random.RandomState(0)
+        words = ["the", "quick", "brown", "fox", "naïve"]  # incl. non-ascii
+        mat = r.randn(5, 8).astype(np.float32)
+        return words, mat
+
+    def test_binary_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import (read_word2vec_binary,
+                                            write_word2vec_binary)
+        words, mat = self._vectors()
+        p = str(tmp_path / "vecs.bin")
+        write_word2vec_binary((words, mat), p)
+        w2, m2 = read_word2vec_binary(p)
+        assert w2 == words
+        np.testing.assert_array_equal(m2, mat)
+
+    def test_text_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import (read_word2vec_text,
+                                            write_word2vec_text)
+        words, mat = self._vectors()
+        p = str(tmp_path / "vecs.txt")
+        write_word2vec_text((words, mat), p)
+        w2, m2 = read_word2vec_text(p)
+        assert w2 == words
+        np.testing.assert_allclose(m2, mat, rtol=0, atol=0)  # repr() is exact
+
+    def test_headerless_glove_style_text(self, tmp_path):
+        from deeplearning4j_tpu.nlp import read_word2vec_text
+        p = tmp_path / "glove.txt"
+        p.write_text("cat 1.0 2.0\ndog 3.0 4.0\n")
+        words, mat = read_word2vec_text(str(p))
+        assert words == ["cat", "dog"]
+        np.testing.assert_allclose(mat, [[1, 2], [3, 4]])
+
+    def test_load_static_model_sniffs_format(self, tmp_path):
+        from deeplearning4j_tpu.nlp import (load_static_model,
+                                            write_word2vec_binary,
+                                            write_word2vec_text)
+        words, mat = self._vectors()
+        pb = str(tmp_path / "vecs.bin")
+        pt = str(tmp_path / "vecs.txt")
+        write_word2vec_binary((words, mat), pb)
+        write_word2vec_text((words, mat), pt)
+        for p in (pb, pt):
+            sv = load_static_model(p)
+            assert sv.has_word("fox")
+            np.testing.assert_allclose(sv.word2vec("fox"), mat[3],
+                                       rtol=1e-6, atol=1e-6)
+            assert sv.similarity("fox", "fox") == pytest.approx(1.0)
+            assert len(sv.words_nearest("the", 3)) == 3
+
+    def test_word2vec_model_export(self, tmp_path):
+        from deeplearning4j_tpu.nlp import Word2Vec, load_static_model
+        from deeplearning4j_tpu.nlp.serde import write_word2vec_binary
+        sents = [["a", "b", "c", "d"]] * 30
+        w2v = Word2Vec(layer_size=6, min_word_frequency=1, epochs=1, seed=1)
+        w2v.fit(sents)
+        p = str(tmp_path / "model.bin")
+        write_word2vec_binary(w2v, p)
+        sv = load_static_model(p)
+        for w in w2v.inv_vocab:
+            np.testing.assert_allclose(sv.word2vec(w),
+                                       np.asarray(w2v.syn0)[w2v.vocab[w]],
+                                       rtol=1e-6, atol=1e-6)
+
+
+class TestROCBinary:
+    def test_per_output_auc(self):
+        from deeplearning4j_tpu.eval import ROCBinary
+        r = np.random.RandomState(0)
+        n = 200
+        labels = (r.rand(n, 3) > 0.5).astype(np.float32)
+        # output 0: perfect scores; output 1: random; output 2: inverted
+        preds = np.stack([
+            labels[:, 0] * 0.9 + 0.05,
+            r.rand(n),
+            1.0 - labels[:, 2],
+        ], axis=1)
+        roc = ROCBinary()
+        roc.eval(labels, preds)
+        assert roc.calculate_auc(0) == pytest.approx(1.0)
+        assert 0.35 < roc.calculate_auc(1) < 0.65
+        assert roc.calculate_auc(2) == pytest.approx(0.0)
+        avg = roc.calculate_average_auc()
+        assert 0.4 < avg < 0.6
+
+
+class TestGraphTransferLearning:
+    def _base_graph(self):
+        b = (G.graph_builder().seed(5)
+             .updater(nn.Sgd(learning_rate=0.1))
+             .add_inputs("in")
+             .set_input_types(**{"in": nn.InputType.feed_forward(4)}))
+        b.add_layer("fc1", nn.DenseLayer(n_out=6, activation="tanh"), "in")
+        b.add_layer("fc2", nn.DenseLayer(n_out=5, activation="tanh"), "fc1")
+        b.add_layer("out", nn.OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "fc2")
+        b.set_outputs("out")
+        return G.ComputationGraph(b.build()).init()
+
+    def test_freeze_and_replace_head(self):
+        net = self._base_graph()
+        r = np.random.RandomState(0)
+        x = r.randn(8, 4).astype(np.float32)
+        y = np.eye(2)[r.randint(0, 2, 8)].astype(np.float32)
+
+        new = (nn.graph_transfer_builder(net)
+               .set_feature_extractor("fc2")
+               .remove_vertex_and_connections("out")
+               .add_layer("new_out",
+                          nn.OutputLayer(n_in=5, n_out=2,
+                                         activation="softmax", loss="mcxent"),
+                          "fc2")
+               .set_outputs("new_out")
+               .build())
+        # kept params copied
+        np.testing.assert_allclose(np.asarray(new.params["fc1"]["W"]),
+                                   np.asarray(net.params["fc1"]["W"]))
+        fc1_before = np.asarray(new.params["fc1"]["W"]).copy()
+        fc2_before = np.asarray(new.params["fc2"]["W"]).copy()
+        for _ in range(3):
+            new.fit_multi([x], [y])
+        # frozen extractor (fc1, fc2 + ancestors) unchanged; head trained
+        np.testing.assert_allclose(np.asarray(new.params["fc1"]["W"]), fc1_before)
+        np.testing.assert_allclose(np.asarray(new.params["fc2"]["W"]), fc2_before)
+        assert np.isfinite(float(new.score()))
+
+    def test_n_out_replace_fixes_consumer(self):
+        net = self._base_graph()
+        new = (nn.graph_transfer_builder(net)
+               .n_out_replace("fc2", 9)
+               .build())
+        assert new.params["fc2"]["W"].shape == (6, 9)
+        assert new.params["out"]["W"].shape == (9, 3)
+
+    def test_dangling_consumer_raises(self):
+        net = self._base_graph()
+        with pytest.raises(ValueError, match="no longer exists"):
+            (nn.graph_transfer_builder(net)
+             .remove_vertex_and_connections("fc2")
+             .add_layer("head", nn.OutputLayer(n_in=5, n_out=2), "fc2")
+             .build())
+
+
+class TestKerasCustomLayerRegistry:
+    def test_register_custom_layer(self):
+        tf = pytest.importorskip("tensorflow")
+        from deeplearning4j_tpu.imports import (import_keras_model,
+                                                register_custom_layer)
+        from deeplearning4j_tpu.imports.keras_import import KerasLayerMapper
+
+        @register_custom_layer("MyScale")
+        def _my_scale(cfg, weights):
+            return nn.ActivationLayer(activation="identity"), {}
+
+        try:
+            class MyScale(tf.keras.layers.Layer):
+                def call(self, t):
+                    return t
+
+            model = tf.keras.Sequential([
+                tf.keras.layers.Input((4,)),
+                tf.keras.layers.Dense(3, activation="relu"),
+                MyScale(),
+            ])
+            net = import_keras_model(model)
+            x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+            np.testing.assert_allclose(net.output(x),
+                                       model(x, training=False).numpy(),
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            KerasLayerMapper.MAPPERS.pop("MyScale", None)
+
+
+class TestReviewFixRegression:
+    """Regressions for the round-3b review findings."""
+
+    def test_remove_then_readd_keeps_downstream(self):
+        b = (G.graph_builder().seed(5).updater(nn.Sgd(learning_rate=0.1))
+             .add_inputs("in")
+             .set_input_types(**{"in": nn.InputType.feed_forward(4)}))
+        b.add_layer("fc1", nn.DenseLayer(n_out=6, activation="tanh"), "in")
+        b.add_layer("fc2", nn.DenseLayer(n_out=5, activation="tanh"), "fc1")
+        b.add_layer("out", nn.OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "fc2")
+        b.set_outputs("out")
+        net = G.ComputationGraph(b.build()).init()
+        # replace fc1 with a wider layer; fc2/out must SURVIVE (the closure
+        # treats re-added names as available)
+        new = (nn.graph_transfer_builder(net)
+               .remove_vertex_and_connections("fc1")
+               .add_layer("fc1", nn.DenseLayer(n_in=4, n_out=6,
+                                               activation="relu"), "in")
+               .build())
+        assert set(new.layers) == {"fc1", "fc2", "out"}
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        assert new.output_single(x).shape == (2, 3)
+
+    def test_stale_output_raises(self):
+        b = (G.graph_builder().seed(5).updater(nn.Sgd(learning_rate=0.1))
+             .add_inputs("in")
+             .set_input_types(**{"in": nn.InputType.feed_forward(4)}))
+        b.add_layer("out", nn.OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "in")
+        b.set_outputs("out")
+        net = G.ComputationGraph(b.build()).init()
+        with pytest.raises(ValueError, match="set_outputs"):
+            (nn.graph_transfer_builder(net)
+             .remove_vertex_and_connections("out")
+             .add_layer("head", nn.OutputLayer(n_in=4, n_out=2,
+                                               activation="softmax",
+                                               loss="mcxent"), "in")
+             .build())
+
+    def test_glove_export(self, tmp_path):
+        from deeplearning4j_tpu.nlp import GloVe
+        from deeplearning4j_tpu.nlp.serde import (load_static_model,
+                                                  write_word2vec_binary)
+        g = GloVe(layer_size=4, epochs=2, seed=0)
+        g.fit([["red", "green", "blue", "red"]] * 10)
+        p = str(tmp_path / "glove.bin")
+        write_word2vec_binary(g, p)
+        sv = load_static_model(p)
+        np.testing.assert_allclose(sv.word2vec("red"),
+                                   np.asarray(g.W)[g.vocab["red"]],
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_rocbinary_per_output_mask(self):
+        from deeplearning4j_tpu.eval import ROCBinary
+        r = np.random.RandomState(0)
+        labels = (r.rand(32, 4) > 0.5).astype(np.float32)
+        preds = r.rand(32, 4).astype(np.float32)
+        mask = (r.rand(32, 4) > 0.3).astype(np.float32)
+        roc = ROCBinary()
+        roc.eval(labels, preds, mask)  # per-output mask must not crash
+        assert np.isfinite(roc.calculate_average_auc())
+
+    def test_binary_reader_handles_missing_trailing_newline(self, tmp_path):
+        from deeplearning4j_tpu.nlp.serde import read_word2vec_binary
+        p = tmp_path / "nosep.bin"
+        vec = np.asarray([1.0, 2.0], "<f4")
+        # original C tool style: no newline between rows at all
+        p.write_bytes(b"2 2\n" + b"aa " + vec.tobytes() + b"bb " + vec.tobytes())
+        words, mat = read_word2vec_binary(str(p))
+        assert words == ["aa", "bb"]
+        np.testing.assert_allclose(mat, [[1, 2], [1, 2]])
+
+
+class TestSDNamespaces:
+    """sd.image()/linalg()/bitwise()/random() op factories (the reference's
+    code-generated SDImage/SDLinalg/SDBitwise/SDRandom namespaces)."""
+
+    def _sd(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        return SameDiff.create()
+
+    def test_image_resize_and_adjust(self):
+        sd = self._sd()
+        x = sd.placeholder("x", shape=(1, 4, 4, 3))
+        y = sd.image.resize_bilinear(x, 8, 8)
+        z = sd.image.adjust_contrast(y, 1.5)
+        img = np.random.RandomState(0).rand(1, 4, 4, 3).astype(np.float32)
+        out = sd.output({"x": img}, z.name)[z.name]
+        assert out.shape == (1, 8, 8, 3)
+
+    def test_linalg_solve_and_det(self):
+        sd = self._sd()
+        r = np.random.RandomState(1)
+        a_np = r.randn(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b_np = r.randn(3, 2).astype(np.float32)
+        a = sd.constant("a", a_np)
+        b = sd.constant("b", b_np)
+        x = sd.linalg.solve(a, b)
+        d = sd.linalg.matrix_determinant(a)
+        res = sd.output({}, [x.name, d.name])
+        np.testing.assert_allclose(a_np @ res[x.name], b_np, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(res[d.name], np.linalg.det(a_np),
+                                   rtol=1e-4)
+
+    def test_linalg_qr_two_outputs(self):
+        sd = self._sd()
+        a_np = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+        a = sd.constant("a", a_np)
+        q, r_ = sd.linalg.qr(a)
+        res = sd.output({}, [q.name, r_.name])
+        np.testing.assert_allclose(res[q.name] @ res[r_.name], a_np,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bitwise(self):
+        sd = self._sd()
+        a = sd.constant("a", np.asarray([0b1100, 0b1010], np.int32))
+        b = sd.constant("b", np.asarray([0b1010, 0b0110], np.int32))
+        res = sd.output({}, [sd.bitwise.and_(a, b).name,
+                             sd.bitwise.xor(a, b).name,
+                             sd.bitwise.left_shift(a, 2).name])
+        vals = list(res.values())
+        np.testing.assert_array_equal(vals[0], [0b1000, 0b0010])
+        np.testing.assert_array_equal(vals[1], [0b0110, 0b1100])
+        np.testing.assert_array_equal(vals[2], [0b110000, 0b101000])
+
+    def test_random_deterministic_by_seed(self):
+        sd = self._sd()
+        u1 = sd.random.uniform(0.0, 1.0, (16,), seed=7)
+        u2 = sd.random.uniform(0.0, 1.0, (16,), seed=7)
+        n = sd.random.normal(0.0, 1.0, (64,), seed=3)
+        res = sd.output({}, [u1.name, u2.name, n.name])
+        np.testing.assert_array_equal(res[u1.name], res[u2.name])
+        assert res[u1.name].min() >= 0 and res[u1.name].max() <= 1
+        assert abs(float(res[n.name].mean())) < 0.5
+
+    def test_nms_two_outputs(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd = SameDiff.create()
+        boxes = sd.constant("boxes", np.asarray(
+            [[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3]], np.float32))
+        scores = sd.constant("scores", np.asarray([0.9, 0.8, 0.7], np.float32))
+        idx, valid = sd.image.non_max_suppression(boxes, scores, 2,
+                                                  iou_threshold=0.5)
+        res = sd.output({}, [idx.name, valid.name])
+        assert res[idx.name].shape[0] == 2  # indices only, not stacked pair
+        assert res[idx.name][0] == 0
+
+    def test_rocbinary_per_timestep_mask_when_T_equals_nout(self):
+        from deeplearning4j_tpu.eval import ROCBinary
+        r = np.random.RandomState(1)
+        labels = (r.rand(4, 3, 3) > 0.5).astype(np.float32)  # T == nOut == 3
+        preds = r.rand(4, 3, 3).astype(np.float32)
+        mask = np.ones((4, 3), np.float32)  # per-timestep, NOT per-output
+        roc = ROCBinary()
+        roc.eval(labels, preds, mask)  # must not crash
+        assert np.isfinite(roc.calculate_average_auc())
