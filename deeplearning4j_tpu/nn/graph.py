@@ -547,7 +547,10 @@ class ComputationGraph:
 
     # --------------------------------------------------------------- forward
     def _forward(self, params, net_state, inputs: Dict[str, Any], masks,
-                 *, train: bool, rng):
+                 *, train: bool, rng, rnn_states: Optional[Dict[str, Any]] = None):
+        """When ``rnn_states`` is given (node-name → carried RNN state, None
+        for non-recurrent nodes) returns (acts, new_state, new_rnn_states) —
+        the ComputationGraph rnnTimeStep / tBPTT state-threading path."""
         from deeplearning4j_tpu.nn import dtype as DT
 
         with DT.precision_scope(self.conf.dtype):
@@ -557,9 +560,13 @@ class ComputationGraph:
                 cd = DT.compute_dtype(self.conf.dtype)
                 params = DT.cast_floats(params, cd)
                 inputs = DT.cast_floats(inputs, cd)
+                if rnn_states is not None:
+                    rnn_states = DT.cast_floats(rnn_states, cd)
             acts: Dict[str, Any] = dict(inputs)
             act_masks: Dict[str, Any] = dict(masks or {})
             new_state: Dict[str, Any] = {}
+            new_rnn: Optional[Dict[str, Any]] = (
+                {} if rnn_states is not None else None)
             layer_names = [n.name for n in self._order if n.kind == "layer"]
             rngs = (jax.random.split(rng, max(len(layer_names), 1))
                     if rng is not None else [None] * len(layer_names))
@@ -573,6 +580,20 @@ class ComputationGraph:
                 else:
                     layer = self.layers[node.name]
                     mask = act_masks.get(node.inputs[0])
+                    if (rnn_states is not None
+                            and hasattr(layer, "apply_with_state")):
+                        x0 = layer._maybe_dropout(xs[0], train=train,
+                                                  rng=rng_map[node.name])
+                        y, last = layer.apply_with_state(
+                            params[node.name], x0, mask=mask,
+                            initial=rnn_states.get(node.name))
+                        acts[node.name] = y
+                        act_masks[node.name] = mask
+                        new_state[node.name] = net_state[node.name]
+                        new_rnn[node.name] = last
+                        continue
+                    if new_rnn is not None:
+                        new_rnn[node.name] = None
                     if hasattr(layer, "apply_multi"):
                         # parameterized multi-input node (AttentionVertex
                         # role): gets ALL wired inputs; the mask that
@@ -599,6 +620,8 @@ class ComputationGraph:
             if DT.needs_cast(self.conf.dtype):
                 for o in self.conf.network_outputs:  # loss/eval math stays f32
                     acts[o] = DT.cast_floats(acts[o], jnp.float32)
+        if new_rnn is not None:
+            return acts, new_state, new_rnn
         return acts, new_state
 
     def output(self, *inputs, masks=None) -> List[np.ndarray]:
@@ -633,11 +656,28 @@ class ComputationGraph:
             total = total + loss_fn(acts[name], labels[name], lm)
         return total
 
-    def _make_train_step(self):
+    def _apply_updates(self, params, grads, opt_state, step):
+        """Shared update tail (regularization-into-grad, updater math) for
+        the standard and tBPTT step functions."""
         conf = self.conf
         layer_names = [n.name for n in self._order if n.kind == "layer"]
-        updaters = {name: conf.layer_updater(self.layers[name].lc) for name in layer_names}
+        updaters = {name: conf.layer_updater(self.layers[name].lc)
+                    for name in layer_names}
+        updated = apply_layer_updates(
+            conf,
+            ((params[n], grads[n], opt_state[n], updaters[n],
+              self.layers[n].lc) for n in layer_names),
+            step, self._normalize_gradient)
+        new_params = {n: p for n, (p, _) in zip(layer_names, updated)}
+        new_opt = {n: s for n, (_, s) in zip(layer_names, updated)}
+        return new_params, new_opt
 
+    def _reg_penalty(self, params):
+        layer_names = [n.name for n in self._order if n.kind == "layer"]
+        return reg_penalty(
+            self.conf, ((params[n], self.layers[n].lc) for n in layer_names))
+
+    def _make_train_step(self):
         def train_step(params, opt_state, net_state, step, key, feeds, labels,
                        fmasks, lmasks):
             def loss_of(p):
@@ -646,20 +686,141 @@ class ComputationGraph:
                 return self._losses(acts, labels, lmasks), new_state
 
             (loss, new_net_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-            updated = apply_layer_updates(
-                conf,
-                ((params[n], grads[n], opt_state[n], updaters[n], self.layers[n].lc)
-                 for n in layer_names),
-                step, self._normalize_gradient)
-            new_params = {n: p for n, (p, _) in zip(layer_names, updated)}
-            new_opt = {n: s for n, (_, s) in zip(layer_names, updated)}
-            penalty = reg_penalty(
-                conf, ((params[n], self.layers[n].lc) for n in layer_names))
-            return new_params, new_opt, new_net_state, loss + penalty
+            new_params, new_opt = self._apply_updates(params, grads, opt_state, step)
+            return (new_params, new_opt, new_net_state,
+                    loss + self._reg_penalty(params))
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     _normalize_gradient = None  # assigned below (shared with MultiLayerNetwork)
+
+    # ------------------------------------------------------ stateful RNN API
+    def rnn_time_step(self, *inputs, masks=None):
+        """Stateful streaming inference (ComputationGraph.rnnTimeStep):
+        recurrent node states carry across calls in ``self._rnn_states``.
+        Inputs: (N, T, F) per network input — or (N, F) for one step.
+        Returns the network outputs (list, or the single array)."""
+        squeeze = False
+        feeds = {}
+        for name, x in zip(self.conf.network_inputs, inputs):
+            x = np.asarray(x)
+            if x.ndim == 2:
+                x = x[:, None, :]
+                squeeze = True
+            feeds[name] = jnp.asarray(x)
+        batch = next(iter(feeds.values())).shape[0]
+        if getattr(self, "_rnn_states", None) is None:
+            self._rnn_states = self._zero_rnn_states(batch)
+        fn = self._jit_cache.get("rnn_time_step")
+        if fn is None:
+            @jax.jit
+            def fn(params, net_state, rnn_states, feeds, masks):
+                acts, _, new_rnn = self._forward(
+                    params, net_state, feeds, masks, train=False, rng=None,
+                    rnn_states=rnn_states)
+                return [acts[o] for o in self.conf.network_outputs], new_rnn
+
+            self._jit_cache["rnn_time_step"] = fn
+        outs, self._rnn_states = fn(self.params, self.net_state,
+                                    self._rnn_states, feeds, masks)
+        outs = [np.asarray(o) for o in outs]
+        if squeeze:
+            outs = [o[:, -1] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_states = None
+
+    def _zero_rnn_states(self, batch: int, dtype=np.float32):
+        from deeplearning4j_tpu.nn.layers import BidirectionalImpl
+
+        states: Dict[str, Any] = {}
+        for name, layer in self.layers.items():
+            if isinstance(layer, BidirectionalImpl):
+                raise ValueError(
+                    "stateful RNN state (rnn_time_step / tBPTT) is not "
+                    "supported with Bidirectional layers")
+            states[name] = (layer.zero_state(batch, dtype)
+                            if hasattr(layer, "zero_state") else None)
+        return states
+
+    def _make_train_step_tbptt(self):
+        """Truncated-BPTT step (doTruncatedBPTT analog): RNN state enters as
+        an input and leaves as an output — gradients truncate at the segment
+        boundary (see MultiLayerNetwork._make_train_step_tbptt)."""
+        def train_step(params, opt_state, net_state, rnn_states, step, key,
+                       feeds, labels, fmasks, lmasks):
+            def loss_of(p):
+                acts, new_state, new_rnn = self._forward(
+                    p, net_state, feeds, fmasks, train=True, rng=key,
+                    rnn_states=rnn_states)
+                return self._losses(acts, labels, lmasks), (new_state, new_rnn)
+
+            (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt = self._apply_updates(params, grads, opt_state, step)
+            return (new_params, new_opt, new_net_state, new_rnn,
+                    loss + self._reg_penalty(params))
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def fit_tbptt(self, features, labels, masks=None, lmasks=None) -> float:
+        """One truncated-BPTT pass over a time-series batch: slices the time
+        axis into ``conf.tbptt_fwd_length`` segments, carrying RNN state
+        (ComputationGraph.doTruncatedBPTT). Single- or multi-input graphs:
+        pass arrays or name-keyed dicts of (N, T, F) features / (N, T, C)
+        labels."""
+        fwd = self.conf.tbptt_fwd_length
+        if fwd <= 0:
+            raise ValueError("set tbptt lengths on the configuration first")
+        if not isinstance(features, dict):
+            features = {self.conf.network_inputs[0]: features}
+        if not isinstance(labels, dict):
+            labels = {self.conf.network_outputs[0]: labels}
+        if masks is not None and not isinstance(masks, dict):
+            masks = {self.conf.network_inputs[0]: masks}
+        if lmasks is not None and not isinstance(lmasks, dict):
+            lmasks = {self.conf.network_outputs[0]: lmasks}
+        for k, v in labels.items():
+            if np.asarray(v).ndim < 3:
+                raise ValueError(
+                    "tBPTT requires 3-D time-series labels (N, T, C); got "
+                    f"shape {np.shape(v)} for output '{k}'")
+        step_fn = self._jit_cache.get("train_step_tbptt")
+        if step_fn is None:
+            step_fn = self._make_train_step_tbptt()
+            self._jit_cache["train_step_tbptt"] = step_fn
+        T = next(iter(features.values())).shape[1]
+        batch = next(iter(features.values())).shape[0]
+        rnn_states = self._zero_rnn_states(batch)
+        segments = list(range(0, T, fwd))
+        loss = 0.0
+        for i, t0 in enumerate(segments):
+            t1 = min(t0 + fwd, T)
+            seg_f = {k: jnp.asarray(np.asarray(v)[:, t0:t1])
+                     for k, v in features.items()}
+            seg_y = {k: jnp.asarray(np.asarray(v)[:, t0:t1])
+                     for k, v in labels.items()}
+            seg_fm = (None if masks is None else
+                      {k: jnp.asarray(np.asarray(v)[:, t0:t1])
+                       for k, v in masks.items()})
+            seg_lm = (None if lmasks is None else
+                      {k: jnp.asarray(np.asarray(v)[:, t0:t1])
+                       for k, v in lmasks.items()})
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.opt_state, self.net_state, rnn_states,
+             loss) = step_fn(self.params, self.opt_state, self.net_state,
+                             rnn_states,
+                             jnp.asarray(self.iteration_count, jnp.int32),
+                             sub, seg_f, seg_y, seg_fm, seg_lm)
+            self._score = loss
+            if i < len(segments) - 1:
+                self.iteration_count += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count,
+                                   self.epoch_count, loss)
+        self.iteration_count += 1
+        return float(loss)
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32) -> None:
@@ -670,6 +831,23 @@ class ComputationGraph:
             data = ListDataSetIterator(DataSet(data, labels), batch_size=batch_size)
         elif isinstance(data, DataSet):
             data = ListDataSetIterator(data, batch_size=batch_size)
+        tbptt = (self.conf.backprop_type == "tbptt"
+                 and self.conf.tbptt_fwd_length > 0)
+        if tbptt:
+            # truncated-BPTT dispatch (doTruncatedBPTT), as in
+            # MultiLayerNetwork.fit — NOT silent full-sequence BPTT
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self)
+                for ds in data:
+                    self.last_batch_size = ds.num_examples()
+                    self.fit_tbptt(ds.features, ds.labels,
+                                   masks=ds.features_mask,
+                                   lmasks=ds.labels_mask)
+                self.epoch_count += 1
+                for lst in self.listeners:
+                    lst.on_epoch_end(self)
+            return
         step_fn = self._jit_cache.get("train_step")
         if step_fn is None:
             step_fn = self._make_train_step()

@@ -24,3 +24,4 @@ from deeplearning4j_tpu.parallel.ring_attention import (
     ring_attention,
     RingSelfAttention,
 )
+from deeplearning4j_tpu.parallel.ulysses import ulysses_attention

@@ -77,6 +77,8 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "seq",
     ``axis`` and run the ring. Returns the full (BH, T, D) output with the
     same sharding."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    # the experimental module keeps the check_rep kwarg this call relies on;
+    # jax.shard_map (0.8+) renamed/removed it
     from jax.experimental.shard_map import shard_map
 
     spec = P(None, axis, None)

@@ -50,6 +50,8 @@ _PAGE = """<!DOCTYPE html>
  <svg id="score"></svg></div>
 <div class="card"><h2>Update : parameter ratio (log10) — healthy ≈ −3</h2>
  <svg id="ratio"></svg><div id="ratio-legend" class="legend"></div></div>
+<div class="card"><h2>Parameter histograms (latest iteration)</h2>
+ <div id="hists" class="legend">enable StatsListener(collect_histograms=True)</div></div>
 <script>
 const COLORS = ['#1976d2','#d32f2f','#388e3c','#f57c00','#7b1fa2',
                 '#00796b','#5d4037','#455a64','#c2185b','#afb42b'];
@@ -81,12 +83,31 @@ function drawSeries(svgId, seriesMap, legendId) {
   svg.innerHTML = out;
   if (legendId) document.getElementById(legendId).innerHTML = legend.join(' &nbsp; ');
 }
+function drawHists(containerId, byParam) {
+  const names = Object.keys(byParam);
+  if (!names.length) return;
+  const div = document.getElementById(containerId);
+  let out = '';
+  names.forEach((k, i) => {
+    const h = byParam[k], counts = h.counts, mx = Math.max(...counts, 1);
+    const W = 240, H = 80, bw = W / counts.length;
+    const c = COLORS[i % COLORS.length];
+    let bars = counts.map((v, j) =>
+      `<rect x="${j*bw}" y="${H - v/mx*H}" width="${bw-1}" height="${v/mx*H}" fill="${c}"/>`
+    ).join('');
+    out += `<div style="display:inline-block;margin:4px"><div>${k}</div>` +
+           `<svg style="width:${W}px;height:${H}px">${bars}</svg></div>`;
+  });
+  div.innerHTML = out;
+}
 async function refresh() {
   try {
     const ov = await (await fetch('train/overview')).json();
     drawSeries('score', {score: ov.score});
     const m = await (await fetch('train/model')).json();
     drawSeries('ratio', m.update_ratio_log10, 'ratio-legend');
+    const hs = await (await fetch('train/histograms')).json();
+    drawHists('hists', hs.histograms);
   } catch (e) {}
   setTimeout(refresh, 2000);
 }
@@ -149,6 +170,21 @@ class UIServer:
                     [r["iteration"], st.get("norm2", 0.0)])
         return {"update_ratio_log10": ratios, "param_norm2": norms}
 
+    def histograms(self) -> Dict:
+        """Latest iteration's per-parameter histograms (the reference
+        dashboard's parameter/update histogram pane; needs
+        StatsListener(collect_histograms=True))."""
+        recs = self._records()
+        for r in reversed(recs):
+            out = {}
+            for name, st in r.get("layers", {}).items():
+                if "histogram" in st:
+                    out[name] = st["histogram"]
+            if out:
+                return {"iteration": r.get("iteration", 0),
+                        "histograms": out}
+        return {"iteration": -1, "histograms": {}}
+
     def sessions(self) -> Dict:
         return {"sessions": list(range(len(self._storages))),
                 "records": len(self._records())}
@@ -171,6 +207,9 @@ class UIServer:
                     ctype = "application/json"
                 elif path.endswith("/train/model"):
                     body = json.dumps(ui.model()).encode()
+                    ctype = "application/json"
+                elif path.endswith("/train/histograms"):
+                    body = json.dumps(ui.histograms()).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

@@ -341,3 +341,82 @@ class TestSDNamespaces:
         roc = ROCBinary()
         roc.eval(labels, preds, mask)  # must not crash
         assert np.isfinite(roc.calculate_average_auc())
+
+
+class TestGraphStatefulRnn:
+    """ComputationGraph.rnnTimeStep + doTruncatedBPTT analogs."""
+
+    def _rnn_graph(self, tbptt=0):
+        b = (G.graph_builder().seed(9).updater(nn.Sgd(learning_rate=0.05))
+             .add_inputs("in")
+             .set_input_types(**{"in": nn.InputType.recurrent(3, -1)}))
+        b.add_layer("lstm", nn.LSTM(n_in=3, n_out=5, activation="tanh"), "in")
+        b.add_layer("out", nn.RnnOutputLayer(n_in=5, n_out=2,
+                                             activation="softmax",
+                                             loss="mcxent"), "lstm")
+        b.set_outputs("out")
+        conf = b.build()
+        if tbptt:
+            conf.backprop_type = "tbptt"
+            conf.tbptt_fwd_length = tbptt
+            conf.tbptt_back_length = tbptt
+        return G.ComputationGraph(conf).init()
+
+    def test_rnn_time_step_matches_full_sequence(self):
+        net = self._rnn_graph()
+        r = np.random.RandomState(0)
+        x = r.randn(2, 6, 3).astype(np.float32)
+        full = net.output_single(x)  # whole sequence at once
+        net.rnn_clear_previous_state()
+        chunks = [net.rnn_time_step(x[:, :2]), net.rnn_time_step(x[:, 2:4]),
+                  net.rnn_time_step(x[:, 4:])]
+        streamed = np.concatenate(chunks, axis=1)
+        np.testing.assert_allclose(streamed, full, rtol=1e-5, atol=1e-6)
+
+    def test_single_step_squeeze(self):
+        net = self._rnn_graph()
+        x = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+        out = net.rnn_time_step(x)
+        assert out.shape == (2, 2)
+
+    def test_fit_tbptt_trains(self):
+        net = self._rnn_graph(tbptt=3)
+        r = np.random.RandomState(2)
+        x = r.randn(4, 9, 3).astype(np.float32)
+        y = np.eye(2)[r.randint(0, 2, (4, 9))].astype(np.float32)
+        losses = [net.fit_tbptt(x, y) for _ in range(6)]
+        assert np.isfinite(losses[-1])
+        assert losses[-1] < losses[0]
+        # iteration advances per segment (3 segments each) + final
+        assert net.iteration_count == 6 * 3
+
+    def test_fit_tbptt_rejects_2d_labels(self):
+        net = self._rnn_graph(tbptt=3)
+        with pytest.raises(ValueError, match="3-D time-series"):
+            net.fit_tbptt(np.zeros((2, 6, 3), np.float32),
+                          np.zeros((2, 2), np.float32))
+
+    def test_fit_dispatches_tbptt_and_fires_listeners(self):
+        """graph.fit must honor backprop_type='tbptt' (not silent full
+        BPTT), firing listeners per segment; dropout on the recurrent layer
+        must survive the tBPTT path (review findings)."""
+        from deeplearning4j_tpu.nn.listeners import CollectScoresIterationListener
+        net = self._rnn_graph(tbptt=3)
+        r = np.random.RandomState(3)
+        x = r.randn(4, 9, 3).astype(np.float32)
+        y = np.eye(2)[r.randint(0, 2, (4, 9))].astype(np.float32)
+        coll = CollectScoresIterationListener()
+        net.listeners = [coll]
+        net.fit(x, y, epochs=1, batch_size=4)
+        # 9 timesteps / fwd 3 = 3 segments -> 3 listener notifications
+        assert len(coll.scores) == 3
+        assert net.epoch_count == 1
+
+    def test_tbptt_mask_as_plain_array(self):
+        net = self._rnn_graph(tbptt=3)
+        r = np.random.RandomState(4)
+        x = r.randn(2, 6, 3).astype(np.float32)
+        y = np.eye(2)[r.randint(0, 2, (2, 6))].astype(np.float32)
+        m = np.ones((2, 6), np.float32)
+        loss = net.fit_tbptt(x, y, masks=m, lmasks=m)  # plain arrays OK
+        assert np.isfinite(loss)

@@ -79,3 +79,42 @@ class TestUIServer:
             assert json.loads(body)["score"] == []
         finally:
             server.stop()
+
+
+def test_histograms_endpoint():
+    """/train/histograms serves the latest iteration's parameter histograms
+    when StatsListener collects them."""
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    from deeplearning4j_tpu import nn
+    from deeplearning4j_tpu.utils.stats import StatsListener, StatsStorage
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    storage = StatsStorage()
+    server = UIServer(port=0).start()
+    try:
+        server.attach(storage)
+        conf = (nn.builder().seed(3).updater(nn.Sgd(learning_rate=0.1)).list()
+                .layer(nn.DenseLayer(n_out=4, activation="tanh"))
+                .layer(nn.OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(nn.InputType.feed_forward(3)).build())
+        net = nn.MultiLayerNetwork(conf).init()
+        net.set_listeners(StatsListener(storage, collect_histograms=True))
+        r = np.random.RandomState(0)
+        net.fit(r.randn(8, 3).astype(np.float32),
+                np.eye(2)[r.randint(0, 2, 8)].astype(np.float32),
+                batch_size=4)
+        port = server._httpd.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/train/histograms", timeout=5) as rsp:
+            data = json.loads(rsp.read())
+        assert data["iteration"] >= 0
+        assert data["histograms"], "no histograms collected"
+        first = next(iter(data["histograms"].values()))
+        assert len(first["counts"]) == 20
+    finally:
+        server.stop()
