@@ -86,7 +86,18 @@ class IRImporter:
                 raise NotImplementedError(
                     f"op '{node.op_type}' (node {node.name}) has no mapping "
                     f"rule; register one in the {ir.name} dialect table")
-            ins = [produced[n] for n in node.inputs if n in produced]
+            # empty names are ONNX's explicit "optional input absent" slots
+            missing = [n for n in node.inputs if n and n not in produced]
+            if missing:
+                # a silently dropped operand would misalign the positional
+                # `ins` and surface as an arity error far from the cause —
+                # typically an unregistered multi-output slot (e.g. a mapper
+                # that returns fewer outputs than the source op produces)
+                raise ValueError(
+                    f"node '{node.name}' ({node.op_type}) consumes "
+                    f"unresolved input(s) {missing} — its producer's mapping "
+                    f"rule may not register that output slot")
+            ins = [produced[n] for n in node.inputs if n]
             if node.op_type in self.needs_consts:
                 out = rule(sd, ins, node.attrs, node, const_values=const_values)
             else:
@@ -99,6 +110,10 @@ class IRImporter:
                 if o.vtype == "ARRAY" and oname not in sd._vars:
                     o.rename(oname)
                 produced[oname] = o
+            # extra outputs beyond the declared names resolve by slot — the
+            # TF "op:N" addressing (graphdef_to_ir preserves N > 0 slots)
+            for j in range(len(names), len(outs)):
+                produced[f"{node.name}:{j}"] = outs[j]
             # the node's own name also resolves (TF addressing convention)
             produced.setdefault(node.name, outs[0])
         # record the graph IO signature (GraphRunner uses it for default

@@ -496,3 +496,192 @@ class OnnxImporter(IRImporter):
 def import_onnx(path_or_bytes) -> SameDiff:
     """One-call facade (KerasModelImport-style)."""
     return OnnxImporter().run_import(path_or_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Dialect widening, round 3 continued.
+# ---------------------------------------------------------------------------
+
+for _onnx, _sd in [("Tan", "tan"), ("Atan", "atan"), ("Asin", "asin"),
+                   ("Acos", "acos"), ("Sinh", "sinh"), ("Cosh", "cosh")]:
+    ONNX_OP_MAPPERS[_onnx] = _unary(_sd)
+
+for _onnx, _sd in [("Equal", "eq"), ("Greater", "gt"), ("Less", "lt"),
+                   ("And", "boolean_and"), ("Or", "boolean_or"),
+                   ("Xor", "boolean_xor"), ("Mod", "floormod")]:
+    def _bin_rule2(sd, ins, attrs, node, _op=_sd):
+        return sd._record(_op, ins)
+
+    ONNX_OP_MAPPERS[_onnx] = _bin_rule2
+
+ONNX_OP_MAPPERS["ReduceProd"] = _reduce_rule("reduce_prod")
+
+
+def _arg_rule(sd_op):
+    def rule(sd, ins, attrs, node):
+        axis = int(attrs.get("axis", 0))
+        v = sd._record(sd_op, [ins[0]], {"axis": axis})
+        if int(attrs.get("keepdims", 1)):
+            v = sd._record("expand_dims", [v], {"axis": axis})
+        return v
+
+    return rule
+
+
+ONNX_OP_MAPPERS["ArgMax"] = _arg_rule("argmax")
+ONNX_OP_MAPPERS["ArgMin"] = _arg_rule("argmin")
+
+
+@register_onnx_op("Where")
+def _where_onnx(sd, ins, attrs, node):
+    return sd._record("where", ins)
+
+
+@register_onnx_op("Expand")
+def _expand_onnx(sd, ins, attrs, node, const_values=None):
+    shape = [int(s) for s in np.atleast_1d(const_values.get(node.inputs[1]))]
+    in_shape = ins[0].shape
+    if in_shape is None:
+        raise NotImplementedError("Expand on an unknown-rank input")
+    # ONNX Expand broadcasts BIDIRECTIONALLY: out dim = max(in, shape) with
+    # numpy alignment — a shape dim of 1 keeps the input dim
+    aligned = [1] * (len(shape) - len(in_shape)) + [int(d) for d in in_shape]         if len(shape) >= len(in_shape) else list(in_shape)
+    target = list(shape)
+    if len(target) < len(aligned):
+        target = [1] * (len(aligned) - len(target)) + target
+    eff = tuple(max(a, t) for a, t in zip(aligned, target))
+    return sd._record("broadcast_to", [ins[0]], {"shape": eff})
+
+
+@register_onnx_op("Tile")
+def _tile_onnx(sd, ins, attrs, node, const_values=None):
+    reps = const_values.get(node.inputs[1])
+    return sd._record("tile", [ins[0]],
+                      {"reps": tuple(int(r) for r in np.atleast_1d(reps))})
+
+
+@register_onnx_op("Split")
+def _split_onnx(sd, ins, attrs, node, const_values=None):
+    axis = int(attrs.get("axis", 0))
+    sizes = attrs.get("split")
+    if sizes is None and len(node.inputs) > 1:
+        sizes = const_values.get(node.inputs[1])
+        if sizes is None:
+            raise ValueError(
+                f"Split {node.name}: dynamic sizes input unsupported")
+    n = len(node.outputs)
+    if sizes is not None:
+        return sd._record("split_v", [ins[0]],
+                          {"sizes": tuple(int(s) for s in sizes),
+                           "axis": axis}, n_out=n)
+    return sd._record("split", [ins[0]], {"num_split": n, "axis": axis},
+                      n_out=n)
+
+
+@register_onnx_op("Slice")
+def _slice_onnx(sd, ins, attrs, node, const_values=None):
+    # opset ≥ 10: starts/ends/axes/steps as const inputs
+    starts = attrs.get("starts")
+    ends = attrs.get("ends")
+    axes = attrs.get("axes")
+    steps = None
+    if starts is None:
+        starts = const_values.get(node.inputs[1])
+        ends = const_values.get(node.inputs[2])
+        axes = (const_values.get(node.inputs[3])
+                if len(node.inputs) > 3 else None)
+        steps = (const_values.get(node.inputs[4])
+                 if len(node.inputs) > 4 else None)
+    if steps is not None and any(int(s) != 1 for s in np.atleast_1d(steps)):
+        raise NotImplementedError("Slice with steps != 1 import")
+    starts = [int(s) for s in np.atleast_1d(starts)]
+    ends = [int(e) for e in np.atleast_1d(ends)]
+    if axes is not None:
+        # expand axes-addressed starts/ends to full rank (strided_slice is
+        # full-rank); rank comes from the traced input shape
+        shape = ins[0].shape
+        if shape is None:
+            raise NotImplementedError(
+                "Slice with axes on an unknown-rank input")
+        rank = len(shape)
+        b, e = [0] * rank, [2**31 - 1] * rank
+        for a, s_, t_ in zip(np.atleast_1d(axes), starts, ends):
+            b[int(a)], e[int(a)] = s_, t_
+        starts, ends = b, e
+    return sd._record("strided_slice", [ins[0]], {
+        "begin": tuple(starts), "end": tuple(ends)})
+
+
+@register_onnx_op("TopK")
+def _topk_onnx(sd, ins, attrs, node, const_values=None):
+    if not int(attrs.get("largest", 1)):
+        raise NotImplementedError("TopK largest=0 (k-smallest) import")
+    if int(attrs.get("axis", -1)) != -1:
+        raise NotImplementedError("TopK with axis != -1 import")
+    k = attrs.get("k")
+    if k is None:
+        k = const_values.get(node.inputs[1])
+    if k is None:
+        raise ValueError(f"TopK {node.name}: dynamic k input unsupported")
+    return sd._record("top_k", [ins[0]], {"k": int(np.asarray(k).item())},
+                      n_out=2)
+
+
+@register_onnx_op("ConvTranspose")
+def _conv_transpose_onnx(sd, ins, attrs, node, const_values=None):
+    # ONNX is NCHW with OIHW→(in, out) transposed kernels; normalize to our
+    # NHWC/HWIO path the same way the Conv rule does
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    if any(int(p) != pads[0] for p in pads):
+        raise NotImplementedError("asymmetric ConvTranspose pads import")
+    x = _to_nhwc(sd, ins[0])
+    w = sd._record("transpose", [ins[1]], {"axes": (2, 3, 0, 1)})  # (I,O,H,W)→HWIO
+    # ONNX ConvTranspose SCATTERS the kernel as-is; our deconv2d is the
+    # conv-gradient form (spatially flipped kernel) — flip to compensate
+    w = sd._record("reverse", [w], {"axis": (0, 1)})
+    y = sd._record("deconv2d", [x, w] + ([ins[2]] if len(ins) > 2 else []), {
+        "stride": (int(strides[0]), int(strides[1])),
+        "padding": ((int(pads[0]), int(pads[2])), (int(pads[1]), int(pads[3])))
+        if int(pads[0]) else "valid"})
+    return _to_nchw(sd, y)
+
+
+@register_onnx_op("SpaceToDepth")
+def _s2d_onnx(sd, ins, attrs, node):
+    x = _to_nhwc(sd, ins[0])
+    y = sd._record("space_to_depth", [x],
+                   {"block_size": int(attrs["blocksize"])})
+    return _to_nchw(sd, y)
+
+
+@register_onnx_op("DepthToSpace")
+def _d2s_onnx(sd, ins, attrs, node):
+    if attrs.get("mode", b"DCR") not in (b"DCR", "DCR"):
+        raise NotImplementedError("DepthToSpace CRD mode import")
+    x = _to_nhwc(sd, ins[0])
+    y = sd._record("depth_to_space", [x],
+                   {"block_size": int(attrs["blocksize"])})
+    return _to_nchw(sd, y)
+
+
+@register_onnx_op("InstanceNormalization")
+def _instance_norm_onnx(sd, ins, attrs, node):
+    eps = float(attrs.get("epsilon", 1e-5))
+    x, scale, bias = ins
+    # NCHW: normalize each (instance, channel) over spatial dims
+    mean = sd._record("reduce_mean", [x], {"axes": (2, 3), "keepdims": True})
+    d = sd._record("sub", [x, mean])
+    var = sd._record("reduce_mean",
+                     [sd._record("square", [d])],
+                     {"axes": (2, 3), "keepdims": True})
+    denom = sd._record("sqrt", [sd._record(
+        "add", [var, sd.constant(node.name + "_eps",
+                                 np.asarray(eps, np.float32))])])
+    xhat = sd._record("div", [d, denom])
+    sc = sd._record("reshape", [scale], {"shape": (1, -1, 1, 1)})
+    bi = sd._record("reshape", [bias], {"shape": (1, -1, 1, 1)})
+    return sd._record("add", [sd._record("mul", [xhat, sc]), bi])
+
+
+_NEEDS_CONSTS |= {"Expand", "Tile", "Split", "Slice", "TopK", "ConvTranspose"}

@@ -106,7 +106,8 @@ for _tf, _ours in [
     ("Square", "square"), ("Abs", "abs"), ("Neg", "neg"), ("Sign", "sign"),
     ("Floor", "floor"), ("Ceil", "ceil"), ("Round", "round"),
     ("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"), ("Erf", "erf"),
-    ("Reciprocal", "reciprocal"),
+    ("Reciprocal", "reciprocal"), ("Atan", "atan"), ("Asin", "asin"),
+    ("Acos", "acos"), ("Sinh", "sinh"), ("Cosh", "cosh"),
 ]:
     def _make(ours):
         def f(sd, ins, attrs, node):
@@ -167,6 +168,12 @@ def _squeeze(sd, ins, attrs, node):
 @register_tf_op("ConcatV2")
 def _concat(sd, ins, attrs, node, const_values=None):
     axis = const_values.get(node.input[-1])
+    data_ins = [i for i in node.input[:-1] if not i.startswith("^")]
+    if all(n in const_values for n in data_ins):
+        # const-fold shape chains (Fill/Range → Concat → Reshape)
+        const_values[node.name] = np.concatenate(
+            [np.atleast_1d(const_values[n]) for n in data_ins],
+            axis=int(axis))
     return sd._record("concat", ins[:-1], {"axis": int(axis)})
 
 
@@ -234,16 +241,27 @@ def _avgpool(sd, ins, attrs, node):
 
 
 @register_tf_op("Cast")
-def _cast(sd, ins, attrs, node):
+def _cast(sd, ins, attrs, node, const_values=None):
     import tensorflow as tf
 
     dst = attrs.get("DstT")
     np_dtype = tf.dtypes.as_dtype(dst).as_numpy_dtype if dst is not None else np.float32
+    if const_values is not None and node.input[0] in const_values:
+        # constant-fold: shape/limit chains (e.g. Range's Cast'ed bounds)
+        # stay resolvable as const operands downstream
+        folded = np.asarray(const_values[node.input[0]]).astype(np_dtype)
+        const_values[node.name] = folded
     return sd._record("cast", ins, {"dtype": str(np.dtype(np_dtype))})
 
 
 @register_tf_op("Pack")
-def _pack(sd, ins, attrs, node):
+def _pack(sd, ins, attrs, node, const_values=None):
+    data_ins = [i for i in node.input if not i.startswith("^")]
+    if const_values is not None and all(n in const_values for n in data_ins):
+        # const-fold shape chains (scalar dims → Pack → Reshape)
+        const_values[node.name] = np.stack(
+            [np.asarray(const_values[n]) for n in data_ins],
+            axis=int(attrs.get("axis", 0)))
     return sd._record("stack", ins, {"axis": int(attrs.get("axis", 0))})
 
 
@@ -442,7 +460,7 @@ def _tf_pool3d_unsupported(sd, ins, attrs, node):
 
 _CONST_ONLY_OPS = {"Const", "Placeholder", "PlaceholderWithDefault"}
 # mappers that need raw const operand values (shape/perm/axis inputs)
-_NEEDS_CONSTS = {"Reshape", "Transpose", "ExpandDims", "ConcatV2", "Mean",
+_NEEDS_CONSTS = {"Cast", "Pack", "Reshape", "Transpose", "ExpandDims", "ConcatV2", "Mean",
                  "Sum", "Max", "Min", "Prod", "GatherV2", "Tile", "Pad",
                  "PadV2", "StridedSlice", "ArgMax", "ArgMin", "ClipByValue",
                  "Cumsum"}
@@ -472,7 +490,19 @@ def graphdef_to_ir(graph_def) -> "IRGraph":
             inputs.append((node.name, shape))
             continue
         attrs = {k: _attr_value(v) for k, v in node.attr.items()}
-        in_names = [i.split(":")[0].lstrip("^") for i in node.input]
+
+        def norm(i):
+            # keep multi-output slot addressing ("op:1"); the default ":0"
+            # slot normalizes to the bare name
+            if ":" in i:
+                base, slot = i.rsplit(":", 1)
+                if slot == "0":
+                    return base
+            return i
+
+        # control-dep inputs ("^name") are ordering-only — XLA's dataflow
+        # subsumes them; they are NOT data operands
+        in_names = [norm(i) for i in node.input if not i.startswith("^")]
         nodes.append(IRNode(name=node.name, op_type=node.op,
                             inputs=in_names, outputs=[node.name],
                             attrs=attrs))
@@ -545,3 +575,147 @@ def _attr_value(v):
 def import_frozen_graph(path_or_bytes) -> SameDiff:
     """Convenience one-call import (KerasModelImport-style facade)."""
     return TensorflowImporter().run_import(path_or_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Dialect widening, round 3 continued: shape/indexing + math + image ops.
+# ---------------------------------------------------------------------------
+
+
+@register_tf_op("Split")
+def _split(sd, ins, attrs, node, const_values=None):
+    # TF Split: (axis, value); num_split is an attr
+    axis = const_values.get(node.input[0])
+    n = int(attrs.get("num_split"))
+    return sd._record("split", [ins[-1]],
+                      {"num_split": n, "axis": int(axis)}, n_out=n)
+
+
+@register_tf_op("SplitV")
+def _split_v(sd, ins, attrs, node, const_values=None):
+    sizes = const_values.get(node.input[1])
+    axis = const_values.get(node.input[2])
+    sizes = tuple(int(s) for s in np.atleast_1d(sizes))
+    return sd._record("split_v", [ins[0]],
+                      {"sizes": sizes, "axis": int(axis)},
+                      n_out=len(sizes))
+
+
+@register_tf_op("OneHot")
+def _one_hot(sd, ins, attrs, node, const_values=None):
+    depth = const_values.get(node.input[1])
+    on = const_values.get(node.input[2]) if len(node.input) > 2 else None
+    off = const_values.get(node.input[3]) if len(node.input) > 3 else None
+    if int(attrs.get("axis", -1)) != -1:
+        raise NotImplementedError("OneHot with axis != -1 import")
+    oh = sd._record("one_hot_graph", [ins[0]], {"depth": int(depth)})
+    on_v = 1.0 if on is None else float(np.asarray(on).item())
+    off_v = 0.0 if off is None else float(np.asarray(off).item())
+    if on_v == 1.0 and off_v == 0.0:
+        return oh
+    # label-smoothing style: off + (on - off) * onehot
+    scaled = sd._record("mul", [oh, sd.constant(
+        node.name + "_scale", np.asarray(on_v - off_v, np.float32))])
+    return sd._record("add", [scaled, sd.constant(
+        node.name + "_off", np.asarray(off_v, np.float32))])
+
+
+@register_tf_op("Range")
+def _range(sd, ins, attrs, node, const_values=None):
+    start = const_values.get(node.input[0])
+    limit = const_values.get(node.input[1])
+    delta = const_values.get(node.input[2], 1)
+    arr = np.arange(np.asarray(start).item(), np.asarray(limit).item(),
+                    np.asarray(delta).item())
+    const_values[node.name] = arr  # keep shape chains const-resolvable
+    return sd.constant(node.name + "_range", arr)
+
+
+@register_tf_op("Fill")
+def _fill(sd, ins, attrs, node, const_values=None):
+    dims = const_values.get(node.input[0])
+    value = const_values.get(node.input[1])
+    arr = np.full(tuple(int(d) for d in np.atleast_1d(dims)),
+                  np.asarray(value).item())
+    const_values[node.name] = arr  # keep shape chains const-resolvable
+    return sd.constant(node.name + "_fill", arr)
+
+
+@register_tf_op("Slice")
+def _slice(sd, ins, attrs, node, const_values=None):
+    begin = const_values.get(node.input[1])
+    size = const_values.get(node.input[2])
+    return sd._record("slice", [ins[0]],
+                      {"begin": tuple(int(b) for b in np.atleast_1d(begin)),
+                       "size": tuple(int(s) for s in np.atleast_1d(size))})
+
+
+@register_tf_op("BroadcastTo")
+def _broadcast_to(sd, ins, attrs, node, const_values=None):
+    shape = const_values.get(node.input[1])
+    return sd._record("broadcast_to", [ins[0]],
+                      {"shape": tuple(int(s) for s in np.atleast_1d(shape))})
+
+
+@register_tf_op("FloorDiv")
+def _floordiv(sd, ins, attrs, node):
+    return sd._record("floordiv", ins)
+
+
+@register_tf_op("FloorMod")
+def _floormod(sd, ins, attrs, node):
+    return sd._record("floormod", ins)
+
+
+@register_tf_op("Atan2")
+def _atan2(sd, ins, attrs, node):
+    return sd._record("atan2", ins)
+
+
+@register_tf_op("SpaceToDepth")
+def _space_to_depth(sd, ins, attrs, node):
+    fmt = attrs.get("data_format", b"NHWC")
+    fmt = fmt.decode() if isinstance(fmt, bytes) else str(fmt)
+    return sd._record("space_to_depth", ins,
+                      {"block_size": int(attrs["block_size"]),
+                       "data_format": fmt})
+
+
+@register_tf_op("DepthToSpace")
+def _depth_to_space(sd, ins, attrs, node):
+    fmt = attrs.get("data_format", b"NHWC")
+    fmt = fmt.decode() if isinstance(fmt, bytes) else str(fmt)
+    return sd._record("depth_to_space", ins,
+                      {"block_size": int(attrs["block_size"]),
+                       "data_format": fmt})
+
+
+@register_tf_op("ResizeBilinear")
+def _resize_bilinear_tf(sd, ins, attrs, node, const_values=None):
+    if not bool(attrs.get("half_pixel_centers", False)):
+        raise NotImplementedError(
+            "legacy ResizeBilinear (half_pixel_centers=false) import — "
+            "re-export with tf.image.resize (TF2 semantics)")
+    size = const_values.get(node.input[1])
+    return sd._record("resize_bilinear", [ins[0]],
+                      {"size": tuple(int(s) for s in np.atleast_1d(size))})
+
+
+@register_tf_op("ResizeNearestNeighbor")
+def _resize_nn_tf(sd, ins, attrs, node, const_values=None):
+    size = const_values.get(node.input[1])
+    return sd._record("resize_nearest_neighbor", [ins[0]],
+                      {"size": tuple(int(s) for s in np.atleast_1d(size))})
+
+
+_NEEDS_CONSTS |= {"Split", "SplitV", "OneHot", "Range", "Fill", "Slice",
+                  "BroadcastTo", "ResizeBilinear", "ResizeNearestNeighbor"}
+
+
+@register_tf_op("TopKV2")
+def _topk(sd, ins, attrs, node, const_values=None):
+    k = const_values.get(node.input[1])
+    return sd._record("top_k", [ins[0]], {"k": int(k)}, n_out=2)
+
+
+_NEEDS_CONSTS.add("TopKV2")

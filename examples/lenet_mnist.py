@@ -1,0 +1,54 @@
+"""LeNet-5 on MNIST — the canonical first example (dl4j-examples
+MnistClassifier role): build a conf, fit, evaluate.
+
+Run: python examples/lenet_mnist.py  (uses the local MNIST files when
+present, else a deterministic synthetic fallback — no downloads)."""
+
+import itertools
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.eval import Evaluation
+
+
+def main():
+    batch = 128
+    train_iter = MnistDataSetIterator(batch, train=True)
+    test_iter = MnistDataSetIterator(batch, train=False)
+    # EXAMPLE_MAX_BATCHES caps the run for smoke tests/CI; unset = full epoch
+    cap = int(os.environ.get("EXAMPLE_MAX_BATCHES", "0"))
+    if cap:
+        train_iter = list(itertools.islice(iter(train_iter), cap))
+        test_iter = list(itertools.islice(iter(test_iter), cap))
+
+    conf = (nn.builder()
+            .seed(123)
+            .updater(nn.Adam(learning_rate=1e-3))
+            .list()
+            .layer(nn.ConvolutionLayer(n_out=20, kernel=(5, 5),
+                                       activation="relu"))
+            .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(nn.ConvolutionLayer(n_out=50, kernel=(5, 5),
+                                       activation="relu"))
+            .layer(nn.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(nn.DenseLayer(n_out=500, activation="relu"))
+            .layer(nn.OutputLayer(n_out=10, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.convolutional_flat(28, 28, 1))
+            .build())
+    net = nn.MultiLayerNetwork(conf).init()
+    net.set_listeners(nn.ScoreIterationListener(50))
+
+    net.fit(train_iter, epochs=1)
+
+    ev = Evaluation()
+    for ds in test_iter:
+        ev.eval(ds.labels, net.output(ds.features))
+    print(ev.stats())
+
+
+if __name__ == "__main__":
+    main()

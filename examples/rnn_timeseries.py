@@ -1,0 +1,45 @@
+"""Recurrent nets: LSTM sequence classification with truncated BPTT and
+streaming inference (dl4j-examples UCISequenceClassification role).
+
+Run: python examples/rnn_timeseries.py"""
+
+import numpy as np
+
+from deeplearning4j_tpu import nn
+
+
+def make_data(n=128, t=24, f=3, seed=0):
+    """Toy task: classify whether the first feature's mean is positive."""
+    r = np.random.RandomState(seed)
+    x = r.randn(n, t, f).astype(np.float32)
+    x[:, :, 0] += np.where(r.rand(n) > 0.5, 0.8, -0.8)[:, None]
+    y = np.eye(2)[(x[:, :, 0].mean(1) > 0).astype(int)].astype(np.float32)
+    # per-timestep labels for the RnnOutputLayer
+    return x, np.repeat(y[:, None, :], t, axis=1)
+
+
+def main():
+    conf = (nn.builder()
+            .seed(42)
+            .updater(nn.Adam(learning_rate=5e-3))
+            .list()
+            .layer(nn.LSTM(n_out=16, activation="tanh"))
+            .layer(nn.RnnOutputLayer(n_out=2, activation="softmax",
+                                     loss="mcxent"))
+            .set_input_type(nn.InputType.recurrent(3, 24))
+            .tbptt(8, 8)  # truncated BPTT, 8-step segments
+            .build())
+    net = nn.MultiLayerNetwork(conf).init()
+
+    x, y = make_data()
+    net.fit(x, y, epochs=3, batch_size=32)
+    print("training score:", float(net.score()))
+
+    # streaming inference: feed one step at a time, state carries over
+    net.rnn_clear_previous_state()
+    stream = [net.rnn_time_step(x[:4, i]) for i in range(6)]
+    print("streamed 6 steps; last-step output shape:", stream[-1].shape)
+
+
+if __name__ == "__main__":
+    main()

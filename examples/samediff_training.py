@@ -1,0 +1,41 @@
+"""SameDiff: define-then-run graph with autodiff training — the
+SameDiff MNIST-MLP example role (quickstart for the sd API)."""
+
+import numpy as np
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+
+
+def main():
+    r = np.random.RandomState(0)
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 8))
+    labels = sd.placeholder("labels", shape=(None, 3))
+    w0 = sd.var("w0", r.randn(8, 16).astype(np.float32) * 0.2)
+    b0 = sd.var("b0", np.zeros(16, np.float32))
+    w1 = sd.var("w1", r.randn(16, 3).astype(np.float32) * 0.2)
+    h = sd.nn.relu(x @ w0 + b0)
+    logits = h @ w1
+    loss = sd.loss.softmax_cross_entropy(logits, labels)
+
+    sd.set_training_config(TrainingConfig(
+        updater=nn.Adam(learning_rate=1e-2),
+        data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"],
+        loss_variables=[loss.name]))
+
+    xs = r.randn(256, 8).astype(np.float32)
+    ys = np.eye(3)[(xs[:, 0] > 0).astype(int)
+                   + (xs[:, 1] > 0)].astype(np.float32)
+    hist = sd.fit(ListDataSetIterator(DataSet(xs, ys), batch_size=64),
+                  epochs=20)
+    print("loss first -> last:", round(hist[0], 4), "->", round(hist[-1], 4))
+
+    out = sd.output({"x": xs[:4]}, logits.name)[logits.name]
+    print("logits shape:", out.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Smoke-run the examples (dl4j-examples role): each must execute
+end-to-end on the CPU harness within example-scale budgets."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(name, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["EXAMPLE_MAX_BATCHES"] = "5"  # smoke scale; users run full scale
+    proc = subprocess.run([sys.executable, os.path.join(EXAMPLES, name)],
+                          cwd=REPO, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    return proc.stdout
+
+
+class TestExamples:
+    def test_transfer_learning(self):
+        out = _run("transfer_learning.py")
+        assert "frozen backbone unchanged: True" in out
+
+    def test_rnn_timeseries(self):
+        out = _run("rnn_timeseries.py")
+        assert "streamed 6 steps" in out
+
+    def test_distributed_data_parallel(self):
+        out = _run("distributed_data_parallel.py")
+        assert "trained over 8 devices" in out
+
+    def test_samediff_training(self):
+        out = _run("samediff_training.py")
+        assert "loss first -> last" in out
+
+    def test_bert_finetune(self):
+        out = _run("bert_finetune.py")
+        assert "MLM loss" in out
+
+    def test_model_import(self):
+        pytest.importorskip("tensorflow")
+        out = _run("model_import.py")
+        assert "GraphRunner outputs" in out
+
+    def test_lenet_mnist_runs(self):
+        out = _run("lenet_mnist.py", timeout=560)
+        assert "Accuracy" in out or "accuracy" in out

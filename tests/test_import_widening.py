@@ -1,0 +1,258 @@
+"""Golden tests for the round-3 dialect widening: TF 86→106 ops (incl.
+multi-output slot addressing), ONNX 60→86 ops."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from tests.test_tf_import import freeze
+from tests.test_onnx_import import build_model, node_proto
+from deeplearning4j_tpu.imports import (TensorflowImporter, import_onnx)
+
+
+def _run_tf(fn, specs, feeds_np, rtol=1e-5, atol=1e-6):
+    gd, ins, outs = freeze(fn, *specs)
+    golden = [np.asarray(t) for t in
+              (fn(*[tf.constant(v) for v in feeds_np]),)]
+    sd = TensorflowImporter().run_import(gd)
+    got = sd.output(dict(zip(ins, feeds_np)), outs[0])[outs[0]]
+    np.testing.assert_allclose(got, golden[0], rtol=rtol, atol=atol)
+
+
+class TestTfWidening:
+    def test_split_multi_output_slots(self):
+        r = np.random.RandomState(0)
+        x = r.randn(2, 6).astype(np.float32)
+
+        def model(t):
+            a, b, c = tf.split(t, 3, axis=1)
+            return a + 2.0 * b - c  # consumes slots :0 :1 :2
+
+        _run_tf(model, [tf.TensorSpec([None, 6], tf.float32)], [x])
+
+    def test_topk_values_and_indices(self):
+        r = np.random.RandomState(1)
+        x = r.randn(3, 8).astype(np.float32)
+
+        def model(t):
+            vals, idx = tf.math.top_k(t, k=3)
+            return vals + tf.cast(idx, tf.float32)
+
+        _run_tf(model, [tf.TensorSpec([None, 8], tf.float32)], [x])
+
+    def test_trig_and_floor_ops(self):
+        r = np.random.RandomState(2)
+        x = (r.rand(4, 5).astype(np.float32) - 0.5)
+
+        def model(t):
+            return (tf.atan(t) + tf.asin(t) + tf.acos(t) + tf.sinh(t)
+                    + tf.cosh(t) + tf.atan2(t, t + 2.0))
+
+        _run_tf(model, [tf.TensorSpec([None, 5], tf.float32)], [x],
+                rtol=1e-4, atol=1e-5)
+
+    def test_floordiv_mod(self):
+        x = np.asarray([[7.0, -7.0, 5.0]], np.float32)
+
+        def model(t):
+            return tf.math.floordiv(t, 2.0) + tf.math.floormod(t, 3.0)
+
+        _run_tf(model, [tf.TensorSpec([None, 3], tf.float32)], [x])
+
+    def test_slice_fill_range_broadcast(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+
+        def model(t):
+            s = tf.slice(t, [0, 1, 0], [2, 2, 4])
+            f = tf.fill([2, 2, 4], 0.5)
+            rng = tf.range(4.0)
+            return s + f + tf.broadcast_to(rng, [2, 2, 4])
+
+        _run_tf(model, [tf.TensorSpec([2, 3, 4], tf.float32)], [x])
+
+    def test_one_hot(self):
+        ids = np.asarray([[0, 2, 1]], np.int32)
+
+        def model(t):
+            return tf.one_hot(t, 4)
+
+        _run_tf(model, [tf.TensorSpec([None, 3], tf.int32)], [ids])
+
+    def test_space_depth_round_trip(self):
+        x = np.random.RandomState(3).rand(1, 4, 4, 2).astype(np.float32)
+
+        def model(t):
+            return tf.nn.depth_to_space(tf.nn.space_to_depth(t, 2), 2)
+
+        _run_tf(model, [tf.TensorSpec([1, 4, 4, 2], tf.float32)], [x])
+
+    def test_resize_bilinear(self):
+        x = np.random.RandomState(4).rand(1, 4, 4, 3).astype(np.float32)
+
+        def model(t):
+            # TF2 resize (half-pixel centers — the convention our
+            # resize_bilinear op implements)
+            return tf.image.resize(t, [8, 8], method="bilinear")
+
+        _run_tf(model, [tf.TensorSpec([1, 4, 4, 3], tf.float32)], [x],
+                rtol=1e-4, atol=1e-4)
+
+
+class TestOnnxWidening:
+    def _run(self, nodes, inputs, outputs, inits, feeds, out_name):
+        model = build_model(nodes, inputs, outputs, inits)
+        sd = import_onnx(bytes(model))
+        return sd.output(feeds, out_name)[out_name]
+
+    def test_split_multi_output(self):
+        r = np.random.RandomState(0)
+        x = r.randn(2, 6).astype(np.float32)
+        nodes = [node_proto("Split", ["x"], ["a", "b", "c"], axis=1),
+                 node_proto("Sub", ["a", "c"], ["y"])]
+        got = self._run(nodes, [("x", (2, 6))], [("y", (2, 2))], {},
+                        {"x": x}, "y")
+        np.testing.assert_allclose(got, x[:, 0:2] - x[:, 4:6], rtol=1e-6)
+
+    def test_topk(self):
+        x = np.asarray([[3.0, 1.0, 4.0, 1.5]], np.float32)
+        nodes = [node_proto("TopK", ["x"], ["v", "i"], k=2)]
+        got = self._run(nodes, [("x", (1, 4))], [("v", (1, 2))], {},
+                        {"x": x}, "v")
+        np.testing.assert_allclose(got, [[4.0, 3.0]])
+
+    def test_comparison_where(self):
+        a = np.asarray([1.0, 5.0, 3.0], np.float32)
+        b = np.asarray([2.0, 2.0, 3.0], np.float32)
+        nodes = [node_proto("Greater", ["a", "b"], ["m"]),
+                 node_proto("Where", ["m", "a", "b"], ["y"])]
+        got = self._run(nodes, [("a", (3,)), ("b", (3,))], [("y", (3,))],
+                        {}, {"a": a, "b": b}, "y")
+        np.testing.assert_allclose(got, np.maximum(a, b))
+
+    def test_expand_tile(self):
+        x = np.asarray([[1.0], [2.0]], np.float32)
+        nodes = [node_proto("Tile", ["x", "reps"], ["y"])]
+        got = self._run(nodes, [("x", (2, 1))], [("y", (2, 3))],
+                        {"reps": np.asarray([1, 3], np.int64)},
+                        {"x": x}, "y")
+        np.testing.assert_allclose(got, np.tile(x, (1, 3)))
+
+    def test_slice_with_axes(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        nodes = [node_proto("Slice", ["x", "st", "en", "ax"], ["y"])]
+        got = self._run(nodes, [("x", (2, 3, 4))], [("y", (2, 2, 4))],
+                        {"st": np.asarray([1], np.int64),
+                         "en": np.asarray([3], np.int64),
+                         "ax": np.asarray([1], np.int64)},
+                        {"x": x}, "y")
+        np.testing.assert_allclose(got, x[:, 1:3, :])
+
+    def test_argmax_keepdims(self):
+        x = np.asarray([[1.0, 9.0, 2.0], [5.0, 0.0, 3.0]], np.float32)
+        nodes = [node_proto("ArgMax", ["x"], ["y"], axis=1, keepdims=1)]
+        got = self._run(nodes, [("x", (2, 3))], [("y", (2, 1))], {},
+                        {"x": x}, "y")
+        np.testing.assert_array_equal(np.asarray(got).reshape(-1), [1, 0])
+
+    def test_instance_normalization(self):
+        r = np.random.RandomState(5)
+        x = r.randn(2, 3, 4, 4).astype(np.float32)
+        scale = r.rand(3).astype(np.float32) + 0.5
+        bias = r.randn(3).astype(np.float32)
+        nodes = [node_proto("InstanceNormalization",
+                            ["x", "scale", "bias"], ["y"], epsilon=1e-5)]
+        got = self._run(nodes, [("x", (2, 3, 4, 4))], [("y", (2, 3, 4, 4))],
+                        {"scale": scale, "bias": bias}, {"x": x}, "y")
+        mean = x.mean(axis=(2, 3), keepdims=True)
+        var = x.var(axis=(2, 3), keepdims=True)
+        want = ((x - mean) / np.sqrt(var + 1e-5)
+                * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_space_to_depth_nchw(self):
+        x = np.random.RandomState(6).rand(1, 2, 4, 4).astype(np.float32)
+        nodes = [node_proto("SpaceToDepth", ["x"], ["y"], blocksize=2)]
+        got = self._run(nodes, [("x", (1, 2, 4, 4))], [("y", (1, 8, 2, 2))],
+                        {}, {"x": x}, "y")
+        assert got.shape == (1, 8, 2, 2)
+
+
+class TestReviewFixes:
+    """Regressions for the widening-review findings."""
+
+    def test_conv_transpose_channels(self):
+        # C_in != C_out exercises the kernel-layout fix
+        r = np.random.RandomState(0)
+        w = r.randn(3, 5, 2, 2).astype(np.float32)  # (C_in, C_out, kH, kW)
+        x = r.randn(1, 3, 4, 4).astype(np.float32)
+        nodes = [node_proto("ConvTranspose", ["x", "w"], ["y"],
+                            strides=[2, 2])]
+        model = build_model(nodes, [("x", (1, 3, 4, 4))],
+                            [("y", (1, 5, 8, 8))], {"w": w})
+        from deeplearning4j_tpu.imports import import_onnx
+        sd = import_onnx(bytes(model))
+        got = sd.output({"x": x}, "y")["y"]
+        assert got.shape == (1, 5, 8, 8)
+        # oracle: scatter each input pixel through the kernel
+        want = np.zeros((1, 5, 8, 8), np.float32)
+        for i in range(4):
+            for j in range(4):
+                for ci in range(3):
+                    want[0, :, 2*i:2*i+2, 2*j:2*j+2] += (
+                        x[0, ci, i, j] * w[ci])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_onehot_on_off_values(self):
+        ids = np.asarray([[0, 2]], np.int32)
+
+        def model(t):
+            return tf.one_hot(t, 3, on_value=0.9, off_value=0.05)
+
+        _run_tf(model, [tf.TensorSpec([None, 2], tf.int32)], [ids])
+
+    def test_fill_feeds_reshape(self):
+        """Fill/Range outputs const-fold through Concat into Reshape's
+        shape operand (the shape-chain class the review flagged)."""
+        x = np.arange(6, dtype=np.float32).reshape(1, 6)
+
+        def model(t):
+            shape = tf.fill([1], 6)
+            return tf.reshape(t, tf.concat([tf.fill([1], 1), shape], 0)) * 2.0
+
+        _run_tf(model, [tf.TensorSpec([1, 6], tf.float32)], [x])
+
+    def test_onnx_topk_largest0_raises(self):
+        x = np.zeros((1, 4), np.float32)
+        nodes = [node_proto("TopK", ["x"], ["v", "i"], k=2, largest=0)]
+        model = build_model(nodes, [("x", (1, 4))], [("v", (1, 2))], {})
+        from deeplearning4j_tpu.imports import import_onnx
+        with pytest.raises(NotImplementedError, match="largest"):
+            import_onnx(bytes(model))
+
+    def test_onnx_expand_bidirectional(self):
+        x = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+        nodes = [node_proto("Expand", ["x", "shape"], ["y"])]
+        model = build_model(nodes, [("x", (2, 3))], [("y", (2, 3))],
+                            {"shape": np.asarray([2, 1], np.int64)})
+        from deeplearning4j_tpu.imports import import_onnx
+        sd = import_onnx(bytes(model))
+        got = sd.output({"x": x}, "y")["y"]
+        np.testing.assert_allclose(got, x)  # dim 1 keeps the input dim
+
+    def test_unresolved_slot_raises_clearly(self):
+        from deeplearning4j_tpu.imports.ir import IRGraph, IRImporter, IRNode
+
+        def one_out(sd, ins, attrs, node):
+            return sd.constant(node.name + "_c", np.zeros(2, np.float32))
+
+        def binop(sd, ins, attrs, node):
+            return sd._record("add", ins)
+
+        ir = IRGraph(
+            nodes=[IRNode("p", "Producer", [], ["p"], {}),
+                   IRNode("c", "Add", ["p", "p:1"], ["c"], {})],
+            initializers={}, inputs=[], outputs=["c"], name="test")
+        imp = IRImporter({"Producer": one_out, "Add": binop})
+        with pytest.raises(ValueError, match="unresolved input"):
+            imp.run_import(ir)
