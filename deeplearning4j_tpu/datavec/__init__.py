@@ -20,6 +20,12 @@ from deeplearning4j_tpu.datavec.readers import (
     CSVSequenceRecordReader,
     ParallelTransformExecutor,
 )
+from deeplearning4j_tpu.datavec.audio import (
+    WavFileRecordReader,
+    read_wav,
+    write_wav,
+    spectrogram,
+)
 from deeplearning4j_tpu.datavec.columnar import (
     ColumnarBatch,
     to_columnar,

@@ -1,0 +1,115 @@
+"""Audio record reading — the datavec-data-audio role.
+
+Reference parity: datavec-data-audio wraps musicg/jlayer to read WAV files
+and extract spectrogram/fingerprint features
+(org/datavec/audio/recordreader/WavFileRecordReader.java,
+audio/extension/Spectrogram.java). Here: stdlib ``wave`` PCM decoding and
+numpy STFT features — no native audio stack needed for the same surface.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import wave
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+
+def read_wav(source: Union[str, bytes]) -> Tuple[np.ndarray, int]:
+    """Decode a PCM WAV file → (float32 samples in [-1, 1] shaped
+    (frames, channels), sample_rate)."""
+    if isinstance(source, (bytes, bytearray)):
+        f = wave.open(io.BytesIO(source), "rb")
+    else:
+        f = wave.open(source, "rb")
+    with f:
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        rate = f.getframerate()
+        raw = f.readframes(n)
+    if width == 2:
+        arr = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    elif width == 1:  # unsigned 8-bit
+        arr = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    elif width == 4:
+        arr = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    return arr.reshape(-1, ch), rate
+
+
+def write_wav(path: str, samples: np.ndarray, rate: int) -> None:
+    """float32 [-1, 1] (frames,) or (frames, channels) → 16-bit PCM WAV.
+    2-D input is taken EXACTLY as (frames, channels) — no orientation
+    guessing: a (1, C) array is one C-channel frame."""
+    samples = np.asarray(samples, np.float32)
+    if samples.ndim == 1:
+        samples = samples.reshape(-1, 1)
+    elif samples.ndim != 2:
+        raise ValueError(f"samples must be 1-D or (frames, channels); "
+                         f"got shape {samples.shape}")
+    pcm = np.clip(samples, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype("<i2")
+    with wave.open(path, "wb") as f:
+        f.setnchannels(pcm.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(rate))
+        f.writeframes(pcm.tobytes())
+
+
+def spectrogram(samples: np.ndarray, *, frame_size: int = 256,
+                overlap: float = 0.5, window: str = "hann",
+                log_scale: bool = False) -> np.ndarray:
+    """Magnitude spectrogram (audio/extension/Spectrogram.java analog):
+    (frames, channels)|(frames,) samples → (time, frame_size // 2 + 1)."""
+    x = np.asarray(samples, np.float32)
+    if x.ndim == 2:
+        x = x.mean(axis=1)  # downmix, as the reference fingerprinting does
+    hop = max(1, int(frame_size * (1.0 - overlap)))
+    if len(x) < frame_size:
+        x = np.pad(x, (0, frame_size - len(x)))
+    n_frames = 1 + (len(x) - frame_size) // hop
+    win = (np.hanning(frame_size) if window == "hann"
+           else np.ones(frame_size, np.float32))
+    frames = np.stack([x[i * hop:i * hop + frame_size] * win
+                       for i in range(n_frames)])
+    mag = np.abs(np.fft.rfft(frames, axis=1)).astype(np.float32)
+    return np.log1p(mag) if log_scale else mag
+
+
+class WavFileRecordReader:
+    """WavFileRecordReader.java: each WAV source becomes one record of raw
+    samples — or spectrogram feature rows when ``features='spectrogram'``."""
+
+    def __init__(self, features: str = "samples", frame_size: int = 256,
+                 overlap: float = 0.5, log_scale: bool = True):
+        if features not in ("samples", "spectrogram"):
+            raise ValueError(f"unknown features mode {features!r}")
+        self.features = features
+        self.frame_size = frame_size
+        self.overlap = overlap
+        self.log_scale = log_scale
+
+    def read_record(self, source) -> np.ndarray:
+        samples, _rate = read_wav(source)
+        if self.features == "samples":
+            return samples.reshape(-1)
+        return spectrogram(samples, frame_size=self.frame_size,
+                           overlap=self.overlap, log_scale=self.log_scale)
+
+    def read(self, sources: Union[str, bytes, Sequence]) -> List[np.ndarray]:
+        """A directory of .wav files, a single path/bytes, or an explicit
+        list of paths/bytes."""
+        if isinstance(sources, str):
+            if os.path.isdir(sources):
+                sources = sorted(
+                    os.path.join(sources, f) for f in os.listdir(sources)
+                    if f.lower().endswith(".wav"))
+            else:
+                sources = [sources]  # single file path
+        elif isinstance(sources, (bytes, bytearray)):
+            sources = [sources]
+        return [self.read_record(s) for s in sources]

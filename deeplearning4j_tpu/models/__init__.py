@@ -16,3 +16,4 @@ from deeplearning4j_tpu.models.zoo import (
     TinyYOLO,
     InceptionResNetV1,
 )
+from deeplearning4j_tpu.models.hub import ModelHub

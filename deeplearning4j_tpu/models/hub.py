@@ -1,0 +1,122 @@
+"""Model hub — the omnihub role (pretrained model registry).
+
+Reference parity: omnihub/ (newer reference tags) downloads pretrained
+models into a local cache by name; the zoo's ``initPretrained`` pulls
+weights the same way. This environment is zero-egress, so the hub is a
+LOCAL directory registry (point ``DL4J_TPU_HUB`` at a shared/network mount
+for team distribution — the interchange property the reference's HTTP hub
+provides). Every publish writes a manifest with a SHA-256 per artifact;
+loads verify it, so a torn copy can never masquerade as a model.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_ROOT = os.path.join(os.path.expanduser("~"), ".dl4j_tpu_hub")
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class ModelHub:
+    """Local pretrained-model registry (omnihub analog)."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.environ.get("DL4J_TPU_HUB", _DEFAULT_ROOT)
+        os.makedirs(self.root, exist_ok=True)
+
+    # ---------------------------------------------------------------- paths
+    @staticmethod
+    def _valid_name(name: str) -> bool:
+        # block path traversal, not dots: "resnet50-v1.5" is a fine name
+        return bool(name) and "/" not in name and "\\" not in name \
+            and ".." not in name and not name.startswith(".")
+
+    def _dir(self, name: str) -> str:
+        if not self._valid_name(name):
+            raise ValueError(f"invalid model name {name!r}")
+        return os.path.join(self.root, name)
+
+    def _manifest_path(self, name: str) -> str:
+        return os.path.join(self._dir(name), "manifest.json")
+
+    # ------------------------------------------------------------------ api
+    def publish(self, name: str, net, *,
+                metadata: Optional[Dict[str, Any]] = None) -> str:
+        """Save a MultiLayerNetwork or ComputationGraph under ``name``
+        (omnihub push / zoo pretrained-artifact role). Returns the model
+        directory."""
+        from deeplearning4j_tpu.nn.serde import save_model
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, save_graph
+
+        d = self._dir(name)
+        os.makedirs(d, exist_ok=True)
+        artifact = os.path.join(d, "model.zip")
+        if isinstance(net, ComputationGraph):
+            save_graph(net, artifact)
+            kind = "ComputationGraph"
+        else:
+            save_model(net, artifact)
+            kind = "MultiLayerNetwork"
+        manifest = {
+            "name": name,
+            "kind": kind,
+            "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "artifacts": {"model.zip": _sha256(artifact)},
+            "metadata": metadata or {},
+        }
+        with open(self._manifest_path(name), "w") as f:
+            json.dump(manifest, f, indent=1)
+        return d
+
+    def load(self, name: str):
+        """Load + checksum-verify a published model."""
+        from deeplearning4j_tpu.nn.serde import restore_model
+        from deeplearning4j_tpu.nn.graph import restore_graph
+
+        manifest = self.manifest(name)
+        d = self._dir(name)
+        for fname, want in manifest["artifacts"].items():
+            got = _sha256(os.path.join(d, fname))
+            if got != want:
+                raise IOError(
+                    f"checksum mismatch for {name}/{fname}: manifest "
+                    f"{want[:12]}…, file {got[:12]}… — artifact corrupt or "
+                    f"tampered")
+        artifact = os.path.join(d, "model.zip")
+        if manifest["kind"] == "ComputationGraph":
+            return restore_graph(artifact)
+        return restore_model(artifact)
+
+    def manifest(self, name: str) -> Dict[str, Any]:
+        p = self._manifest_path(name)
+        if not os.path.exists(p):
+            raise KeyError(
+                f"no model '{name}' in hub {self.root} — "
+                f"known: {self.list_models()}")
+        with open(p) as f:
+            return json.load(f)
+
+    def list_models(self) -> List[str]:
+        # tolerate stray files on shared mounts (.DS_Store, README, …)
+        return sorted(
+            n for n in os.listdir(self.root)
+            if self._valid_name(n)
+            and os.path.exists(self._manifest_path(n)))
+
+    def delete(self, name: str) -> None:
+        import shutil
+
+        d = self._dir(name)
+        if os.path.exists(d):
+            shutil.rmtree(d)

@@ -122,3 +122,114 @@ class TestParallelExecutor:
         out = ParallelTransformExecutor(workers=3).execute(
             [list(r) for r in records], tp)
         assert out == [[i] for i in range(1000, 3000)]
+
+
+class TestAudio:
+    """datavec-data-audio role: WAV decode + spectrogram features."""
+
+    def _tone(self, freq=440.0, rate=8000, secs=0.25):
+        t = np.arange(int(rate * secs)) / rate
+        return np.sin(2 * np.pi * freq * t).astype(np.float32), rate
+
+    def test_wav_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.datavec import read_wav, write_wav
+        samples, rate = self._tone()
+        p = str(tmp_path / "tone.wav")
+        write_wav(p, samples.reshape(-1, 1), rate)
+        back, r2 = read_wav(p)
+        assert r2 == rate and back.shape == (len(samples), 1)
+        np.testing.assert_allclose(back[:, 0], samples, atol=2e-4)
+
+    def test_spectrogram_peak_at_tone_frequency(self):
+        from deeplearning4j_tpu.datavec import spectrogram
+        samples, rate = self._tone(freq=1000.0)
+        spec = spectrogram(samples, frame_size=256, log_scale=False)
+        # bin of 1kHz at 8kHz rate, 256-pt fft: 1000/8000*256 = 32
+        peak_bins = spec.argmax(axis=1)
+        assert np.abs(np.median(peak_bins) - 32) <= 1
+
+    def test_wav_record_reader(self, tmp_path):
+        from deeplearning4j_tpu.datavec import (WavFileRecordReader,
+                                                write_wav)
+        for i, f in enumerate([300.0, 600.0]):
+            s, rate = self._tone(freq=f)
+            write_wav(str(tmp_path / f"t{i}.wav"), s.reshape(-1, 1), rate)
+        reader = WavFileRecordReader(features="spectrogram", frame_size=128)
+        recs = reader.read(str(tmp_path))
+        assert len(recs) == 2
+        assert recs[0].ndim == 2 and recs[0].shape[1] == 65
+        raw = WavFileRecordReader().read(str(tmp_path))
+        assert raw[0].ndim == 1
+
+
+class TestModelHub:
+    """Omnihub-role local registry: publish/load with checksum verify."""
+
+    def _net(self):
+        from deeplearning4j_tpu import nn
+        conf = (nn.builder().seed(3).updater(nn.Sgd(learning_rate=0.1)).list()
+                .layer(nn.DenseLayer(n_out=4, activation="tanh"))
+                .layer(nn.OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(nn.InputType.feed_forward(3)).build())
+        return nn.MultiLayerNetwork(conf).init()
+
+    def test_publish_load_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.models.hub import ModelHub
+        hub = ModelHub(root=str(tmp_path))
+        net = self._net()
+        hub.publish("tiny-mlp", net, metadata={"task": "demo"})
+        assert hub.list_models() == ["tiny-mlp"]
+        assert hub.manifest("tiny-mlp")["metadata"]["task"] == "demo"
+        back = hub.load("tiny-mlp")
+        x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        np.testing.assert_allclose(back.output(x), net.output(x),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_checksum_verification(self, tmp_path):
+        from deeplearning4j_tpu.models.hub import ModelHub
+        hub = ModelHub(root=str(tmp_path))
+        hub.publish("m", self._net())
+        # corrupt the artifact
+        p = str(tmp_path / "m" / "model.zip")
+        with open(p, "r+b") as f:
+            f.seek(30)
+            f.write(b"\xff\xff")
+        with pytest.raises(IOError, match="checksum mismatch"):
+            hub.load("m")
+
+    def test_unknown_model_raises(self, tmp_path):
+        from deeplearning4j_tpu.models.hub import ModelHub
+        with pytest.raises(KeyError, match="no model"):
+            ModelHub(root=str(tmp_path)).manifest("ghost")
+
+    def test_bad_name_rejected(self, tmp_path):
+        from deeplearning4j_tpu.models.hub import ModelHub
+        with pytest.raises(ValueError, match="invalid model name"):
+            ModelHub(root=str(tmp_path)).publish("../evil", self._net())
+
+    def test_dotted_name_and_stray_files(self, tmp_path):
+        from deeplearning4j_tpu.models.hub import ModelHub
+        hub = ModelHub(root=str(tmp_path))
+        hub.publish("resnet50-v1.5", self._net())  # dots are legal
+        (tmp_path / ".DS_Store").write_text("junk")
+        (tmp_path / "README.md").write_text("notes")
+        assert hub.list_models() == ["resnet50-v1.5"]
+        with pytest.raises(KeyError):
+            hub.manifest("missing")  # KeyError, not ValueError from strays
+
+    def test_single_file_read_and_exact_channel_layout(self, tmp_path):
+        from deeplearning4j_tpu.datavec import (WavFileRecordReader,
+                                                read_wav, write_wav)
+        rate = 8000
+        t = np.arange(int(rate * 0.1)) / rate
+        s = np.sin(2 * np.pi * 440.0 * t).astype(np.float32)
+        p = str(tmp_path / "one.wav")
+        write_wav(p, s, rate)  # 1-D input
+        recs = WavFileRecordReader().read(p)  # single path, not a dir
+        assert len(recs) == 1 and recs[0].shape == (len(s),)
+        # (1, C): one frame of 4 channels, NOT 4 mono frames
+        p2 = str(tmp_path / "frame.wav")
+        write_wav(p2, np.zeros((1, 4), np.float32), rate)
+        back, _ = read_wav(p2)
+        assert back.shape == (1, 4)
