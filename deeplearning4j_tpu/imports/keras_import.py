@@ -142,6 +142,8 @@ def _embedding(cfg, weights):
 @KerasLayerMapper.register("LSTM")
 def _lstm(cfg, weights):
     units = cfg["units"]
+    if cfg.get("go_backwards", False):
+        raise NotImplementedError("LSTM import with go_backwards=True")
     lc = nn.LSTM(n_out=units, activation=_act(cfg),
                  gate_activation=_ACT_MAP.get(cfg.get("recurrent_activation",
                                                       "sigmoid"), "sigmoid"),
@@ -153,7 +155,14 @@ def _lstm(cfg, weights):
         i, f, c, o = np.split(w, 4, axis=-1)
         return np.concatenate([i, f, o, c], axis=-1)
 
-    return lc, {"W": regate(kernel), "RW": regate(recurrent), "b": regate(bias)}
+    p = {"W": regate(kernel), "RW": regate(recurrent), "b": regate(bias)}
+    if not cfg.get("return_sequences", False):
+        # keras default emits the LAST step only → wrap in LastTimeStep
+        from deeplearning4j_tpu.nn import conf as _C
+
+        return _C.LastTimeStep(fwd=lc.to_dict(), name=cfg.get("name")), \
+            {"inner": p}
+    return lc, p
 
 
 def _assemble_sequential(specs, input_type) -> nn.MultiLayerNetwork:
@@ -810,3 +819,43 @@ def register_custom_layer(name: str):
             return nn.SelfAttentionLayer(...), {"Wq": weights[0], ...}
     """
     return KerasLayerMapper.register(name)
+
+
+@KerasLayerMapper.register("GRU")
+def _gru(cfg, weights):
+    """Keras GRU (reset_after=True, the TF2 default) → nn.GRU. Keras gate
+    order is [z, r, h]; ours (the gru_cell op / PyTorch convention) is
+    [r, z, n] — columns reorder, and the (2, 3H) bias splits into the
+    input/recurrent halves."""
+    if not cfg.get("reset_after", True):
+        raise NotImplementedError(
+            "GRU import with reset_after=False (legacy CuDNN-incompatible "
+            "variant) — re-export with reset_after=True")
+    if cfg.get("go_backwards", False):
+        raise NotImplementedError("GRU import with go_backwards=True")
+    if _act(cfg) != "tanh" or _ACT_MAP.get(
+            cfg.get("recurrent_activation", "sigmoid"),
+            cfg.get("recurrent_activation")) != "sigmoid":
+        raise NotImplementedError(
+            "GRU import requires tanh/sigmoid activations (gru_cell ABI)")
+    units = cfg["units"]
+    kernel, recurrent = weights[0], weights[1]
+    if cfg.get("use_bias", True) and len(weights) > 2:
+        b = np.asarray(weights[2])  # reset_after=True ⇒ always (2, 3H)
+        b_in, b_rec = b[0], b[1]
+    else:
+        b_in = np.zeros(3 * units, np.float32)
+        b_rec = np.zeros(3 * units, np.float32)
+
+    def regate(w):
+        z, r, h = np.split(w, 3, axis=-1)
+        return np.concatenate([r, z, h], axis=-1)
+
+    lc = nn.GRU(n_in=kernel.shape[0], n_out=units, name=cfg.get("name"))
+    p = {"W": regate(kernel), "RW": regate(recurrent),
+         "b": regate(b_in), "rb": regate(b_rec)}
+    if not cfg.get("return_sequences", False):
+        # keras default emits the LAST step only → wrap in LastTimeStep
+        return C.LastTimeStep(fwd=lc.to_dict(), name=cfg.get("name")), \
+            {"inner": p}
+    return lc, p

@@ -409,6 +409,24 @@ class GravesLSTM(LSTM):
 
 
 @dataclasses.dataclass(frozen=True)
+class GRU(LayerConf):
+    """GRU recurrent layer over the catalog's ``gru_cell`` declarable op
+    (libnd4j gruCell.cpp — the reference exposes the CELL op but never grew
+    a layer around it; this closes that gap). Gate order r, z, n with
+    separate input/recurrent biases (the Keras reset_after=True / PyTorch
+    convention, so imported weights drop straight in)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
 class SimpleRnn(LayerConf):
     """conf/layers/recurrent/SimpleRnn.java."""
 
@@ -1130,6 +1148,7 @@ LAYER_TYPES = {
         DropoutLayer,
         LSTM,
         GravesLSTM,
+        GRU,
         SimpleRnn,
         Bidirectional,
         RnnOutputLayer,

@@ -451,6 +451,66 @@ class LSTMImpl(Layer):
         return hs, (h_last, c_last)
 
 
+class GRUImpl(Layer):
+    """GRU over the gru_cell declarable op, scanned across time — the same
+    shared-recurrence shape as SimpleRnn/LSTM (training forward, tBPTT, and
+    rnn_time_step all route through apply_with_state)."""
+
+    def __init__(self, net_conf, lc, itype):
+        super().__init__(net_conf, lc, itype)
+        # the gru_cell ABI hardcodes tanh/sigmoid; an EXPLICIT per-layer
+        # activation would be silently ignored — refuse instead
+        # (LSTM/SimpleRnn honor theirs, so silence here would diverge; the
+        # net-wide default activation is not treated as a GRU request)
+        if lc.activation not in (None, "tanh"):
+            raise ValueError(
+                f"GRU uses the gru_cell op's fixed tanh/sigmoid gates; "
+                f"activation={lc.activation!r} cannot apply")
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": init_weights(k1, (lc.n_in, 3 * lc.n_out), self.winit,
+                              dtype=self.dtype),
+            "RW": init_weights(k2, (lc.n_out, 3 * lc.n_out), self.winit,
+                               dtype=self.dtype),
+            "b": jnp.zeros((3 * lc.n_out,), self.dtype),
+            "rb": jnp.zeros((3 * lc.n_out,), self.dtype),
+        }
+
+    def zero_state(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.lc.n_out), dtype)
+
+    def apply(self, params, x, state, *, train, rng, mask=None, initial=None):
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        hs, _ = self.apply_with_state(params, x, mask=mask, initial=initial)
+        return hs, state, mask
+
+    def apply_with_state(self, params, x, *, mask=None, initial=None):
+        from deeplearning4j_tpu.ops.registry import registry
+
+        cell = registry().get("gru_cell").fn
+        lc = self.lc
+        n = x.shape[0]
+        h0 = initial if initial is not None else jnp.zeros((n, lc.n_out), x.dtype)
+        masked = mask is not None
+
+        def step(h, xm):
+            xt, mt = xm
+            h_new = cell(xt, h, params["W"], params["RW"], params["b"],
+                         params["rb"])
+            if masked:
+                h_new = jnp.where(mt[:, None] > 0, h_new, h)
+            return h_new, h_new
+
+        xs = jnp.swapaxes(x, 0, 1)
+        ms = (jnp.swapaxes(mask, 0, 1) if masked
+              else jnp.zeros((xs.shape[0], 0), x.dtype))  # unmasked sentinel
+        h_last, hs = jax.lax.scan(step, h0, (xs, ms))
+        return jnp.swapaxes(hs, 0, 1), h_last
+
+
 class SimpleRnnImpl(Layer):
     """layers/recurrent/SimpleRnn.java: h' = act(x·W + h·RW + b)."""
 
@@ -1246,6 +1306,7 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.DropoutLayer: DropoutLayerImpl,
     C.LSTM: LSTMImpl,
     C.GravesLSTM: LSTMImpl,
+    C.GRU: GRUImpl,
     C.SimpleRnn: SimpleRnnImpl,
     C.Bidirectional: BidirectionalImpl,
     C.RnnOutputLayer: RnnOutputLayerImpl,

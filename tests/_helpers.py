@@ -1,0 +1,16 @@
+"""Shared test helpers (single definition for the layer/breadth suites)."""
+
+import numpy as np
+
+from deeplearning4j_tpu import nn
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _mln(layers, itype):
+    b = nn.builder().seed(7).updater(nn.Sgd(learning_rate=0.1)).list()
+    for lc in layers:
+        b.layer(lc)
+    return nn.MultiLayerNetwork(b.set_input_type(itype).build()).init()

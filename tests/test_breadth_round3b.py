@@ -420,3 +420,95 @@ class TestGraphStatefulRnn:
         m = np.ones((2, 6), np.float32)
         loss = net.fit_tbptt(x, y, masks=m, lmasks=m)  # plain arrays OK
         assert np.isfinite(loss)
+
+
+from deeplearning4j_tpu.autodiff.gradcheck import check_gradients
+from tests._helpers import _mln, _rng
+
+
+class TestGRU:
+    """nn.GRU over the gru_cell declarable op + Keras import."""
+
+    def test_gru_gradcheck(self):
+        net = _mln([
+            nn.GRU(n_out=5),
+            nn.RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ], nn.InputType.recurrent(3, 6))
+        r = _rng(0)
+        x = r.randn(2, 6, 3)
+        y = np.eye(2)[r.randint(0, 2, (2, 6))]
+        assert check_gradients(net, x, y)
+
+    def test_gru_streaming_matches_full(self):
+        net = _mln([
+            nn.GRU(n_out=4),
+            nn.RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ], nn.InputType.recurrent(3, 6))
+        x = _rng(1).randn(2, 6, 3).astype(np.float32)
+        full = net.output(x)
+        net.rnn_clear_previous_state()
+        streamed = np.concatenate(
+            [net.rnn_time_step(x[:, :3]), net.rnn_time_step(x[:, 3:])], axis=1)
+        np.testing.assert_allclose(streamed, full, rtol=1e-5, atol=1e-6)
+
+    def test_keras_gru_golden(self):
+        tf = pytest.importorskip("tensorflow")
+        from deeplearning4j_tpu.imports import import_keras_model
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((7, 4)),
+            tf.keras.layers.GRU(6, return_sequences=True),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(2).randn(3, 7, 4).astype(np.float32)
+        np.testing.assert_allclose(net.output(x),
+                                   model(x, training=False).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_keras_gru_last_step_default(self):
+        """keras default return_sequences=False must import as LastTimeStep
+        (review finding: previously the full sequence leaked through)."""
+        tf = pytest.importorskip("tensorflow")
+        from deeplearning4j_tpu.imports import import_keras_model
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((6, 3)),
+            tf.keras.layers.GRU(5),       # last step only
+            tf.keras.layers.Dense(2, activation="softmax"),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(3).randn(2, 6, 3).astype(np.float32)
+        np.testing.assert_allclose(net.output(x),
+                                   model(x, training=False).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_keras_lstm_last_step_default(self):
+        tf = pytest.importorskip("tensorflow")
+        from deeplearning4j_tpu.imports import import_keras_model
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((5, 3)),
+            tf.keras.layers.LSTM(4),      # last step only
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(4).randn(2, 5, 3).astype(np.float32)
+        np.testing.assert_allclose(net.output(x),
+                                   model(x, training=False).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_keras_gru_no_bias(self):
+        tf = pytest.importorskip("tensorflow")
+        from deeplearning4j_tpu.imports import import_keras_model
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 3)),
+            tf.keras.layers.GRU(4, use_bias=False, return_sequences=True),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(5).randn(2, 4, 3).astype(np.float32)
+        np.testing.assert_allclose(net.output(x),
+                                   model(x, training=False).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_explicit_activation_rejected(self):
+        with pytest.raises(ValueError, match="gru_cell"):
+            _mln([nn.GRU(n_out=4, activation="relu"),
+                  nn.RnnOutputLayer(n_out=2, activation="softmax",
+                                    loss="mcxent")],
+                 nn.InputType.recurrent(3, 5))

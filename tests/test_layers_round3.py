@@ -15,15 +15,7 @@ from deeplearning4j_tpu.nn import conf as C
 from deeplearning4j_tpu.nn import graph as G
 
 
-def _rng(seed=0):
-    return np.random.RandomState(seed)
-
-
-def _mln(layers, itype):
-    b = nn.builder().seed(7).updater(nn.Sgd(learning_rate=0.1)).list()
-    for lc in layers:
-        b.layer(lc)
-    return nn.MultiLayerNetwork(b.set_input_type(itype).build()).init()
+from tests._helpers import _mln, _rng
 
 
 class TestNewLayerGradchecks:
