@@ -52,3 +52,7 @@ class TestExamples:
     def test_lenet_mnist_runs(self):
         out = _run("lenet_mnist.py", timeout=560)
         assert "Accuracy" in out or "accuracy" in out
+
+    def test_long_context_attention(self):
+        out = _run("long_context_attention.py")
+        assert "strategies agree" in out
