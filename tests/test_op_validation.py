@@ -77,3 +77,17 @@ def test_shape_function_agrees_with_execution():
         flat_w = jax.tree.leaves(want)
         flat_g = jax.tree.leaves(got)
         assert [w.shape for w in flat_w] == [g.shape for g in flat_g], name
+
+
+def test_op_catalog_doc_up_to_date():
+    """docs/OP_CATALOG.md must track the live registry (the codegen-role
+    artifact; tools/gen_op_catalog.py regenerates it)."""
+    import os
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/gen_op_catalog.py", "--check"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
