@@ -181,9 +181,18 @@ class ParallelWrapper:
     def _data_spec(self, arr):
         """Batch-axis sharding; a batch not divisible by the data-axis size
         falls back to replicated (the math is identical under GSPMD, only
-        the partitioning differs) — avoids a mid-epoch remainder crash."""
+        the partitioning differs) — avoids a mid-epoch remainder crash.
+        The fallback is LOUD (once): a replicated batch gets no data-
+        parallel speedup, which a user sizing batches should know."""
         n = self.mesh.shape["data"]
         if np.shape(arr)[0] % n != 0:
+            if not getattr(self, "_warned_ragged", False):
+                self._warned_ragged = True
+                logger.warning(
+                    "ParallelWrapper: batch size %d is not divisible by the "
+                    "data axis (%d devices) — this batch runs REPLICATED "
+                    "(correct, but no DP speedup). Pad or size batches to a "
+                    "multiple of %d.", np.shape(arr)[0], n, n)
             return NamedSharding(self.mesh, P())
         return NamedSharding(self.mesh, P("data", *([None] * (np.ndim(arr) - 1))))
 

@@ -210,3 +210,25 @@ class TestTransformerTP:
         # row-parallel Wo/W2 force a psum: all-reduce must appear in the HLO
         hlo = jit_fwd.lower(sharded).compile().as_text()
         assert "all-reduce" in hlo or "all_reduce" in hlo
+
+
+def test_ragged_batch_fallback_warns(caplog):
+    """Round-2 weak #6: the replicated fallback for a ragged batch must be
+    LOUD, not silent."""
+    import logging
+
+    conf = (nn.builder().seed(1).updater(nn.Sgd(learning_rate=0.1)).list()
+            .layer(nn.DenseLayer(n_out=4, activation="tanh"))
+            .layer(nn.OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(3)).build())
+    net = nn.MultiLayerNetwork(conf).init()
+    mesh = make_mesh({"data": 8})
+    pw = ParallelWrapper(net, mesh=mesh)
+    r = np.random.RandomState(0)
+    x = r.randn(11, 3).astype(np.float32)  # 11 % 8 != 0 → ragged
+    y = np.eye(2)[r.randint(0, 2, 11)].astype(np.float32)
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.parallel.mesh"):
+        pw.fit(DataSet(x, y), epochs=1, batch_size=11)
+    assert any("REPLICATED" in rec.message for rec in caplog.records)
