@@ -37,6 +37,13 @@ class IRNode:
         return self.inputs
 
 
+# "slice to the end" sentinel shared by the TF/ONNX slice rules: the
+# strided_slice backend executes via Python/jnp slicing, which CLAMPS
+# out-of-range bounds — both dialects rely on that contract through this
+# one constant.
+SLICE_TO_END = 2**31 - 1
+
+
 @dataclasses.dataclass
 class IRGraph:
     """Normalized graph: nodes in topological-ish file order + tensors."""

@@ -603,8 +603,10 @@ def _slice_onnx(sd, ins, attrs, node, const_values=None):
         if shape is None:
             raise NotImplementedError(
                 "Slice with axes on an unknown-rank input")
+        from deeplearning4j_tpu.imports.ir import SLICE_TO_END
+
         rank = len(shape)
-        b, e = [0] * rank, [2**31 - 1] * rank
+        b, e = [0] * rank, [SLICE_TO_END] * rank
         for a, s_, t_ in zip(np.atleast_1d(axes), starts, ends):
             b[int(a)], e[int(a)] = s_, t_
         starts, ends = b, e

@@ -339,19 +339,40 @@ def _tf_pad(sd, ins, attrs, node, const_values=None):
 
 @register_tf_op("StridedSlice")
 def _tf_strided_slice(sd, ins, attrs, node, const_values=None):
-    masks = [attrs.get(m, 0) for m in ("begin_mask", "end_mask",
-                                       "ellipsis_mask", "new_axis_mask",
-                                       "shrink_axis_mask")]
-    if any(masks):
+    """Handles begin_mask/end_mask/shrink_axis_mask — what ANY python
+    slicing (``t[:, :2]``, ``t[0]``) compiles to; ellipsis/new_axis masks
+    (``t[..., None]``) still raise."""
+    if attrs.get("ellipsis_mask", 0) or attrs.get("new_axis_mask", 0):
         raise NotImplementedError(
-            f"StridedSlice {node.name}: mask attrs {masks} not supported — "
-            "only explicit begin/end/strides slices import")
-    begin = _require_const(const_values, node, 1, "begin")
-    end = _require_const(const_values, node, 2, "end")
-    strides = _require_const(const_values, node, 3, "strides")
-    return sd._record("strided_slice", [ins[0]], {
-        "begin": [int(b) for b in begin], "end": [int(e) for e in end],
-        "strides": [int(s) for s in strides]})
+            f"StridedSlice {node.name}: ellipsis/new_axis masks not "
+            "supported — rewrite without '...'/None indexing")
+    begin = [int(b) for b in _require_const(const_values, node, 1, "begin")]
+    end = [int(e) for e in _require_const(const_values, node, 2, "end")]
+    strides = [int(s) for s in
+               _require_const(const_values, node, 3, "strides")]
+    from deeplearning4j_tpu.imports.ir import SLICE_TO_END
+
+    bmask = int(attrs.get("begin_mask", 0))
+    emask = int(attrs.get("end_mask", 0))
+    smask = int(attrs.get("shrink_axis_mask", 0))
+    big = SLICE_TO_END
+    shrink_axes = []
+    for i in range(len(begin)):
+        if smask & (1 << i):
+            # shrink: select exactly index begin[i], then squeeze the axis
+            end[i] = begin[i] + 1 if begin[i] != -1 else big
+            strides[i] = 1
+            shrink_axes.append(i)
+            continue
+        if bmask & (1 << i):
+            begin[i] = 0 if strides[i] > 0 else big
+        if emask & (1 << i):
+            end[i] = big if strides[i] > 0 else -big
+    out = sd._record("strided_slice", [ins[0]], {
+        "begin": begin, "end": end, "strides": strides})
+    if shrink_axes:
+        out = sd._record("squeeze", [out], {"axis": tuple(shrink_axes)})
+    return out
 
 
 @register_tf_op("Unpack")

@@ -256,3 +256,35 @@ class TestReviewFixes:
         imp = IRImporter({"Producer": one_out, "Add": binop})
         with pytest.raises(ValueError, match="unresolved input"):
             imp.run_import(ir)
+
+
+class TestStridedSliceMasks:
+    """begin/end/shrink masks — what python slicing compiles to."""
+
+    def test_python_slicing_patterns(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        for fn in [lambda t: t[:, :2] * 1.0,
+                   lambda t: t[0] + 0.0,
+                   lambda t: t[:, -1] * 2.0,
+                   lambda t: t[1:, :2, 1:3] + 1.0,
+                   lambda t: t[:, ::-1] * 1.0]:
+            _run_tf(fn, [tf.TensorSpec([2, 3, 4], tf.float32)], [x])
+
+    def test_scalar_select_then_dense(self):
+        r = np.random.RandomState(0)
+        w = tf.Variable(r.randn(4, 3).astype(np.float32))
+
+        def model(t):
+            first = t[0]          # shrink axis 0: (B,4) -> (4,)
+            return tf.linalg.matvec(w, first, transpose_a=True)
+
+        x = r.randn(2, 4).astype(np.float32)
+        _run_tf(model, [tf.TensorSpec([2, 4], tf.float32)], [x])
+
+    def test_ellipsis_still_raises(self):
+        def model(t):
+            return t[..., None] * 1.0
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([2, 3], tf.float32))
+        with pytest.raises(NotImplementedError, match="ellipsis|new_axis"):
+            TensorflowImporter().run_import(gd)
