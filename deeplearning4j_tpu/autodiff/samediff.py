@@ -734,7 +734,7 @@ class SameDiff:
 
     def op(self, name: str, *inputs, **kwargs) -> SDVariable:
         """Record ANY catalog op by name — the Nd4j.exec(DynamicCustomOp)
-        parity surface: every declarable-op-registry name (270 ops) plus the
+        parity surface: every declarable-op-registry name (~270 ops) plus the
         graph-op table is recordable without a dedicated namespace method.
 
             vals, idx = sd.op("top_k", x, k=5, n_out=2)

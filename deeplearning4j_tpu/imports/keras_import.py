@@ -381,9 +381,15 @@ def _softmax_layer(cfg, weights):
 
 
 @KerasLayerMapper.register("SpatialDropout2D")
-@KerasLayerMapper.register("GaussianDropout")
 def _spatial_dropout(cfg, weights):
-    return C.DropoutLayer(rate=float(cfg.get("rate", 0.5))), {}
+    return C.DropoutLayer(rate=float(cfg.get("rate", 0.5)),
+                          mode="spatial", name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("GaussianDropout")
+def _gaussian_dropout(cfg, weights):
+    return C.DropoutLayer(rate=float(cfg.get("rate", 0.5)),
+                          mode="gaussian", name=cfg.get("name")), {}
 
 
 # ---------------------------------------------------------------------------
@@ -796,9 +802,17 @@ def _time_distributed(cfg, weights):
 
 @KerasLayerMapper.register("SpatialDropout1D")
 @KerasLayerMapper.register("SpatialDropout3D")
-@KerasLayerMapper.register("AlphaDropout")
 def _spatial_dropout_1d3d(cfg, weights):
-    return C.DropoutLayer(rate=float(cfg.get("rate", 0.5))), {}
+    # mask broadcasts over every non-batch, non-channel dim, so one
+    # spatial mode covers 1D/2D/3D (KerasSpatialDropout analog)
+    return C.DropoutLayer(rate=float(cfg.get("rate", 0.5)),
+                          mode="spatial", name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("AlphaDropout")
+def _alpha_dropout(cfg, weights):
+    return C.DropoutLayer(rate=float(cfg.get("rate", 0.5)),
+                          mode="alpha", name=cfg.get("name")), {}
 
 
 @KerasLayerMapper.register("GaussianNoise")

@@ -508,11 +508,25 @@ for _onnx, _sd in [("Tan", "tan"), ("Atan", "atan"), ("Asin", "asin"),
 
 for _onnx, _sd in [("Equal", "eq"), ("Greater", "gt"), ("Less", "lt"),
                    ("And", "boolean_and"), ("Or", "boolean_or"),
-                   ("Xor", "boolean_xor"), ("Mod", "floormod")]:
+                   ("Xor", "boolean_xor")]:
     def _bin_rule2(sd, ins, attrs, node, _op=_sd):
         return sd._record(_op, ins)
 
     ONNX_OP_MAPPERS[_onnx] = _bin_rule2
+
+
+def _mod_rule(sd, ins, attrs, node):
+    """ONNX Mod: fmod=0 -> Python-style floor mod, fmod=1 -> C-style trunc mod.
+
+    The spec requires fmod=1 for float tensors; both variants lower to real
+    ops so neither silently changes sign semantics.
+    """
+    if int(attrs.get("fmod", 0)):
+        return sd._record("truncatemod", ins)
+    return sd._record("floormod", ins)
+
+
+ONNX_OP_MAPPERS["Mod"] = _mod_rule
 
 ONNX_OP_MAPPERS["ReduceProd"] = _reduce_rule("reduce_prod")
 

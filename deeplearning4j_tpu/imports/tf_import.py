@@ -606,7 +606,7 @@ def import_frozen_graph(path_or_bytes) -> SameDiff:
 @register_tf_op("Split")
 def _split(sd, ins, attrs, node, const_values=None):
     # TF Split: (axis, value); num_split is an attr
-    axis = const_values.get(node.input[0])
+    axis = _require_const(const_values, node, 0, "axis")
     n = int(attrs.get("num_split"))
     return sd._record("split", [ins[-1]],
                       {"num_split": n, "axis": int(axis)}, n_out=n)
@@ -614,8 +614,8 @@ def _split(sd, ins, attrs, node, const_values=None):
 
 @register_tf_op("SplitV")
 def _split_v(sd, ins, attrs, node, const_values=None):
-    sizes = const_values.get(node.input[1])
-    axis = const_values.get(node.input[2])
+    sizes = _require_const(const_values, node, 1, "size_splits")
+    axis = _require_const(const_values, node, 2, "axis")
     sizes = tuple(int(s) for s in np.atleast_1d(sizes))
     return sd._record("split_v", [ins[0]],
                       {"sizes": sizes, "axis": int(axis)},
@@ -624,9 +624,11 @@ def _split_v(sd, ins, attrs, node, const_values=None):
 
 @register_tf_op("OneHot")
 def _one_hot(sd, ins, attrs, node, const_values=None):
-    depth = const_values.get(node.input[1])
-    on = const_values.get(node.input[2]) if len(node.input) > 2 else None
-    off = const_values.get(node.input[3]) if len(node.input) > 3 else None
+    depth = _require_const(const_values, node, 1, "depth")
+    on = _require_const(const_values, node, 2, "on_value") \
+        if len(node.input) > 2 else None
+    off = _require_const(const_values, node, 3, "off_value") \
+        if len(node.input) > 3 else None
     if int(attrs.get("axis", -1)) != -1:
         raise NotImplementedError("OneHot with axis != -1 import")
     oh = sd._record("one_hot_graph", [ins[0]], {"depth": int(depth)})
@@ -643,9 +645,10 @@ def _one_hot(sd, ins, attrs, node, const_values=None):
 
 @register_tf_op("Range")
 def _range(sd, ins, attrs, node, const_values=None):
-    start = const_values.get(node.input[0])
-    limit = const_values.get(node.input[1])
-    delta = const_values.get(node.input[2], 1)
+    start = _require_const(const_values, node, 0, "start")
+    limit = _require_const(const_values, node, 1, "limit")
+    delta = _require_const(const_values, node, 2, "delta") \
+        if len(node.input) > 2 else 1
     arr = np.arange(np.asarray(start).item(), np.asarray(limit).item(),
                     np.asarray(delta).item())
     const_values[node.name] = arr  # keep shape chains const-resolvable
@@ -654,8 +657,8 @@ def _range(sd, ins, attrs, node, const_values=None):
 
 @register_tf_op("Fill")
 def _fill(sd, ins, attrs, node, const_values=None):
-    dims = const_values.get(node.input[0])
-    value = const_values.get(node.input[1])
+    dims = _require_const(const_values, node, 0, "dims")
+    value = _require_const(const_values, node, 1, "value")
     arr = np.full(tuple(int(d) for d in np.atleast_1d(dims)),
                   np.asarray(value).item())
     const_values[node.name] = arr  # keep shape chains const-resolvable
@@ -664,8 +667,8 @@ def _fill(sd, ins, attrs, node, const_values=None):
 
 @register_tf_op("Slice")
 def _slice(sd, ins, attrs, node, const_values=None):
-    begin = const_values.get(node.input[1])
-    size = const_values.get(node.input[2])
+    begin = _require_const(const_values, node, 1, "begin")
+    size = _require_const(const_values, node, 2, "size")
     return sd._record("slice", [ins[0]],
                       {"begin": tuple(int(b) for b in np.atleast_1d(begin)),
                        "size": tuple(int(s) for s in np.atleast_1d(size))})
@@ -673,7 +676,7 @@ def _slice(sd, ins, attrs, node, const_values=None):
 
 @register_tf_op("BroadcastTo")
 def _broadcast_to(sd, ins, attrs, node, const_values=None):
-    shape = const_values.get(node.input[1])
+    shape = _require_const(const_values, node, 1, "shape")
     return sd._record("broadcast_to", [ins[0]],
                       {"shape": tuple(int(s) for s in np.atleast_1d(shape))})
 
@@ -717,14 +720,20 @@ def _resize_bilinear_tf(sd, ins, attrs, node, const_values=None):
         raise NotImplementedError(
             "legacy ResizeBilinear (half_pixel_centers=false) import — "
             "re-export with tf.image.resize (TF2 semantics)")
-    size = const_values.get(node.input[1])
+    size = _require_const(const_values, node, 1, "size")
     return sd._record("resize_bilinear", [ins[0]],
                       {"size": tuple(int(s) for s in np.atleast_1d(size))})
 
 
 @register_tf_op("ResizeNearestNeighbor")
 def _resize_nn_tf(sd, ins, attrs, node, const_values=None):
-    size = const_values.get(node.input[1])
+    if not bool(attrs.get("half_pixel_centers", False)) \
+            or bool(attrs.get("align_corners", False)):
+        raise NotImplementedError(
+            "legacy ResizeNearestNeighbor (half_pixel_centers=false or "
+            "align_corners=true) import — re-export with tf.image.resize "
+            "(TF2 semantics)")
+    size = _require_const(const_values, node, 1, "size")
     return sd._record("resize_nearest_neighbor", [ins[0]],
                       {"size": tuple(int(s) for s in np.atleast_1d(size))})
 
@@ -735,7 +744,7 @@ _NEEDS_CONSTS |= {"Split", "SplitV", "OneHot", "Range", "Fill", "Slice",
 
 @register_tf_op("TopKV2")
 def _topk(sd, ins, attrs, node, const_values=None):
-    k = const_values.get(node.input[1])
+    k = _require_const(const_values, node, 1, "k")
     return sd._record("top_k", [ins[0]], {"k": int(k)}, n_out=2)
 
 

@@ -72,7 +72,7 @@ def read_word2vec_binary(path: str) -> Tuple[List[str], np.ndarray]:
         while pos < len(data) and data[pos:pos + 1] in (b"\n", b"\r"):
             pos += 1  # inter-row newline variants
         sp = data.find(b" ", pos)
-        if sp < 0 or sp + row_bytes > len(data):
+        if sp < 0 or sp + 1 + row_bytes > len(data):
             raise ValueError(f"truncated at word {i}")
         words.append(data[pos:sp].decode("utf-8"))
         mat[i] = np.frombuffer(data, "<f4", count=dim, offset=sp + 1)

@@ -377,9 +377,17 @@ class ActivationLayer(LayerConf):
 
 @dataclasses.dataclass(frozen=True)
 class DropoutLayer(LayerConf):
-    """conf/layers/DropoutLayer.java: standalone dropout."""
+    """conf/layers/DropoutLayer.java: standalone dropout.
+
+    ``mode`` selects the IDropout variant (conf/dropout/*.java):
+    "elementwise" (Dropout), "spatial" (SpatialDropout — drops whole
+    feature maps along the trailing channel axis), "alpha"
+    (AlphaDropout — SELU-preserving), "gaussian" (GaussianDropout —
+    multiplicative N(1, rate/(1-rate)) noise).
+    """
 
     rate: float = 0.5
+    mode: str = "elementwise"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -347,12 +347,34 @@ class ActivationLayerImpl(Layer):
 
 
 class DropoutLayerImpl(Layer):
-    """layers/DropoutLayer.java."""
+    """layers/DropoutLayer.java + conf/dropout/{Spatial,Alpha,Gaussian}Dropout.java."""
 
     def apply(self, params, x, state, *, train, rng, mask=None):
-        if not train:
+        if not train or self.lc.rate <= 0.0:
             return x, state, mask
-        return nn_ops.dropout.fn(x, rng, rate=self.lc.rate), state, mask
+        rate = self.lc.rate
+        mode = getattr(self.lc, "mode", "elementwise")
+        if mode == "elementwise":
+            return nn_ops.dropout.fn(x, rng, rate=rate), state, mask
+        if mode == "spatial":
+            # drop whole feature maps: bernoulli over (N, 1, ..., 1, C)
+            keep = 1.0 - rate
+            mshape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+            m = jax.random.bernoulli(rng, keep, mshape)
+            return jnp.where(m, x / keep, 0.0), state, mask
+        if mode == "alpha":
+            # Klambauer et al. 2017 §3: keeps SELU self-normalisation
+            keep = 1.0 - rate
+            alpha_p = -1.7580993408473766
+            a = (keep + alpha_p ** 2 * keep * rate) ** -0.5
+            b = -a * rate * alpha_p
+            m = jax.random.bernoulli(rng, keep, x.shape)
+            return a * jnp.where(m, x, alpha_p) + b, state, mask
+        if mode == "gaussian":
+            std = (rate / (1.0 - rate)) ** 0.5
+            noise = 1.0 + std * jax.random.normal(rng, x.shape, x.dtype)
+            return x * noise, state, mask
+        raise ValueError(f"unknown dropout mode {mode!r}")
 
 
 # ---------------------------------------------------------------------------

@@ -98,6 +98,7 @@ _BINARY = {
     "atan2": (jnp.arctan2, np.arctan2, False),
     "mod": (jnp.mod, np.mod, True),
     "floormod": (jnp.mod, np.mod, True),
+    "truncatemod": (jnp.fmod, np.fmod, True),
     "floordiv": (jnp.floor_divide, np.floor_divide, True),
     "truncatediv": (lambda x, y: jnp.trunc(x / y), lambda x, y: np.trunc(x / y), True),
     "pow": (jnp.power, np.power, "pow"),

@@ -1,0 +1,155 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md r3):
+ONNX Mod fmod handling, TF resize/const-operand diagnostics, word2vec
+binary truncation off-by-one, and spatial/alpha/gaussian dropout modes.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.nn.layers import build_layer
+from deeplearning4j_tpu.ops.registry import registry
+
+
+def make_net(*layers, input_type):
+    b = nn.builder().seed(42).list()
+    for l in layers:
+        b.layer(l)
+    return nn.MultiLayerNetwork(b.set_input_type(input_type).build()).init()
+
+
+class TestOnnxModFmod:
+    def _roundtrip(self, fmod):
+        from deeplearning4j_tpu.imports.onnx_import import ONNX_OP_MAPPERS
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff()
+        a = sd.placeholder("a", (4,))
+        b = sd.placeholder("b", (4,))
+
+        class FakeNode:
+            pass
+
+        out = ONNX_OP_MAPPERS["Mod"](sd, [a, b], {"fmod": fmod}, FakeNode())
+        av = np.array([5.0, -5.0, 5.0, -5.0], np.float32)
+        bv = np.array([3.0, 3.0, -3.0, -3.0], np.float32)
+        return sd.output({"a": av, "b": bv}, out.name)[out.name], av, bv
+
+    def test_fmod_1_is_trunc_mod(self):
+        got, av, bv = self._roundtrip(1)
+        np.testing.assert_allclose(got, np.fmod(av, bv), rtol=1e-6)
+
+    def test_fmod_0_is_floor_mod(self):
+        got, av, bv = self._roundtrip(0)
+        np.testing.assert_allclose(got, np.mod(av, bv), rtol=1e-6)
+
+    def test_trunc_and_floor_differ_on_mixed_signs(self):
+        # sanity: the two conventions genuinely disagree here, so the
+        # pre-fix mapping was silently wrong
+        assert not np.allclose(np.fmod(-5.0, 3.0), np.mod(-5.0, 3.0))
+
+    def test_truncatemod_in_registry(self):
+        assert "truncatemod" in registry().names()
+
+
+class TestTfImportDiagnostics:
+    def test_dynamic_const_operand_raises_value_error(self):
+        """Range with a dynamic limit must produce the _require_const
+        diagnostic, not an opaque TypeError (ADVICE r3 finding 3)."""
+        from deeplearning4j_tpu.imports.tf_import import TF_OP_MAPPERS
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        class FakeNode:
+            op_type = "Range"
+            name = "r"
+            input = ["dyn_start", "dyn_limit", "delta"]
+
+        sd = SameDiff()
+        with pytest.raises(ValueError, match="must be a captured constant|dynamic"):
+            TF_OP_MAPPERS["Range"](sd, [], {}, FakeNode(), const_values={})
+
+    def test_legacy_nearest_resize_rejected(self):
+        from deeplearning4j_tpu.imports.tf_import import TF_OP_MAPPERS
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        class FakeNode:
+            op_type = "ResizeNearestNeighbor"
+            name = "rn"
+            input = ["x", "size"]
+
+        sd = SameDiff()
+        with pytest.raises(NotImplementedError, match="half_pixel_centers"):
+            TF_OP_MAPPERS["ResizeNearestNeighbor"](
+                sd, [], {"half_pixel_centers": False}, FakeNode(),
+                const_values={"size": np.array([4, 4])})
+
+
+class TestWord2vecTruncation:
+    def test_truncated_by_one_byte_reports_word_index(self, tmp_path):
+        from deeplearning4j_tpu.nlp.serde import read_word2vec_binary
+
+        dim = 3
+        payload = b"2 3\n"
+        payload += b"cat " + struct.pack("<3f", 1.0, 2.0, 3.0)
+        payload += b"dog " + struct.pack("<3f", 4.0, 5.0, 6.0)
+        ok = tmp_path / "ok.bin"
+        ok.write_bytes(payload)
+        words, mat = read_word2vec_binary(str(ok))
+        assert words == ["cat", "dog"]
+        np.testing.assert_allclose(mat[1], [4.0, 5.0, 6.0])
+
+        bad = tmp_path / "bad.bin"
+        bad.write_bytes(payload[:-1])  # exactly one byte short
+        with pytest.raises(ValueError, match="truncated at word 1"):
+            read_word2vec_binary(str(bad))
+
+
+class TestDropoutModes:
+    def _train_acts(self, layer, x):
+        net = make_net(layer, input_type=nn.InputType.feed_forward(x.shape[-1])
+                       if x.ndim == 2 else nn.InputType.recurrent(x.shape[-1]))
+        return np.asarray(net.feed_forward(x, train=True)[0])
+
+    def test_spatial_drops_whole_feature_maps(self):
+        # recurrent input (N, T, C): a dropped channel must be zero at
+        # EVERY timestep (KerasSpatialDropout / conf/dropout/SpatialDropout.java)
+        x = np.ones((8, 16, 32), np.float32)
+        out = self._train_acts(nn.DropoutLayer(rate=0.5, mode="spatial"), x)
+        per_channel = out.sum(axis=1)  # (N, C)
+        zero_channels = per_channel == 0
+        assert zero_channels.sum() > 0
+        for n, c in zip(*np.nonzero(zero_channels)):
+            assert (out[n, :, c] == 0).all()
+        # surviving channels are scaled by 1/keep
+        assert np.allclose(out[~np.isclose(out, 0)], 2.0)
+
+    def test_alpha_dropout_preserves_mean_var(self):
+        x = np.random.RandomState(0).randn(512, 256).astype(np.float32)
+        out = self._train_acts(nn.DropoutLayer(rate=0.1, mode="alpha"), x)
+        assert abs(out.mean() - x.mean()) < 0.05
+        assert abs(out.std() - x.std()) < 0.1
+        assert not np.allclose(out, x)  # it did something
+
+    def test_gaussian_dropout_multiplicative(self):
+        x = np.full((256, 128), 3.0, np.float32)
+        out = self._train_acts(nn.DropoutLayer(rate=0.25, mode="gaussian"), x)
+        assert abs(out.mean() - 3.0) < 0.1
+        assert out.std() > 0.5  # noise applied
+        # identity at inference
+        net = make_net(nn.DropoutLayer(rate=0.25, mode="gaussian"),
+                       input_type=nn.InputType.feed_forward(128))
+        np.testing.assert_allclose(net.output(x), x)
+
+    def test_keras_mappers_set_modes(self):
+        from deeplearning4j_tpu.imports.keras_import import KerasLayerMapper
+
+        for cls, mode in [("SpatialDropout1D", "spatial"),
+                          ("SpatialDropout2D", "spatial"),
+                          ("SpatialDropout3D", "spatial"),
+                          ("AlphaDropout", "alpha"),
+                          ("GaussianDropout", "gaussian")]:
+            lc, _ = KerasLayerMapper.MAPPERS[cls]({"rate": 0.3}, {})
+            assert lc.mode == mode, cls
+            assert lc.rate == pytest.approx(0.3)
