@@ -984,6 +984,40 @@ class SameDiff:
         self._local_ops[name + "_impl"] = while_op
         return self._record(name + "_impl", [init_var])
 
+    def while_loop_multi(self, cond_fn, body_fn,
+                         init_vars: Sequence["SDVariable"]):
+        """Recorded multi-carry lax.while_loop — the TF2 While/StatelessWhile
+        function-graph analog (AbstractSession loop frames, SURVEY §4.3).
+
+        cond_fn: tuple(carry) -> scalar bool; body_fn: tuple(carry) ->
+        tuple(carry). Returns one SDVariable per loop variable (the final
+        carry), mirroring the TF While node's N outputs."""
+        name = self._fresh("while")
+        n = len(init_vars)
+
+        def while_op(*vals):
+            out = jax.lax.while_loop(cond_fn, body_fn, tuple(vals))
+            # n_out=1 slots store a bare value, not lax's 1-tuple carry
+            return out[0] if n == 1 else out
+
+        self._local_ops[name + "_impl"] = while_op
+        return self._record(name + "_impl", list(init_vars), n_out=n)
+
+    def cond_multi(self, pred_var: "SDVariable", true_fn, false_fn,
+                   operands: Sequence["SDVariable"], n_out: int):
+        """Recorded lax.cond over N operands with M outputs — the TF2
+        If/StatelessIf function-graph analog. true_fn/false_fn:
+        (*operands) -> tuple of n_out values."""
+        name = self._fresh("cond")
+
+        def cond_op(pred, *vals):
+            return jax.lax.cond(jnp.asarray(pred).astype(bool).reshape(()),
+                                true_fn, false_fn, *vals)
+
+        self._local_ops[name + "_impl"] = cond_op
+        return self._record(name + "_impl", [pred_var] + list(operands),
+                            n_out=n_out)
+
     def cond(self, pred_var: "SDVariable", true_fn, false_fn,
              operand: "SDVariable") -> "SDVariable":
         """Recorded lax.cond (TF Switch/Merge analog)."""

@@ -132,6 +132,13 @@ class IRImporter:
             # None for utility nodes (NoOp/init), which never materialize
             outs = [n.name for n in ir.nodes
                     if n.name not in consumed and n.name in produced]
+        for oname in outs:
+            if oname not in sd._vars and oname in produced:
+                # output name resolves to a var that could not be renamed
+                # (a placeholder passthrough, e.g. a While body returning a
+                # loop-invariant arg via Identity) — alias it explicitly so
+                # execution can fetch it by the graph's output name
+                sd._record("identity", [produced[oname]]).rename(oname)
         sd.graph_inputs = [n for n, _ in ir.inputs]
         sd.graph_outputs = outs
         return sd

@@ -64,12 +64,18 @@ def precision_scope(policy: str):
     """
     from deeplearning4j_tpu.environment import environment
 
+    stack = contextlib.ExitStack()
+    if policy == "float64":
+        # DataType.DOUBLE semantics: without this scope JAX silently
+        # truncates every requested f64 buffer to f32 (with a UserWarning),
+        # so "double" networks were double in name only
+        stack.enter_context(jax.enable_x64())
     if environment().matmul_precision != "default":
-        return contextlib.nullcontext()  # respect the explicit global knob
+        return stack  # respect the explicit global knob
     prec = matmul_precision(policy)
-    if prec == "default":
-        return contextlib.nullcontext()
-    return jax.default_matmul_precision(prec)
+    if prec != "default":
+        stack.enter_context(jax.default_matmul_precision(prec))
+    return stack
 
 
 def param_dtype(policy: str) -> jnp.dtype:

@@ -526,20 +526,23 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------ init
     def init(self, params=None) -> "ComputationGraph":
-        if params is not None:
-            self.params = params
-        else:
-            key = jax.random.key(self.conf.seed)
-            names = [n.name for n in self._order if n.kind == "layer"]
-            keys = jax.random.split(key, max(len(names), 1))
-            self.params = {
-                name: self.layers[name].init(k) for name, k in zip(names, keys)
-            }
-        self.net_state = {name: l.init_state() for name, l in self.layers.items()}
-        self.opt_state = {}
-        for name, l in self.layers.items():
-            upd = self.conf.layer_updater(l.lc)
-            self.opt_state[name] = jax.tree.map(upd.init_state, self.params[name])
+        from deeplearning4j_tpu.nn import dtype as DT
+
+        with DT.precision_scope(self.conf.dtype):
+            if params is not None:
+                self.params = params
+            else:
+                key = jax.random.key(self.conf.seed)
+                names = [n.name for n in self._order if n.kind == "layer"]
+                keys = jax.random.split(key, max(len(names), 1))
+                self.params = {
+                    name: self.layers[name].init(k) for name, k in zip(names, keys)
+                }
+            self.net_state = {name: l.init_state() for name, l in self.layers.items()}
+            self.opt_state = {}
+            for name, l in self.layers.items():
+                upd = self.conf.layer_updater(l.lc)
+                self.opt_state[name] = jax.tree.map(upd.init_state, self.params[name])
         return self
 
     def set_listeners(self, *ls: TrainingListener) -> None:

@@ -165,17 +165,20 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------ init
     def init(self, params: Optional[List[Dict[str, Any]]] = None) -> "MultiLayerNetwork":
         """Initialize parameters (MultiLayerNetwork.init())."""
-        if params is not None:
-            self.params = params
-        else:
-            key = jax.random.key(self.conf.seed)
-            keys = jax.random.split(key, max(len(self.layers), 1))
-            self.params = [l.init(k) for l, k in zip(self.layers, keys)]
-        self.net_state = [l.init_state() for l in self.layers]
-        self.opt_state = [
-            jax.tree.map(upd.init_state, p)
-            for upd, p in zip(self.updaters, self.params)
-        ]
+        from deeplearning4j_tpu.nn import dtype as DT
+
+        with DT.precision_scope(self.conf.dtype):
+            if params is not None:
+                self.params = params
+            else:
+                key = jax.random.key(self.conf.seed)
+                keys = jax.random.split(key, max(len(self.layers), 1))
+                self.params = [l.init(k) for l, k in zip(self.layers, keys)]
+            self.net_state = [l.init_state() for l in self.layers]
+            self.opt_state = [
+                jax.tree.map(upd.init_state, p)
+                for upd, p in zip(self.updaters, self.params)
+            ]
         return self
 
     def set_listeners(self, *ls: TrainingListener) -> None:

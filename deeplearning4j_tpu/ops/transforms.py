@@ -80,6 +80,7 @@ _UNARY = {
     "gelu": (functools.partial(jax.nn.gelu, approximate=False), None, None),
     "swish": (jax.nn.swish, lambda x: x / (1.0 + np.exp(-x)), None),
     "mish": (jax.nn.mish, lambda x: x * np.tanh(np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)), None),
+    "identity": (lambda x: x, lambda x: x, None),
     "isnan": (jnp.isnan, np.isnan, None),
     "isinf": (jnp.isinf, np.isinf, None),
     "isfinite": (jnp.isfinite, np.isfinite, None),

@@ -10,14 +10,19 @@ tf = pytest.importorskip("tensorflow")
 from deeplearning4j_tpu.imports import TensorflowImporter, import_frozen_graph
 
 
-def freeze(fn, *specs):
-    """Concrete function → frozen GraphDef (variables inlined as Consts)."""
+def freeze(fn, *specs, lower_control_flow=True):
+    """Concrete function → frozen GraphDef (variables inlined as Consts).
+
+    lower_control_flow=True (TF's default) lowers functional While/If into
+    TF1 frames (Enter/Exit/Merge/Switch); False keeps the functional nodes
+    + library — both forms appear in real frozen graphs and both import."""
     from tensorflow.python.framework.convert_to_constants import (
         convert_variables_to_constants_v2,
     )
 
     cf = tf.function(fn).get_concrete_function(*specs)
-    frozen = convert_variables_to_constants_v2(cf)
+    frozen = convert_variables_to_constants_v2(
+        cf, lower_control_flow=lower_control_flow)
     return frozen.graph.as_graph_def(), [t.name.split(":")[0] for t in frozen.inputs], \
         [t.name.split(":")[0] for t in frozen.outputs]
 
@@ -161,3 +166,235 @@ class TestTfImportWidened:
         sd = import_frozen_graph(gd.SerializeToString())
         got = sd.output({ins[0]: x}, outs[0])[outs[0]]
         np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+
+class TestTfControlFlow:
+    """TF2 function-graph control flow → lax.while_loop/cond
+    (TFGraphMapper + AbstractSession frames, SURVEY §4.3)."""
+
+    def test_while_loop_golden(self):
+        def model(x):
+            i = tf.constant(0)
+
+            def cond(i, x):
+                return i < 5
+
+            def body(i, x):
+                return i + 1, x * 1.5 + 1.0
+
+            _, out = tf.while_loop(cond, body, [i, x])
+            return out
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([4], tf.float32),
+                               lower_control_flow=False)
+        assert any(n.op in ("While", "StatelessWhile") for n in gd.node)
+        x = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+        golden = model(tf.constant(x)).numpy()
+        sd = TensorflowImporter().run_import(gd)
+        got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+        np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+    def test_while_loop_data_dependent_trip_count(self):
+        def model(x):
+            def cond(x):
+                return tf.reduce_sum(x) < 100.0
+
+            def body(x):
+                return (x * 2.0,)
+
+            return tf.while_loop(cond, body, [x])[0]
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([3], tf.float32),
+                               lower_control_flow=False)
+        sd = TensorflowImporter().run_import(gd)
+        for scale in (1.0, 7.0):  # different trip counts, same import
+            x = scale * np.array([1.0, 2.0, 3.0], np.float32)
+            golden = model(tf.constant(x)).numpy()
+            got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+            np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+    def test_cond_golden_both_branches(self):
+        def model(x):
+            return tf.cond(tf.reduce_sum(x) > 0.0,
+                           lambda: x * 2.0 + 1.0,
+                           lambda: -x)
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([4], tf.float32),
+                               lower_control_flow=False)
+        assert any(n.op in ("If", "StatelessIf") for n in gd.node)
+        sd = TensorflowImporter().run_import(gd)
+        for sign in (1.0, -1.0):  # exercise BOTH branches
+            x = sign * np.arange(1.0, 5.0, dtype=np.float32)
+            golden = model(tf.constant(x)).numpy()
+            got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+            np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+    def test_nested_while_in_cond(self):
+        def model(x):
+            def loop():
+                return tf.while_loop(lambda i, v: i < 3,
+                                     lambda i, v: (i + 1, v + v),
+                                     [tf.constant(0), x])[1]
+
+            return tf.cond(tf.reduce_sum(x) > 0.0, loop, lambda: x)
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([2], tf.float32),
+                               lower_control_flow=False)
+        sd = TensorflowImporter().run_import(gd)
+        for sign in (1.0, -1.0):
+            x = sign * np.array([1.0, 2.0], np.float32)
+            golden = model(tf.constant(x)).numpy()
+            got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+            np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+    def test_while_multi_output_slots(self):
+        """Both loop vars of a While consumed downstream (slot addressing)."""
+        def model(x):
+            i, y = tf.while_loop(lambda i, v: i < 4,
+                                 lambda i, v: (i + 1, v * 1.1),
+                                 [tf.constant(0), x])
+            return tf.cast(i, tf.float32) + tf.reduce_sum(y)
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([3], tf.float32),
+                               lower_control_flow=False)
+        sd = TensorflowImporter().run_import(gd)
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        golden = model(tf.constant(x)).numpy()
+        got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+        np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+
+class TestTf1FrameControlFlow:
+    """Default freezing (lower_control_flow=True) lowers While/If into TF1
+    frames — Enter/Merge/Switch/Exit/NextIteration/LoopCond — the form every
+    legacy frozen .pb carries. The importer collapses each frame back onto
+    lax.while_loop, and frameless Switch/Merge conds onto pred-selects
+    (AbstractSession frame interpretation, SURVEY §4.3)."""
+
+    def test_lowered_while_golden(self):
+        def model(x):
+            def cond(i, x):
+                return i < 5
+
+            def body(i, x):
+                return i + 1, x * 1.5 + 1.0
+
+            _, out = tf.while_loop(cond, body, [tf.constant(0), x])
+            return out
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([4], tf.float32))
+        assert any(n.op == "Enter" for n in gd.node)  # really lowered
+        x = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+        golden = model(tf.constant(x)).numpy()
+        sd = TensorflowImporter().run_import(gd)
+        got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+        np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+    def test_lowered_while_data_dependent(self):
+        def model(x):
+            def cond(x):
+                return tf.reduce_sum(x) < 100.0
+
+            def body(x):
+                return (x * 2.0,)
+
+            return tf.while_loop(cond, body, [x])[0]
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([3], tf.float32))
+        sd = TensorflowImporter().run_import(gd)
+        for scale in (1.0, 7.0):
+            x = scale * np.array([1.0, 2.0, 3.0], np.float32)
+            golden = model(tf.constant(x)).numpy()
+            got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+            np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+    def test_lowered_cond_golden_both_branches(self):
+        def model(x):
+            return tf.cond(tf.reduce_sum(x) > 0.0,
+                           lambda: x * 2.0 + 1.0,
+                           lambda: -x)
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([4], tf.float32))
+        assert any(n.op == "Switch" for n in gd.node)
+        assert not any(n.op in ("If", "StatelessIf") for n in gd.node)
+        sd = TensorflowImporter().run_import(gd)
+        for sign in (1.0, -1.0):
+            x = sign * np.arange(1.0, 5.0, dtype=np.float32)
+            golden = model(tf.constant(x)).numpy()
+            got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+            np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+    def test_lowered_cond_multi_capture(self):
+        def model(x, y):
+            return tf.cond(tf.reduce_mean(x) > tf.reduce_mean(y),
+                           lambda: x - y,
+                           lambda: x * y + 3.0)
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([3], tf.float32),
+                               tf.TensorSpec([3], tf.float32))
+        sd = TensorflowImporter().run_import(gd)
+        r = np.random.RandomState(0)
+        for _ in range(3):
+            x = r.randn(3).astype(np.float32)
+            y = r.randn(3).astype(np.float32)
+            golden = model(tf.constant(x), tf.constant(y)).numpy()
+            got = sd.output({ins[0]: x, ins[1]: y}, outs[0])[outs[0]]
+            np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+    def test_lowered_while_matmul_body(self):
+        """Loop body with a matmul on a carried state (power iteration)."""
+        def model(x):
+            m = tf.constant(np.array([[0.9, 0.1], [0.2, 0.7]], np.float32))
+
+            def cond(i, v):
+                return i < 4
+
+            def body(i, v):
+                return i + 1, tf.linalg.matvec(m, v)
+
+            return tf.while_loop(cond, body, [tf.constant(0), x])[1]
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([2], tf.float32))
+        sd = TensorflowImporter().run_import(gd)
+        x = np.array([1.0, 2.0], np.float32)
+        golden = model(tf.constant(x)).numpy()
+        got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+        np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
+
+    def test_lowered_nested_cond(self):
+        """A cond nested inside a branch: the outer Merge must select on the
+        OUTER predicate (slot-crossing analysis), not the nearest Switch."""
+        def model(x):
+            return tf.cond(
+                tf.reduce_sum(x) > 0.0,
+                lambda: tf.cond(tf.reduce_max(x) > 5.0,
+                                lambda: x + 100.0,
+                                lambda: x + 1.0),
+                lambda: -x)
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([2], tf.float32))
+        sd = TensorflowImporter().run_import(gd)
+        for x in (np.array([1.0, 2.0], np.float32),      # outer T, inner F
+                  np.array([1.0, 9.0], np.float32),      # outer T, inner T
+                  np.array([-1.0, -2.0], np.float32)):   # outer F
+            golden = model(tf.constant(x)).numpy()
+            got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+            np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6,
+                                       err_msg=str(x))
+
+    def test_single_var_while_keeps_shape(self):
+        """One-loop-variable While: result must keep the carried shape, not
+        grow lax.while_loop's 1-tuple into a leading dimension."""
+        def model(x):
+            return tf.while_loop(lambda v: tf.reduce_sum(v) < 10.0,
+                                 lambda v: (v * 2.0,), [x])[0]
+
+        for lcf in (True, False):
+            gd, ins, outs = freeze(model, tf.TensorSpec([3], tf.float32),
+                                   lower_control_flow=lcf)
+            sd = TensorflowImporter().run_import(gd)
+            x = np.array([1.0, 0.5, 0.25], np.float32)
+            golden = model(tf.constant(x)).numpy()
+            got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+            assert got.shape == golden.shape == (3,), (lcf, got.shape)
+            np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
