@@ -55,6 +55,11 @@ from jax.experimental import pallas as pl
 # (VMEM scratch allocations); a build without it cannot run these kernels.
 from jax.experimental.pallas import tpu as pltpu
 
+# Shortest kv length at which the Pallas kernel beats the XLA fused /
+# generic materialized paths on-chip (tools/bench_attention_sweep.py table
+# in BENCH_HISTORY.json 'attention_sweep'; re-measure per device class).
+FLASH_MIN_T = 2048
+
 
 def _keep_mask(seed, bh, q0, k0, *, block_q: int, block_k: int, rate: float):
     """Deterministic per-element keep mask for one (block_q, block_k) tile.
@@ -587,6 +592,14 @@ def register_platform_attention() -> None:
                                None, rate)
 
     def usable(q, k, v, mask=None, **kw):
+        # Measured crossover (BENCH_HISTORY.json 'attention_sweep', v5e,
+        # bf16 fwd+bwd): below T=2048 the XLA/generic materialized path is
+        # ~1.6x FASTER than the Pallas kernel (grid overhead dominates);
+        # at and above 2048 Pallas wins 1.25x-28x. Defer below the
+        # crossover — the PlatformHelper::isUsable contract (SURVEY §3.1).
+        t_kv = k.shape[2] if q.ndim == 4 else k.shape[1]
+        if t_kv < FLASH_MIN_T:
+            return False
         if q.ndim == 3:
             mask_ok = mask is None or (
                 hasattr(mask, "ndim") and mask.ndim in (2, 3)

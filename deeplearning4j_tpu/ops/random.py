@@ -25,16 +25,27 @@ class RandomSource:
     use only at orchestration level, never inside jit)."""
 
     def __init__(self, seed: int = 0):
-        self._key = jax.random.key(seed)
+        # LAZY: creating a key initializes the XLA backend, and importing
+        # the package must not do that (jax.distributed.initialize has to
+        # run first in multi-process jobs — SURVEY §4.4 bootstrap order)
+        self._seed = seed
+        self._key = None
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
 
     def set_seed(self, seed: int) -> None:
-        self._key = jax.random.key(seed)
+        self._seed = seed
+        self._key = None  # stays lazy: no backend init before jax.distributed
 
     def next_key(self):
+        self._ensure()
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def split(self, n: int):
+        self._ensure()
         self._key, *subs = jax.random.split(self._key, n + 1)
         return subs
 

@@ -52,13 +52,19 @@ class TrainingCheckpointer:
 
     # ------------------------------------------------------------------ save
     def _state_of(self, net) -> Dict[str, Any]:
-        return {
+        state = {
             "params": net.params,
             "opt_state": net.opt_state,
             "net_state": net.net_state,
             "iteration": np.asarray(net.iteration_count),
             "epoch": np.asarray(net.epoch_count),
         }
+        key = getattr(net, "_key", None)
+        if key is not None:
+            # the training RNG stream is part of exact resume: without it a
+            # relaunched job replays dropout masks from step 0
+            state["rng_key"] = np.asarray(jax.random.key_data(key))
+        return state
 
     def save(self, step: int, net) -> str:
         state = self._state_of(net)
@@ -122,6 +128,11 @@ class TrainingCheckpointer:
             restored_leaves = []
             for kp, leaf in leaves_p:
                 key = jax.tree_util.keystr(kp)
+                if key not in data and key.startswith("['rng_key']"):
+                    # pre-round-4 checkpoint without the RNG stream: keep
+                    # the net's current key rather than failing the restore
+                    restored_leaves.append(np.asarray(leaf))
+                    continue
                 restored_leaves.append(data[key])
             treedef = jax.tree_util.tree_structure(target)
             restored = jax.tree_util.tree_unflatten(treedef, restored_leaves)
@@ -130,6 +141,10 @@ class TrainingCheckpointer:
         net.net_state = jax.tree.map(jnp.asarray, restored["net_state"])
         net.iteration_count = int(restored["iteration"])
         net.epoch_count = int(restored["epoch"])
+        if "rng_key" in restored and getattr(net, "_key", None) is not None:
+            net._key = jax.random.wrap_key_data(
+                jnp.asarray(restored["rng_key"]),
+                impl=jax.random.key_impl(net._key))
         return step
 
 

@@ -77,3 +77,103 @@ class ShardedDataSetIterator:
         for i, ds in enumerate(self.base):
             if i % self.n == self.pid:
                 yield ds
+
+
+# ---------------------------------------------------------------------------
+# Multi-process launcher CLI (round 4) — the SharedTrainingMaster JOB role
+# (SURVEY §4.4, §8.2-M5): spawn N worker processes that form a
+# jax.distributed cluster, stream their output, and on worker failure kill
+# the survivors and relaunch the whole job (checkpoint-restart elasticity,
+# SURVEY §6.3 — workers resume from their latest checkpoint on restart).
+#
+#   python -m deeplearning4j_tpu.parallel.launch --nprocs 2 --restarts 1 \
+#       -- my_fit_script.py arg1 arg2
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(nprocs: int, argv: Sequence[str], restarts: int = 0,
+           env_extra: Optional[dict] = None, timeout: float = 600.0) -> int:
+    """Run ``argv`` as ``nprocs`` coordinated worker processes.
+
+    Returns the exit code (0 = all workers succeeded on some attempt).
+    Each attempt uses a fresh coordinator port; workers read the cluster
+    layout from DL4J_TPU_* env vars via initialize_distributed()."""
+    import subprocess
+    import sys
+    import time
+
+    for attempt in range(restarts + 1):
+        port = _free_port()
+        procs = []
+        for pid in range(nprocs):
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env.update({
+                "DL4J_TPU_COORDINATOR": f"127.0.0.1:{port}",
+                "DL4J_TPU_NUM_PROCS": str(nprocs),
+                "DL4J_TPU_PROC_ID": str(pid),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable] + list(argv), env=env))
+        deadline = time.time() + timeout
+        failed = False
+        while procs:
+            for p in list(procs):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                procs.remove(p)
+                if rc != 0:
+                    failed = True
+            if failed or time.time() > deadline:
+                for p in procs:  # kill survivors (they may be blocked in a
+                    p.terminate()  # collective waiting on the dead rank)
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                break
+            time.sleep(0.1)
+        if not failed and procs == []:
+            return 0
+        print(f"[launch] attempt {attempt + 1}/{restarts + 1} failed"
+              + ("; relaunching (workers resume from checkpoint)"
+                 if attempt < restarts else ""),
+              flush=True)
+    return 1
+
+
+def main(args: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.parallel.launch",
+        description="Multi-process training launcher (SharedTrainingMaster "
+                    "job role): coordinates N workers via jax.distributed; "
+                    "on failure relaunches so workers resume from their "
+                    "latest checkpoint.")
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--restarts", type=int, default=0,
+                    help="relaunch attempts after a worker failure")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-attempt wall-clock limit (seconds)")
+    ap.add_argument("argv", nargs="+",
+                    help="worker script and its args (prefix with --)")
+    ns = ap.parse_args(args)
+    return launch(ns.nprocs, ns.argv, restarts=ns.restarts,
+                  timeout=ns.timeout)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

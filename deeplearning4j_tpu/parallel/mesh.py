@@ -196,7 +196,50 @@ class ParallelWrapper:
             return NamedSharding(self.mesh, P())
         return NamedSharding(self.mesh, P("data", *([None] * (np.ndim(arr) - 1))))
 
-    def fit(self, data, epochs: int = 1, batch_size: int = 32) -> None:
+    def _place(self, arr):
+        """Put a host-local batch onto the mesh. Single-process: device_put
+        with the batch-axis sharding. Multi-process (the launcher path):
+        each host supplies ITS shard of the global batch and the global
+        array assembles via make_array_from_process_local_data — the
+        VirtualDataSetIterator per-executor partition, realized as a jax
+        global array (global batch = local batch × process_count)."""
+        if arr is None:
+            return None
+        nproc = jax.process_count()
+        if nproc == 1:
+            a = jnp.asarray(arr)
+            return jax.device_put(a, self._data_spec(a))
+        a = np.asarray(arr)
+        gshape = (a.shape[0] * nproc,) + a.shape[1:]
+        if gshape[0] % self.mesh.shape["data"] != 0:
+            # ragged remainder batch: mirror the single-process replicated
+            # fallback instead of killing the job (which would burn every
+            # launcher restart on the same partial batch). All-gather the
+            # host shards so every process holds the identical global batch,
+            # then run it replicated — same math, no DP speedup, said once.
+            if not getattr(self, "_warned_ragged", False):
+                self._warned_ragged = True
+                logger.warning(
+                    "ParallelWrapper: global batch %d (local %d x %d hosts) "
+                    "is not divisible by the data axis (%d devices) — this "
+                    "batch runs REPLICATED via host all-gather (correct, "
+                    "but no DP speedup).", gshape[0], a.shape[0], nproc,
+                    self.mesh.shape["data"])
+            from jax.experimental import multihost_utils
+
+            global_a = multihost_utils.process_allgather(a)
+            return jax.device_put(jnp.asarray(global_a),
+                                  NamedSharding(self.mesh, P()))
+        sh = NamedSharding(self.mesh, P("data", *([None] * (a.ndim - 1))))
+        return jax.make_array_from_process_local_data(sh, a, gshape)
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            checkpointer=None, checkpoint_every: int = 0) -> None:
+        """``checkpointer`` (parallel.checkpoint.TrainingCheckpointer) +
+        ``checkpoint_every`` N iterations enable the periodic-save path the
+        multi-process launcher's elasticity relies on: every N steps the
+        (replicated) state is pulled back to host and process 0 persists
+        it, so a relaunched job resumes mid-fit (SURVEY §6.3/§6.4)."""
         net = self.net
         if isinstance(data, DataSet):
             data = ListDataSetIterator(data, batch_size=batch_size)
@@ -216,16 +259,10 @@ class ParallelWrapper:
                 for ds in data:
                     net.last_batch_size = ds.num_examples()
                     net._key, sub = jax.random.split(net._key)
-                    x = jax.device_put(jnp.asarray(ds.features),
-                                       self._data_spec(ds.features))
-                    y = jax.device_put(jnp.asarray(ds.labels),
-                                       self._data_spec(ds.labels))
-                    fm = (None if ds.features_mask is None else
-                          jax.device_put(jnp.asarray(ds.features_mask),
-                                         self._data_spec(ds.features_mask)))
-                    lm = (None if ds.labels_mask is None else
-                          jax.device_put(jnp.asarray(ds.labels_mask),
-                                         self._data_spec(ds.labels_mask)))
+                    x = self._place(ds.features)
+                    y = self._place(ds.labels)
+                    fm = self._place(ds.features_mask)
+                    lm = self._place(ds.labels_mask)
                     if self._is_graph:
                         in_name = net.conf.network_inputs[0]
                         out_name = net.conf.network_outputs[0]
@@ -242,6 +279,16 @@ class ParallelWrapper:
                             x, y, fm, lm)
                     net._score = loss
                     net.iteration_count += 1
+                    if (checkpointer is not None and checkpoint_every
+                            and net.iteration_count % checkpoint_every == 0
+                            and jax.process_index() == 0):
+                        # replicated leaves are addressable on every host,
+                        # so the pull-back is local to process 0 — the other
+                        # ranks keep streaming steps
+                        net.params = jax.device_get(params)
+                        net.opt_state = jax.device_get(opt_state)
+                        net.net_state = jax.device_get(net_state)
+                        checkpointer.save(net.iteration_count, net)
                     for lst in net.listeners:
                         lst.iteration_done(net, net.iteration_count,
                                            net.epoch_count, loss)

@@ -1,0 +1,111 @@
+"""Launcher CLI + kill-and-resume recovery test — SURVEY §6.3's translation
+("kill a host process in multi-process CPU tests, recover via
+checkpoint-restart") and §8.2-M5's multi-process launcher.
+
+The worker (examples/distributed_fit.py) runs a REAL ParallelWrapper.fit
+over a 2-process jax.distributed cluster with periodic checkpoints; the
+fault run injects a hard rank-0 death mid-fit, the launcher kills the
+survivor and relaunches, and the resumed run must land on EXACTLY the same
+final parameters as an uninterrupted run (deterministic data + no dropout
+make equality exact, not just within tolerance)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "examples", "distributed_fit.py")
+
+
+def run_launcher(tmp_path, tag, crash_at=0, restarts=0, nprocs=2, steps=12):
+    out = tmp_path / f"{tag}_out.json"
+    ckdir = tmp_path / f"{tag}_ck"
+    argv = [sys.executable, "-m", "deeplearning4j_tpu.parallel.launch",
+            "--nprocs", str(nprocs), "--restarts", str(restarts),
+            "--timeout", "240", "--",
+            WORKER, "--steps", str(steps), "--checkpoint-dir", str(ckdir),
+            "--checkpoint-every", "4", "--out", str(out)]
+    if crash_at:
+        argv += ["--crash-at", str(crash_at),
+                 "--crash-marker", str(tmp_path / f"{tag}_marker")]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(argv, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=500)
+    return proc, out
+
+
+class TestLauncherElastic:
+    def test_clean_multiprocess_fit(self, tmp_path):
+        proc, out = run_launcher(tmp_path, "clean")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        res = json.loads(out.read_text())
+        assert res["final_iteration"] == 12
+        assert res["first_step"] == 0
+        assert len(res["losses"]) == 12
+        # training made progress
+        assert res["losses"][-1] < res["losses"][0]
+
+    def test_kill_worker_and_resume_matches_uninterrupted(self, tmp_path):
+        ref_proc, ref_out = run_launcher(tmp_path, "ref")
+        assert ref_proc.returncode == 0, ref_proc.stdout + ref_proc.stderr
+        ref = json.loads(ref_out.read_text())
+
+        # crash rank 0 at step 10 (after the step-8 checkpoint); one restart
+        proc, out = run_launcher(tmp_path, "fault", crash_at=10, restarts=1)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "injected crash" in proc.stdout
+        assert "relaunching" in proc.stdout
+        res = json.loads(out.read_text())
+        # the final attempt resumed from the step-8 checkpoint, not step 0
+        assert res["first_step"] == 8
+        assert res["final_iteration"] == 12
+        # resumed loss curve matches the uninterrupted run's tail
+        for a, b in zip(res["losses"], ref["losses"][8:]):
+            assert abs(a - b) < 1e-6, (res["losses"], ref["losses"])
+        # and the final parameters are IDENTICAL
+        assert res["param_sha256"] == ref["param_sha256"]
+
+    def test_launcher_reports_failure_when_no_restarts(self, tmp_path):
+        proc, _ = run_launcher(tmp_path, "nofix", crash_at=6, restarts=0)
+        assert proc.returncode == 1
+
+
+class TestCheckpointRngStream:
+    def test_rng_key_round_trips(self, tmp_path):
+        """Exact resume includes the training RNG stream — a restored net
+        must continue the dropout-mask sequence, not replay it from step 0."""
+        import jax
+        import numpy as np
+
+        from deeplearning4j_tpu import nn
+        from deeplearning4j_tpu.parallel.checkpoint import TrainingCheckpointer
+
+        def build():
+            return nn.MultiLayerNetwork(
+                nn.builder().seed(3).list()
+                .layer(nn.DenseLayer(n_out=4, activation="tanh", dropout=0.5))
+                .layer(nn.OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(nn.InputType.feed_forward(3)).build()).init()
+
+        net = build()
+        x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+        y = np.eye(2)[np.random.RandomState(1).randint(0, 2, 8)]
+        for _ in range(5):
+            net.fit(x, y)  # advances net._key
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        ck.save(5, net)
+
+        fresh = build()
+        before = np.asarray(jax.random.key_data(fresh._key))
+        assert ck.restore(fresh) == 5
+        after = np.asarray(jax.random.key_data(fresh._key))
+        want = np.asarray(jax.random.key_data(net._key))
+        assert not np.array_equal(after, before)  # actually restored
+        np.testing.assert_array_equal(after, want)

@@ -1,0 +1,126 @@
+"""Hand-rolled raw-JAX ResNet-50 train step — the control experiment for
+docs/PERF_ANALYSIS.md: if this runs at the same speed as the framework's
+ComputationGraph step, the framework adds no overhead and the remaining
+bound is XLA's own fusion structure, not our graph machinery."""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def bn_train(x, gamma, beta, eps=1e-5):
+    f32 = jnp.float32
+    axes = (0, 1, 2)
+    xf = x.astype(f32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes)
+    inv = lax.rsqrt(var + eps)
+    scale = (inv * gamma).astype(x.dtype)
+    shift = (beta - mean * inv * gamma).astype(x.dtype)
+    return x * scale + shift
+
+
+def bottleneck(params, x, stride, project):
+    s = x
+    y = conv(x, params["w1"], stride)
+    y = jax.nn.relu(bn_train(y, params["g1"], params["b1"]))
+    y = conv(y, params["w2"])
+    y = jax.nn.relu(bn_train(y, params["g2"], params["b2"]))
+    y = conv(y, params["w3"])
+    y = bn_train(y, params["g3"], params["b3"])
+    if project:
+        s = conv(x, params["ws"], stride)
+        s = bn_train(s, params["gs"], params["bs"])
+    return jax.nn.relu(y + s)
+
+
+STAGES = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+
+
+def init_params(key, dtype=jnp.float32):
+    r = np.random.RandomState(0)
+
+    def w(shape):
+        fan_in = np.prod(shape[:-1])
+        return jnp.asarray((r.randn(*shape) * np.sqrt(2.0 / fan_in))
+                           .astype(np.float32), dtype)
+
+    params = {"conv1": w((7, 7, 3, 64)),
+              "g0": jnp.ones((64,), dtype), "b0": jnp.zeros((64,), dtype)}
+    c_in = 64
+    for si, (f, blocks, stride) in enumerate(STAGES):
+        for bi in range(blocks):
+            p = {}
+            p["w1"] = w((1, 1, c_in, f))
+            p["g1"], p["b1"] = jnp.ones((f,), dtype), jnp.zeros((f,), dtype)
+            p["w2"] = w((3, 3, f, f))
+            p["g2"], p["b2"] = jnp.ones((f,), dtype), jnp.zeros((f,), dtype)
+            p["w3"] = w((1, 1, f, 4 * f))
+            p["g3"], p["b3"] = jnp.ones((4 * f,), dtype), jnp.zeros((4 * f,), dtype)
+            if bi == 0:
+                p["ws"] = w((1, 1, c_in, 4 * f))
+                p["gs"], p["bs"] = jnp.ones((4 * f,), dtype), jnp.zeros((4 * f,), dtype)
+            params[f"s{si}b{bi}"] = p
+            c_in = 4 * f
+    params["fc_w"] = w((2048, 1000))
+    params["fc_b"] = jnp.zeros((1000,), dtype)
+    return params
+
+
+def forward(params, x):
+    y = conv(x, params["conv1"], 2)
+    y = jax.nn.relu(bn_train(y, params["g0"], params["b0"]))
+    y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    for si, (f, blocks, stride) in enumerate(STAGES):
+        for bi in range(blocks):
+            y = bottleneck(params[f"s{si}b{bi}"], y,
+                           stride if bi == 0 else 1, bi == 0)
+    y = jnp.mean(y, axis=(1, 2))
+    return y.astype(jnp.float32) @ params["fc_w"].astype(jnp.float32) + \
+        params["fc_b"]
+
+
+def main():
+    batch = 128
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.rand(batch, 224, 224, 3).astype(np.float32))
+    labels = jnp.asarray(r.randint(0, 1000, batch))
+    params = init_params(jax.random.key(0))
+
+    def loss_fn(p, xb):
+        xb = xb.astype(jnp.bfloat16)
+        pb = jax.tree.map(lambda a: a.astype(jnp.bfloat16)
+                          if a.dtype == jnp.float32 else a, p)
+        logits = forward(pb, xb)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(logp[jnp.arange(batch), labels])
+
+    @jax.jit
+    def step(p, xb):
+        loss, g = jax.value_and_grad(loss_fn)(p, xb)
+        p = jax.tree.map(lambda a, d: a - 0.1 * d.astype(a.dtype), p, g)
+        return p, loss
+
+    params, loss = step(params, x)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        params, loss = step(params, x)
+    jax.block_until_ready(params)
+    dt = (time.perf_counter() - t0) / n
+    print(f"raw jax resnet50: {dt * 1e3:.2f} ms/step  {batch / dt:.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()
