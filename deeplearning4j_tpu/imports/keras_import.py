@@ -225,9 +225,15 @@ def _assemble_sequential(specs, input_type) -> nn.MultiLayerNetwork:
     return net
 
 
-def import_keras_model(model, input_type: Optional[C.InputType] = None) -> nn.MultiLayerNetwork:
-    """In-memory tf.keras Sequential → MultiLayerNetwork (the
-    KerasModelImport.importKerasSequentialModelAndWeights role)."""
+def import_keras_model(model, input_type: Optional[C.InputType] = None):
+    """In-memory tf.keras model → MultiLayerNetwork (Sequential) or
+    ComputationGraph (functional) — the KerasModelImport.importKeras*
+    dispatch for live models."""
+    if not any(c.__name__ == "Sequential" for c in type(model).__mro__):
+        weights_map = {kl.name: [np.asarray(w) for w in kl.get_weights()]
+                       for kl in model.layers}
+        config = {"class_name": "Functional", "config": model.get_config()}
+        return import_keras_functional_config(config, weights_map)
     specs = []
     for kl in model.layers:
         cls = type(kl).__name__
@@ -441,7 +447,9 @@ def _infer_input_type_from_shape(shape):
     if len(shape) == 4:
         return C.InputType.convolutional(shape[1], shape[2], shape[3])
     if len(shape) == 3:
-        return C.InputType.recurrent(shape[2])
+        # keep the static sequence length when keras declares one — layers
+        # like Permute/LocallyConnected1D need it for shape inference
+        return C.InputType.recurrent(shape[2], shape[1] or -1)
     if len(shape) == 5:
         return C.InputType.convolutional3d(shape[1], shape[2], shape[3],
                                            shape[4])
@@ -474,6 +482,7 @@ _MERGE_LAYERS = {
     "Multiply": ("elementwise", "product"),
     "Average": ("elementwise", "average"),
     "Maximum": ("elementwise", "max"),
+    "Minimum": ("elementwise", "min"),
     "Concatenate": ("merge", None),
 }
 
@@ -872,4 +881,299 @@ def _gru(cfg, weights):
         # keras default emits the LAST step only → wrap in LastTimeStep
         return C.LastTimeStep(fwd=lc.to_dict(), name=cfg.get("name")), \
             {"inner": p}
+    return lc, p
+
+
+# ---------------------------------------------------------------------------
+# Widened mapper table (round 4): normalization, shape ops, ConvLSTM2D,
+# locally-connected, attention, preprocessing layers — toward the
+# reference's ~100 KerasLayer mappers (SURVEY §3.3).
+# ---------------------------------------------------------------------------
+
+
+@KerasLayerMapper.register("LayerNormalization")
+def _layer_norm(cfg, weights):
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        if len(axis) != 1:
+            raise NotImplementedError("LayerNormalization over multiple axes")
+        axis = axis[0]
+    if axis not in (-1,):
+        raise NotImplementedError("LayerNormalization import requires the "
+                                  "trailing axis (keras default)")
+    lc = C.LayerNormalization(eps=float(cfg.get("epsilon", 1e-3)),
+                              activation="identity", name=cfg.get("name"))
+    p = {}
+    idx = 0
+    if cfg.get("scale", True):
+        p["gain"] = weights[idx]; idx += 1
+    if cfg.get("center", True):
+        p["b"] = weights[idx]
+    return lc, p
+
+
+@KerasLayerMapper.register("GroupNormalization")
+def _group_norm(cfg, weights):
+    lc = C.GroupNormalization(groups=int(cfg.get("groups", 32)),
+                              eps=float(cfg.get("epsilon", 1e-3)),
+                              activation="identity", name=cfg.get("name"))
+    p = {}
+    idx = 0
+    if cfg.get("scale", True):
+        p["gamma"] = weights[idx]; idx += 1
+    if cfg.get("center", True):
+        p["beta"] = weights[idx]
+    return lc, p
+
+
+@KerasLayerMapper.register("Permute")
+def _permute(cfg, weights):
+    return C.PermuteLayer(dims=tuple(cfg["dims"]), name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("Reshape")
+def _reshape_layer(cfg, weights):
+    return C.ReshapeLayer(target_shape=tuple(cfg["target_shape"]),
+                          name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("UnitNormalization")
+def _unit_norm(cfg, weights):
+    return C.UnitNormLayer(name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("Rescaling")
+def _rescaling(cfg, weights):
+    return C.RescaleLayer(scale=cfg.get("scale", 1.0),
+                          offset=cfg.get("offset", 0.0),
+                          name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("Normalization")
+def _normalization(cfg, weights):
+    # adapted Normalization stores mean/variance as weights [mean, var(, count)]
+    if len(weights) >= 2:
+        mean, var = np.asarray(weights[0]), np.asarray(weights[1])
+    else:
+        mean = np.asarray(cfg.get("mean", 0.0))
+        var = np.asarray(cfg.get("variance", 1.0))
+    inv = 1.0 / np.sqrt(var + 1e-12)
+    return C.RescaleLayer(scale=inv.tolist(), offset=(-mean * inv).tolist(),
+                          name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("ThresholdedReLU")
+def _thresholded_relu(cfg, weights):
+    if float(cfg.get("theta", 1.0)) != 1.0:
+        raise NotImplementedError("ThresholdedReLU import with theta != 1.0")
+    return C.ActivationLayer(activation="thresholdedrelu",
+                             name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("ActivityRegularization")
+def _activity_reg(cfg, weights):
+    import warnings
+
+    warnings.warn("ActivityRegularization imports as identity: activation "
+                  "penalties do not transfer (inference parity only)",
+                  stacklevel=2)
+    return C.ActivationLayer(activation="identity", name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("Identity")
+def _identity_layer(cfg, weights):
+    return C.ActivationLayer(activation="identity", name=cfg.get("name")), {}
+
+
+# train-time data-augmentation layers: identity at inference by definition
+for _aug in ("RandomFlip", "RandomRotation", "RandomZoom",
+             "RandomTranslation", "RandomContrast", "RandomBrightness"):
+    def _aug_mapper(cfg, weights, _cls=_aug):
+        import warnings
+
+        warnings.warn(f"{_cls} imports as identity (augmentation is "
+                      "train-time only; re-augment in your input pipeline)",
+                      stacklevel=2)
+        return C.ActivationLayer(activation="identity",
+                                 name=cfg.get("name")), {}
+
+    KerasLayerMapper.register(_aug)(_aug_mapper)
+
+
+@KerasLayerMapper.register("LocallyConnected1D")
+def _locally_connected_1d(cfg, weights):
+    lc = C.LocallyConnected1D(
+        n_out=int(cfg["filters"]),
+        kernel=int(cfg["kernel_size"][0] if isinstance(cfg["kernel_size"],
+                                                       (list, tuple))
+                   else cfg["kernel_size"]),
+        stride=int(cfg.get("strides", [1])[0] if isinstance(
+            cfg.get("strides", 1), (list, tuple)) else cfg.get("strides", 1)),
+        activation=_act(cfg), name=cfg.get("name"))
+    p = {"W": weights[0]}
+    if cfg.get("use_bias", True) and len(weights) > 1:
+        p["b"] = weights[1]
+    return lc, p
+
+
+@KerasLayerMapper.register("LocallyConnected2D")
+def _locally_connected_2d(cfg, weights):
+    if cfg.get("padding", "valid") != "valid":
+        raise NotImplementedError("LocallyConnected2D 'same' padding import")
+    kh, kw = _pair(cfg["kernel_size"])
+    lc = C.LocallyConnected2D(
+        n_out=int(cfg["filters"]), kernel=(kh, kw),
+        stride=_pair(cfg.get("strides", 1)), activation=_act(cfg),
+        name=cfg.get("name"))
+    w = np.asarray(weights[0])  # (oh*ow, kh*kw*cin, filters), (kh,kw,C) order
+    pos, feat, fo = w.shape
+    cin = feat // (kh * kw)
+    # our impl consumes conv_general_dilated_patches features in (C, kh, kw)
+    # order — permute the keras (kh, kw, C) flatten accordingly
+    w = w.reshape(pos, kh, kw, cin, fo).transpose(0, 3, 1, 2, 4)
+    p = {"W": w.reshape(pos, feat, fo)}
+    if cfg.get("use_bias", True) and len(weights) > 1:
+        p["b"] = weights[1]
+    return lc, p
+
+
+@KerasLayerMapper.register("ConvLSTM2D")
+def _conv_lstm_2d(cfg, weights):
+    if cfg.get("go_backwards", False):
+        raise NotImplementedError("ConvLSTM2D with go_backwards=True")
+    strides = cfg.get("strides", (1, 1))
+    if _pair(strides) != (1, 1):
+        raise NotImplementedError("ConvLSTM2D import with strides != 1")
+    lc = C.ConvLSTM2D(
+        filters=int(cfg["filters"]), kernel=_pair(cfg["kernel_size"]),
+        padding="same" if cfg.get("padding", "valid") == "same" else "truncate",
+        return_sequences=bool(cfg.get("return_sequences", False)),
+        activation=_ACT_MAP.get(cfg.get("activation", "tanh"), "tanh"),
+        gate_activation=_ACT_MAP.get(cfg.get("recurrent_activation",
+                                             "hard_sigmoid"), "hardsigmoid"),
+        name=cfg.get("name"))
+
+    def regate(w):
+        i, f, c, o = np.split(w, 4, axis=-1)  # keras i,f,c,o -> ours i,f,o,g
+        return np.concatenate([i, f, o, c], axis=-1)
+
+    p = {"W": regate(weights[0]), "RW": regate(weights[1])}
+    if cfg.get("use_bias", True) and len(weights) > 2:
+        p["b"] = regate(weights[2])
+    return lc, p
+
+
+@KerasLayerMapper.register("SeparableConv1D")
+def _separable_conv1d(cfg, weights):
+    k = cfg["kernel_size"]
+    k = int(k[0] if isinstance(k, (list, tuple)) else k)
+    s = cfg.get("strides", 1)
+    s = int(s[0] if isinstance(s, (list, tuple)) else s)
+    lc = C.SeparableConvolution1D(
+        n_out=int(cfg["filters"]), kernel=k, stride=s,
+        convolution_mode="same" if cfg.get("padding", "valid") == "same"
+        else "truncate",
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        activation=_act(cfg), has_bias=cfg.get("use_bias", True),
+        name=cfg.get("name"))
+    dw = np.asarray(weights[0])  # keras (k, cin, mult)
+    kk, cin, mult = dw.shape
+    p = {"dW": dw.reshape(kk, 1, cin * mult),
+         "pW": np.asarray(weights[1])}  # (1, cin*mult, cout)
+    if cfg.get("use_bias", True) and len(weights) > 2:
+        p["b"] = weights[2]
+    return lc, p
+
+
+_KERAS_LAMBDAS: Dict[str, Any] = {}
+
+
+def register_lambda(name: str, layer_conf_factory):
+    """KerasLambda parity: the reference requires user-registered lambda
+    implementations (KerasLayer.registerLambdaLayer). Register a factory
+    ``fn(cfg, weights) -> (LayerConf, params)`` under the Lambda layer's
+    NAME."""
+    _KERAS_LAMBDAS[name] = layer_conf_factory
+    return layer_conf_factory
+
+
+@KerasLayerMapper.register("Lambda")
+def _lambda_layer(cfg, weights):
+    name = cfg.get("name")
+    factory = _KERAS_LAMBDAS.get(name)
+    if factory is None:
+        raise NotImplementedError(
+            f"Keras Lambda layer '{name}' needs a registered implementation "
+            f"— call keras_import.register_lambda('{name}', factory) first "
+            f"(the reference's registerLambdaLayer contract)")
+    return factory(cfg, weights)
+
+
+@KerasLayerMapper.register("MultiHeadAttention")
+def _multi_head_attention(cfg, weights):
+    """Keras MHA → AttentionVertex (multi-input graph layer; functional
+    models wire (query, value[, key]) — keras_order handles the swap).
+    Keras kernels (d, H, hd) / (H, hd, d_out) flatten to our 2-D Wq..Wo."""
+    heads = int(cfg["num_heads"])
+    key_dim = int(cfg["key_dim"])
+    value_dim = cfg.get("value_dim")
+    if value_dim is not None and int(value_dim) != key_dim:
+        raise NotImplementedError(
+            "MultiHeadAttention import with value_dim != key_dim")
+    d = heads * key_dim
+    use_bias = bool(cfg.get("use_bias", True))
+    ws = [np.asarray(w) for w in weights]
+    if use_bias:
+        wq, bq, wk, bk, wv, bv, wo, bo = ws[:8]
+    else:
+        wq, wk, wv, wo = ws[:4]
+        bq = bk = bv = bo = None
+    lc = C.AttentionVertex(n_out=d, n_heads=heads, keras_order=True,
+                           has_bias=use_bias, d_out=wo.shape[-1],
+                           name=cfg.get("name"))
+    p = {"Wq": wq.reshape(wq.shape[0], d), "Wk": wk.reshape(wk.shape[0], d),
+         "Wv": wv.reshape(wv.shape[0], d), "Wo": wo.reshape(d, wo.shape[-1])}
+    if use_bias:
+        p.update({"bq": bq.reshape(d), "bk": bk.reshape(d),
+                  "bv": bv.reshape(d), "bo": bo.reshape(-1)})
+    return lc, p
+
+
+@KerasLayerMapper.register("Attention")
+def _attention_layer(cfg, weights):
+    scale = np.asarray(weights[0]) if (cfg.get("use_scale") and weights) \
+        else None
+    if cfg.get("score_mode", "dot") != "dot":
+        raise NotImplementedError("Keras Attention score_mode != 'dot'")
+    return C.DotAttentionLayer(use_scale=bool(cfg.get("use_scale", False)),
+                               additive=False,
+                               scale=None if scale is None else scale.tolist(),
+                               name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("AdditiveAttention")
+def _additive_attention_layer(cfg, weights):
+    scale = np.asarray(weights[0]).tolist() if (cfg.get("use_scale", True)
+                                                and weights) else None
+    return C.DotAttentionLayer(use_scale=bool(cfg.get("use_scale", True)),
+                               additive=True, scale=scale,
+                               name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("Conv1DTranspose")
+def _conv1d_transpose(cfg, weights):
+    k = cfg["kernel_size"]
+    k = int(k[0] if isinstance(k, (list, tuple)) else k)
+    s = cfg.get("strides", 1)
+    s = int(s[0] if isinstance(s, (list, tuple)) else s)
+    w = np.asarray(weights[0])  # keras: (k, out, in)
+    lc = C.Deconvolution1D(
+        n_in=w.shape[2], n_out=w.shape[1], kernel=k, stride=s,
+        convolution_mode="same" if cfg.get("padding", "valid") == "same"
+        else "truncate",
+        activation=_act(cfg), has_bias=cfg.get("use_bias", True),
+        name=cfg.get("name"))
+    p = {"W": w.transpose(0, 2, 1)}  # (k, in, out)
+    if cfg.get("use_bias", True) and len(weights) > 1:
+        p["b"] = weights[1]
     return lc, p

@@ -653,9 +653,10 @@ def _conv_transpose_onnx(sd, ins, attrs, node, const_values=None):
         raise NotImplementedError("asymmetric ConvTranspose pads import")
     x = _to_nhwc(sd, ins[0])
     w = sd._record("transpose", [ins[1]], {"axes": (2, 3, 0, 1)})  # (I,O,H,W)→HWIO
-    # ONNX ConvTranspose SCATTERS the kernel as-is; our deconv2d is the
-    # conv-gradient form (spatially flipped kernel) — flip to compensate
-    w = sd._record("reverse", [w], {"axis": (0, 1)})
+    # ONNX ConvTranspose SCATTERS the kernel as-is — exactly deconv2d's
+    # semantics now that it matches TF conv_transpose at every stride
+    # (round 4: the old path needed a compensating flip and still diverged
+    # at stride>1)
     y = sd._record("deconv2d", [x, w] + ([ins[2]] if len(ins) > 2 else []), {
         "stride": (int(strides[0]), int(strides[1])),
         "padding": ((int(pads[0]), int(pads[2])), (int(pads[1]), int(pads[3])))

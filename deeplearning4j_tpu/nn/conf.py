@@ -580,9 +580,14 @@ class AttentionVertex(LayerConf):
     n_in_queries: int = 0
     n_in_keys: int = 0
     n_in_values: int = 0
+    # Keras MultiHeadAttention call order is (query, VALUE, key) — set by
+    # the importer so 3-input wiring lands on (q, k, v) internally
+    keras_order: bool = False
+    has_bias: bool = False
+    d_out: int = 0  # output projection width when != n_out (keras MHA)
 
     def output_type(self, itype):
-        return InputType.recurrent(self.n_out, itype.timesteps)
+        return InputType.recurrent(self.d_out or self.n_out, itype.timesteps)
 
     def has_params(self):
         return True
@@ -1135,9 +1140,207 @@ PREPROCESSORS = {
 }
 
 
+
+@dataclasses.dataclass(frozen=True)
+class PermuteLayer(LayerConf):
+    """Axis permutation of the non-batch dims (Keras Permute parity; the
+    reference maps it through KerasPermute -> PermutePreprocessor).
+    ``dims`` are 1-indexed non-batch axes, Keras convention."""
+
+    dims: tuple = ()
+
+    def output_type(self, itype):
+        if itype.kind == "recurrent" and tuple(self.dims) == (2, 1):
+            return InputType.recurrent(itype.timesteps, itype.size)
+        if itype.kind == "convolutional" and len(self.dims) == 3:
+            hwc = (itype.height, itype.width, itype.channels)
+            ph, pw, pc = (hwc[d - 1] for d in self.dims)
+            return InputType.convolutional(ph, pw, pc)
+        if itype.kind == "feedforward":
+            return itype
+        raise ValueError(
+            f"PermuteLayer: cannot infer the permuted shape for dims "
+            f"{self.dims} on a {itype.kind} input")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshapeLayer(LayerConf):
+    """Batch-preserving reshape (KerasReshape -> ReshapePreprocessor
+    parity). ``target_shape`` excludes the batch dim; -1 infers."""
+
+    target_shape: tuple = ()
+
+    def output_type(self, itype):
+        flat = itype.flat_size()
+        shape = list(self.target_shape)
+        if -1 in shape:
+            known = 1
+            for s in shape:
+                if s != -1:
+                    known *= int(s)
+            shape[shape.index(-1)] = flat // max(known, 1)
+        if len(shape) == 1:
+            return InputType.feed_forward(shape[0])
+        if len(shape) == 2:
+            return InputType.recurrent(shape[1], shape[0])
+        if len(shape) == 3:
+            return InputType.convolutional(shape[0], shape[1], shape[2])
+        return InputType.feed_forward(flat)
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerNormalization(LayerConf):
+    """Trailing-axis layer norm with learned gain/bias — the Keras
+    LayerNormalization surface (the reference's samediff layer_norm op,
+    libnd4j ops/declarable/generic/nn/layer_norm.cpp, as a layer)."""
+
+    n_out: int = 0
+    eps: float = 1e-3
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupNormalization(LayerConf):
+    """Group norm over the channel axis (Keras GroupNormalization parity);
+    groups=-1 degenerates to instance norm, groups=1 to layer norm over
+    spatial+channel."""
+
+    n_out: int = 0
+    groups: int = 32
+    eps: float = 1e-3
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class RescaleLayer(LayerConf):
+    """out = x * scale + offset with per-feature broadcast — the Keras
+    Rescaling / adapted-Normalization preprocessing surface."""
+
+    scale: Any = 1.0
+    offset: Any = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class UnitNormLayer(LayerConf):
+    """L2-normalize along the trailing axis (Keras UnitNormalization)."""
+
+    eps: float = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvLSTM2D(LayerConf):
+    """Convolutional LSTM over (N, T, H, W, C) — KerasConvLSTM2D parity
+    (the reference maps it onto its ConvLSTM; here gates are conv2d ops
+    inside one lax.scan, so the MXU sees batched convs per step).
+
+    Keras gate order i, f, c, o re-packs to our i, f, o, g at import."""
+
+    n_in: int = 0
+    filters: int = 0
+    kernel: tuple = (3, 3)
+    padding: str = "same"
+    return_sequences: bool = False
+    gate_activation: str = "sigmoid"
+
+    def has_params(self):
+        return True
+
+    def output_type(self, itype):
+        if self.padding not in ("same", "truncate", "valid"):
+            raise ValueError(f"ConvLSTM2D padding {self.padding!r}")
+        h, w = itype.height, itype.width
+        if self.padding in ("truncate", "valid"):
+            h = h - self.kernel[0] + 1
+            w = w - self.kernel[1] + 1
+        if self.return_sequences:
+            return InputType("convolutional3d", depth=itype.depth or -1,
+                             height=h, width=w, channels=self.filters)
+        return InputType.convolutional(h, w, self.filters)
+
+
+
+@dataclasses.dataclass(frozen=True)
+class DotAttentionLayer(LayerConf):
+    """Param-free Keras Attention / AdditiveAttention surface: multi-input
+    (query, value[, key]) in KERAS order. ``additive`` picks Bahdanau
+    scoring (tanh(q+k) reduced by ``scale`` when use_scale)."""
+
+    use_scale: bool = False
+    additive: bool = False
+    scale: Any = None  # adapted scale vector (AdditiveAttention weights)
+
+    def output_type(self, itype):
+        return itype
+
+
+@dataclasses.dataclass(frozen=True)
+class SeparableConvolution1D(LayerConf):
+    """Depthwise + pointwise temporal conv over (N, T, C) — the Keras
+    SeparableConv1D surface (reference SeparableConvolution2D.java family,
+    one dim down)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    convolution_mode: str = "truncate"
+    depth_multiplier: int = 1
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        t = itype.timesteps
+        if t and t > 0:
+            if self.convolution_mode == "same":
+                t = -(-t // self.stride)
+            else:
+                t = (t - self.kernel) // self.stride + 1
+        return InputType.recurrent(self.n_out, t)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class Deconvolution1D(LayerConf):
+    """Transposed temporal conv over (N, T, C) — Keras Conv1DTranspose
+    surface (Deconvolution2D.java family, one dim down)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        t = itype.timesteps
+        if t and t > 0:
+            if self.convolution_mode == "same":
+                t = t * self.stride
+            else:
+                t = (t - 1) * self.stride + self.kernel
+        return InputType.recurrent(self.n_out, t)
+
+    def has_params(self):
+        return True
+
 LAYER_TYPES = {
     c.__name__: c
     for c in [
+        Deconvolution1D,
+        SeparableConvolution1D,
+        DotAttentionLayer,
+        PermuteLayer,
+        ReshapeLayer,
+        LayerNormalization,
+        GroupNormalization,
+        RescaleLayer,
+        UnitNormLayer,
+        ConvLSTM2D,
         DenseLayer,
         OutputLayer,
         LossLayer,
@@ -1440,8 +1643,13 @@ def _adapt(conf, i, itype, lc) -> Tuple[InputType, LayerConf]:
             updates["n_in"] = itype.size
         elif itype.kind in ("convolutional", "convolutional3d"):
             updates["n_in"] = itype.channels
-    if isinstance(lc, BatchNormalization) and lc.n_out == 0:
-        updates["n_out"] = itype.channels if itype.kind == "convolutional" else itype.flat_size()
+    if isinstance(lc, (BatchNormalization, LayerNormalization,
+                       GroupNormalization)) and lc.n_out == 0:
+        # all three normalize the trailing (feature/channel) axis
+        updates["n_out"] = itype.channels \
+            if itype.kind in ("convolutional", "convolutional3d") \
+            else (itype.size if itype.kind == "recurrent"
+                  else itype.flat_size())
     if isinstance(lc, LocallyConnected2D) and tuple(lc.input_size) == (0, 0):
         updates["input_size"] = (itype.height, itype.width)
     if isinstance(lc, LocallyConnected1D) and lc.input_size == 0:

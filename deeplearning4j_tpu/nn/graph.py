@@ -114,6 +114,11 @@ class ElementWiseVertex(GraphVertex):
             for x in inputs[1:]:
                 out = jnp.maximum(out, x)
             return out
+        if op == "min":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
+            return out
         raise ValueError(f"unknown ElementWiseVertex op {self.op}")
 
 

@@ -779,26 +779,41 @@ class AttentionVertexImpl(Layer):
         lc = self.lc
         ks = jax.random.split(key, 4)
         d = lc.n_out
+        d_out = getattr(lc, "d_out", 0) or d
         nq = lc.n_in_queries or lc.n_in_keys
         nk = lc.n_in_keys or nq
         nv = lc.n_in_values or nk
-        return {
+        p = {
             "Wq": init_weights(ks[0], (nq, d), self.winit, dtype=self.dtype),
             "Wk": init_weights(ks[1], (nk, d), self.winit, dtype=self.dtype),
             "Wv": init_weights(ks[2], (nv, d), self.winit, dtype=self.dtype),
-            "Wo": init_weights(ks[3], (d, d), self.winit, dtype=self.dtype),
+            "Wo": init_weights(ks[3], (d, d_out), self.winit, dtype=self.dtype),
         }
+        if getattr(lc, "has_bias", False):
+            p.update({"bq": jnp.zeros((d,), self.dtype),
+                      "bk": jnp.zeros((d,), self.dtype),
+                      "bv": jnp.zeros((d,), self.dtype),
+                      "bo": jnp.zeros((d_out,), self.dtype)})
+        return p
 
     def apply_multi(self, params, xs, state, *, train, rng, mask=None):
         from deeplearning4j_tpu.ops import exec_op
 
-        queries = xs[0]
-        keys = xs[1] if len(xs) > 1 else xs[0]
-        values = xs[2] if len(xs) > 2 else keys
+        if getattr(self.lc, "keras_order", False) and len(xs) >= 2:
+            # Keras MultiHeadAttention call order: (query, VALUE[, key])
+            queries = xs[0]
+            values = xs[1]
+            keys = xs[2] if len(xs) > 2 else values
+        else:
+            queries = xs[0]
+            keys = xs[1] if len(xs) > 1 else xs[0]
+            values = xs[2] if len(xs) > 2 else keys
         out = exec_op("multi_head_dot_product_attention",
                       queries, keys, values,
                       params["Wq"], params["Wk"], params["Wv"], params["Wo"],
-                      mask, num_heads=self.lc.n_heads)
+                      mask, num_heads=self.lc.n_heads,
+                      bq=params.get("bq"), bk=params.get("bk"),
+                      bv=params.get("bv"), bo=params.get("bo"))
         return out, state, mask
 
     def apply(self, params, x, state, *, train, rng, mask=None):
@@ -1309,6 +1324,222 @@ class CapsuleStrengthLayerImpl(Layer):
         return jnp.sqrt(jnp.sum(jnp.square(x), axis=-1) + 1e-12), state, mask
 
 
+
+class PermuteLayerImpl(Layer):
+    """Keras Permute parity: reorder non-batch axes (dims are 1-indexed)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        perm = (0,) + tuple(int(d) for d in self.lc.dims)
+        return jnp.transpose(x, perm), state, mask
+
+
+class ReshapeLayerImpl(Layer):
+    """Keras Reshape parity: batch-preserving reshape with -1 inference."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return x.reshape((x.shape[0],) + tuple(int(s) for s in
+                                               self.lc.target_shape)), \
+            state, mask
+
+
+class LayerNormalizationImpl(Layer):
+    """Trailing-axis layer norm with learned gain/bias (layer_norm op)."""
+
+    def init(self, key) -> Params:
+        n = self.lc.n_out
+        return {"gain": jnp.ones((n,), self.dtype),
+                "b": jnp.zeros((n,), self.dtype)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        y = nn_ops.layer_norm.fn(x, params["gain"], params["b"],
+                                 axis=-1, eps=self.lc.eps)
+        return self.activation(y), state, mask
+
+
+class GroupNormalizationImpl(Layer):
+    """Group norm: normalize per (sample, group) over spatial dims +
+    in-group channels, then per-channel scale/shift."""
+
+    def init(self, key) -> Params:
+        n = self.lc.n_out
+        return {"gamma": jnp.ones((n,), self.dtype),
+                "beta": jnp.zeros((n,), self.dtype)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        c = x.shape[-1]
+        g = lc.groups if lc.groups > 0 else c
+        xg = x.reshape(x.shape[:-1] + (g, c // g))
+        # per (sample, group): reduce spatial dims + in-group channels,
+        # NOT across groups (keras GroupNormalization semantics)
+        axes = tuple(i for i in range(1, xg.ndim) if i != xg.ndim - 2)
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        y = ((xg - mean) * jax.lax.rsqrt(var + lc.eps)).reshape(x.shape)
+        y = y * params["gamma"] + params["beta"]
+        return self.activation(y), state, mask
+
+
+class RescaleLayerImpl(Layer):
+    """out = x * scale + offset (Keras Rescaling / adapted Normalization)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        scale = jnp.asarray(self.lc.scale, x.dtype)
+        offset = jnp.asarray(self.lc.offset, x.dtype)
+        return x * scale + offset, state, mask
+
+
+class UnitNormLayerImpl(Layer):
+    """L2-normalize along the trailing axis (Keras UnitNormalization)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+        return x / jnp.maximum(norm, self.lc.eps), state, mask
+
+
+class ConvLSTM2DImpl(Layer):
+    """Convolutional LSTM over (N, T, H, W, C): gate pre-activations are
+    conv2d(x_t, W) + conv2d(h, RW) + b, one lax.scan over time so each step
+    is a batched MXU conv (KerasConvLSTM2D parity; gate order i, f, o, g
+    after import re-packing)."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        kh, kw = lc.kernel
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": init_weights(k1, (kh, kw, lc.n_in, 4 * lc.filters),
+                              self.winit, dtype=self.dtype),
+            "RW": init_weights(k2, (kh, kw, lc.filters, 4 * lc.filters),
+                               self.winit, dtype=self.dtype),
+            "b": jnp.zeros((4 * lc.filters,), self.dtype),
+        }
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        gate_act = get_activation(lc.gate_activation)
+        pad = "same" if lc.padding == "same" else "valid"
+
+        def conv(a, w, p):
+            return jax.lax.conv_general_dilated(
+                a, w, (1, 1), p,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        # input convs for ALL timesteps in one batched conv: (N*T, H, W, C)
+        n, t = x.shape[0], x.shape[1]
+        zx = conv(x.reshape((n * t,) + x.shape[2:]), params["W"], pad.upper())
+        zx = zx.reshape((n, t) + zx.shape[1:]) + params["b"]
+        h0 = jnp.zeros((n,) + zx.shape[2:-1] + (lc.filters,), x.dtype)
+
+        def step(carry, zt):
+            h, c = carry
+            # the recurrent conv is ALWAYS 'same' — the carried state must
+            # keep its spatial shape (keras ConvLSTM2D semantics)
+            gates = zt + conv(h, params["RW"], "SAME")
+            i, f, o, g = jnp.split(gates, 4, axis=-1)
+            # keras applies `activation` to BOTH candidate and cell output
+            c_new = gate_act(f) * c + gate_act(i) * self.activation(g)
+            h_new = gate_act(o) * self.activation(c_new)
+            return (h_new, c_new), h_new
+
+        (h_last, _), hs = jax.lax.scan(step, (h0, h0),
+                                       jnp.swapaxes(zx, 0, 1))
+        if lc.return_sequences:
+            return jnp.swapaxes(hs, 0, 1), state, mask
+        return h_last, state, None
+
+
+
+class DotAttentionLayerImpl(Layer):
+    """Param-free Keras Attention / AdditiveAttention: inputs in KERAS
+    order (query, value[, key]); key defaults to value."""
+
+    def apply_multi(self, params, xs, state, *, train, rng, mask=None):
+        q = xs[0]
+        v = xs[1] if len(xs) > 1 else xs[0]
+        k = xs[2] if len(xs) > 2 else v
+        lc = self.lc
+        if lc.additive:
+            # Bahdanau: score[b,i,j] = sum(scale * tanh(q_i + k_j))
+            t = jnp.tanh(q[:, :, None, :] + k[:, None, :, :])
+            if lc.use_scale and lc.scale is not None:
+                t = t * jnp.asarray(lc.scale, t.dtype)
+            scores = jnp.sum(t, axis=-1)
+        else:
+            scores = jnp.einsum("bqd,bkd->bqk", q, k)
+            if lc.use_scale and lc.scale is not None:
+                scores = scores * jnp.asarray(lc.scale, scores.dtype)
+        if mask is not None and mask.shape[-1] == k.shape[1]:
+            # key-padding mask: padded positions get no attention weight
+            scores = jnp.where(mask[:, None, :] > 0, scores,
+                               jnp.asarray(-1e9, scores.dtype))
+        w = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bqk,bkd->bqd", w, v), state, mask
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        return self.apply_multi(params, [x], state, train=train, rng=rng,
+                                mask=mask)
+
+
+
+class SeparableConvolution1DImpl(Layer):
+    """Depthwise (grouped) + pointwise conv over (N, T, C)."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        k1, k2 = jax.random.split(key)
+        mult = lc.depth_multiplier
+        p = {"dW": init_weights(k1, (lc.kernel, 1, lc.n_in * mult),
+                                self.winit, dtype=self.dtype),
+             "pW": init_weights(k2, (1, lc.n_in * mult, lc.n_out),
+                                self.winit, dtype=self.dtype)}
+        if lc.has_bias:
+            p["b"] = jnp.zeros((lc.n_out,), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        pad = "SAME" if lc.convolution_mode == "same" else "VALID"
+        dn = ("NWC", "WIO", "NWC")
+        z = jax.lax.conv_general_dilated(
+            x, params["dW"], (lc.stride,), pad, dimension_numbers=dn,
+            feature_group_count=lc.n_in)
+        z = jax.lax.conv_general_dilated(
+            z, params["pW"], (1,), "VALID", dimension_numbers=dn)
+        if "b" in params:
+            z = z + params["b"]
+        if mask is not None and z.shape[1] != mask.shape[1]:
+            mask = mask[:, ::lc.stride][:, :z.shape[1]]
+        return self.activation(z), state, mask
+
+
+
+class Deconvolution1DImpl(Layer):
+    """Transposed temporal conv over (N, T, C)."""
+
+    def init(self, key) -> Params:
+        lc = self.lc
+        p = {"W": init_weights(key, (lc.kernel, lc.n_in, lc.n_out),
+                               self.winit, dtype=self.dtype)}
+        if lc.has_bias:
+            p["b"] = jnp.zeros((lc.n_out,), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        pad = "SAME" if lc.convolution_mode == "same" else "VALID"
+        # transpose_kernel=True = TF conv1d_transpose semantics (exact at
+        # every stride); W stored (k, in, out) like the 2D convention
+        z = jax.lax.conv_transpose(
+            x, jnp.swapaxes(params["W"], 1, 2), (lc.stride,), pad,
+            dimension_numbers=("NWC", "WIO", "NWC"), transpose_kernel=True)
+        if "b" in params:
+            z = z + params["b"]
+        return self.activation(z), state, None
+
+
 LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.DenseLayer: DenseLayerImpl,
     C.OutputLayer: OutputLayerImpl,
@@ -1359,6 +1590,16 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.MaskLayer: MaskLayerImpl,
     C.MaskZeroLayer: MaskZeroLayerImpl,
     C.RepeatVector: RepeatVectorImpl,
+    C.Deconvolution1D: Deconvolution1DImpl,
+    C.SeparableConvolution1D: SeparableConvolution1DImpl,
+    C.DotAttentionLayer: DotAttentionLayerImpl,
+    C.PermuteLayer: PermuteLayerImpl,
+    C.ReshapeLayer: ReshapeLayerImpl,
+    C.LayerNormalization: LayerNormalizationImpl,
+    C.GroupNormalization: GroupNormalizationImpl,
+    C.RescaleLayer: RescaleLayerImpl,
+    C.UnitNormLayer: UnitNormLayerImpl,
+    C.ConvLSTM2D: ConvLSTM2DImpl,
     C.ElementWiseMultiplicationLayer: ElementWiseMultiplicationLayerImpl,
     C.FrozenLayerWithBackprop: FrozenLayerWithBackpropImpl,
     C.CenterLossOutputLayer: CenterLossOutputLayerImpl,

@@ -156,8 +156,12 @@ def deconv2d(x, w, b=None, *, stride: IntPair = 1, padding="same"):
     pad = "SAME" if (isinstance(padding, str) and padding.upper() == "SAME") else (
         "VALID" if isinstance(padding, str) else tuple((int(p), int(p)) for p in _pair(padding))
     )
+    # transpose_kernel=True gives the exact gradient-of-conv semantics TF/
+    # keras Conv2DTranspose uses — without it, stride>1 results diverge
+    # (stride-1 outputs are identical either way)
     out = lax.conv_transpose(
-        x, w, strides=s, padding=pad, dimension_numbers=("NHWC", "HWIO", "NHWC")
+        x, jnp.swapaxes(w, 2, 3), strides=s, padding=pad,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), transpose_kernel=True
     )
     if b is not None:
         out = out + b
@@ -466,15 +470,19 @@ def dot_product_attention(q, k, v, mask=None, *, scaled: bool = True,
 
 @op("multi_head_dot_product_attention")
 def multi_head_dot_product_attention(q, k, v, wq, wk, wv, wo, mask=None, *,
-                                     num_heads: int, scaled: bool = True):
-    """Projected multi-head attention, q/k/v: [B, L, D]; w*: [D, D]."""
+                                     num_heads: int, scaled: bool = True,
+                                     bq=None, bk=None, bv=None, bo=None):
+    """Projected multi-head attention, q/k/v: [B, L, D]; w*: [D, D].
+    Optional per-projection biases (Keras MultiHeadAttention use_bias)."""
 
-    def split(x, w):
+    def split(x, w, bias):
         y = jnp.einsum("bld,de->ble", x, w)
+        if bias is not None:
+            y = y + bias
         b, l, d = y.shape
         return y.reshape(b, l, num_heads, d // num_heads).transpose(0, 2, 1, 3)
 
-    qh, kh, vh = split(q, wq), split(k, wk), split(v, wv)
+    qh, kh, vh = split(q, wq, bq), split(k, wk, bk), split(v, wv, bv)
     m = None
     if mask is not None:
         m = mask[:, None, None, :].astype(bool)
@@ -483,7 +491,8 @@ def multi_head_dot_product_attention(q, k, v, wq, wk, wv, wo, mask=None, *,
     out = dot_product_attention(qh, kh, vh, m, scaled=scaled)
     b, h, l, d = out.shape
     out = out.transpose(0, 2, 1, 3).reshape(b, l, h * d)
-    return jnp.einsum("ble,ed->bld", out, wo)
+    out = jnp.einsum("ble,ed->bld", out, wo)
+    return out if bo is None else out + bo
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,259 @@
+"""Round-4 Keras mapper golden tests: LayerNormalization, Permute/Reshape,
+ConvLSTM2D, LocallyConnected, SeparableConv1D, MultiHeadAttention,
+Attention, preprocessing layers — each built with in-env keras and compared
+elementwise (reference modelimport test pattern, SURVEY §5.4)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.imports.keras_import import (
+    KerasLayerMapper, import_keras_model)
+
+
+def assert_outputs_match(model, net, x, rtol=1e-4, atol=1e-5):
+    golden = model(x, training=False).numpy()
+    got = net.output(x)
+    np.testing.assert_allclose(got, golden, rtol=rtol, atol=atol)
+
+
+class TestRound4Mappers:
+    def test_mapper_count_at_least_80(self):
+        from deeplearning4j_tpu.imports.keras_import import _MERGE_LAYERS
+
+        total = len(KerasLayerMapper.MAPPERS) + len(_MERGE_LAYERS)
+        assert total >= 80, total
+
+    def test_layer_normalization(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((10,)),
+            tf.keras.layers.Dense(8, activation="relu"),
+            tf.keras.layers.LayerNormalization(),
+            tf.keras.layers.Dense(3),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(0).randn(4, 10).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_group_normalization(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((6, 6, 8)),
+            tf.keras.layers.GroupNormalization(groups=4),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(1).randn(2, 6, 6, 8).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_permute_reshape(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 6)),
+            tf.keras.layers.Permute((2, 1)),
+            tf.keras.layers.Reshape((3, 8)),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(2).randn(3, 4, 6).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_conv_lstm_2d(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((5, 8, 8, 3)),
+            tf.keras.layers.ConvLSTM2D(4, (3, 3), padding="same",
+                                       return_sequences=False),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(3).rand(2, 5, 8, 8, 3).astype(np.float32)
+        assert_outputs_match(model, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_conv_lstm_2d_return_sequences(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 6, 6, 2)),
+            tf.keras.layers.ConvLSTM2D(3, (3, 3), padding="valid",
+                                       return_sequences=True),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(4).rand(2, 4, 6, 6, 2).astype(np.float32)
+        assert_outputs_match(model, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_locally_connected_1d_oracle(self):
+        """Keras 3 dropped LocallyConnected*, so the mapper is golden-tested
+        against a numpy oracle in the LEGACY keras weight layout
+        (output_len, k*cin, filters), position p consuming x[p*s : p*s+k]."""
+        from deeplearning4j_tpu import nn
+        from deeplearning4j_tpu.imports.keras_import import KerasLayerMapper
+
+        r = np.random.RandomState(5)
+        t_in, cin, k, filt = 10, 4, 3, 6
+        ot = t_in - k + 1
+        kw_ = r.randn(ot, k * cin, filt).astype(np.float32)
+        b = r.randn(ot, filt).astype(np.float32)
+        cfg = {"filters": filt, "kernel_size": [k], "strides": [1],
+               "activation": "linear", "use_bias": True, "name": "lc1"}
+        lc, p = KerasLayerMapper.MAPPERS["LocallyConnected1D"](cfg, [kw_, b])
+        bld = nn.builder().seed(0).list()
+        bld.layer(lc)
+        net = nn.MultiLayerNetwork(
+            bld.set_input_type(nn.InputType.recurrent(cin, t_in)).build()).init()
+        net.params[0].update({kk: np.asarray(v) for kk, v in p.items()})
+        x = r.randn(2, t_in, cin).astype(np.float32)
+        want = np.zeros((2, ot, filt), np.float32)
+        for pos in range(ot):
+            win = x[:, pos:pos + k, :].reshape(2, -1)  # (k, cin) flatten
+            want[:, pos] = win @ kw_[pos] + b[pos]
+        np.testing.assert_allclose(net.output(x), want, rtol=1e-4, atol=1e-5)
+
+    def test_locally_connected_2d_oracle(self):
+        from deeplearning4j_tpu import nn
+        from deeplearning4j_tpu.imports.keras_import import KerasLayerMapper
+
+        r = np.random.RandomState(6)
+        h = w = 6
+        cin, kh, kw_sz, filt = 2, 3, 3, 4
+        oh, ow = h - kh + 1, w - kw_sz + 1
+        kern = r.randn(oh * ow, kh * kw_sz * cin, filt).astype(np.float32)
+        b = r.randn(oh, ow, filt).astype(np.float32)
+        cfg = {"filters": filt, "kernel_size": [kh, kw_sz], "strides": [1, 1],
+               "activation": "linear", "use_bias": True, "name": "lc2"}
+        lc, p = KerasLayerMapper.MAPPERS["LocallyConnected2D"](cfg, [kern, b])
+        bld = nn.builder().seed(0).list()
+        bld.layer(lc)
+        net = nn.MultiLayerNetwork(
+            bld.set_input_type(nn.InputType.convolutional(h, w, cin)).build()).init()
+        net.params[0].update({kk: np.asarray(v) for kk, v in p.items()})
+        x = r.randn(2, h, w, cin).astype(np.float32)
+        want = np.zeros((2, oh, ow, filt), np.float32)
+        for i in range(oh):
+            for j in range(ow):
+                # legacy keras layout: (kh, kw, C)-major patch flatten
+                win = x[:, i:i + kh, j:j + kw_sz, :].reshape(2, -1)
+                want[:, i, j] = win @ kern[i * ow + j] + b[i, j]
+        np.testing.assert_allclose(net.output(x), want, rtol=1e-4, atol=1e-5)
+
+    def test_separable_conv1d(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((12, 4)),
+            tf.keras.layers.SeparableConv1D(6, 3, padding="same",
+                                            activation="relu"),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(7).randn(2, 12, 4).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_preprocessing_layers(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Rescaling(scale=2.0, offset=0.5),
+            tf.keras.layers.UnitNormalization(),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(8).randn(4, 6).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_normalization_adapted(self):
+        norm = tf.keras.layers.Normalization()
+        data = np.random.RandomState(9).randn(64, 5).astype(np.float32) * 3 + 1
+        norm.adapt(data)
+        model = tf.keras.Sequential([tf.keras.layers.Input((5,)), norm])
+        net = import_keras_model(model)
+        x = data[:4]
+        assert_outputs_match(model, net, x)
+
+    def test_multi_head_attention_functional(self):
+        inp = tf.keras.layers.Input((6, 16))
+        mha = tf.keras.layers.MultiHeadAttention(num_heads=4, key_dim=4)
+        out = mha(inp, inp)  # self-attention
+        out = tf.keras.layers.Dense(3)(out)
+        model = tf.keras.Model(inp, out)
+        net = import_keras_model(model)
+        x = np.random.RandomState(10).randn(2, 6, 16).astype(np.float32)
+        golden = model(x, training=False).numpy()
+        got = net.output(x)[0]  # functional import -> ComputationGraph
+        np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+    def test_attention_functional(self):
+        q_in = tf.keras.layers.Input((5, 8))
+        v_in = tf.keras.layers.Input((7, 8))
+        out = tf.keras.layers.Attention()([q_in, v_in])
+        model = tf.keras.Model([q_in, v_in], out)
+        net = import_keras_model(model)
+        r = np.random.RandomState(11)
+        q = r.randn(2, 5, 8).astype(np.float32)
+        v = r.randn(2, 7, 8).astype(np.float32)
+        golden = model([q, v], training=False).numpy()
+        got = net.output(q, v)[0]
+        np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+    def test_identity_mappers_warn(self):
+        with pytest.warns(UserWarning, match="identity"):
+            model = tf.keras.Sequential([
+                tf.keras.layers.Input((4,)),
+                tf.keras.layers.ActivityRegularization(l2=0.1),
+            ])
+            net = import_keras_model(model)
+        x = np.random.RandomState(12).randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), x)
+
+    def test_lambda_requires_registration(self):
+        from deeplearning4j_tpu.imports.keras_import import (
+            register_lambda)
+        from deeplearning4j_tpu.nn import conf as C
+
+        cfg = {"name": "my_double"}
+        with pytest.raises(NotImplementedError, match="register_lambda"):
+            KerasLayerMapper.MAPPERS["Lambda"](cfg, [])
+        register_lambda("my_double", lambda c, w: (
+            C.RescaleLayer(scale=2.0, name=c.get("name")), {}))
+        lc, _ = KerasLayerMapper.MAPPERS["Lambda"](cfg, [])
+        assert lc.scale == 2.0
+
+    def test_merge_minimum_functional(self):
+        a = tf.keras.layers.Input((6,))
+        b = tf.keras.layers.Input((6,))
+        out = tf.keras.layers.Minimum()([a, b])
+        model = tf.keras.Model([a, b], out)
+        net = import_keras_model(model)
+        r = np.random.RandomState(13)
+        xa = r.randn(3, 6).astype(np.float32)
+        xb = r.randn(3, 6).astype(np.float32)
+        golden = model([xa, xb], training=False).numpy()
+        got = net.output(xa, xb)[0]
+        np.testing.assert_allclose(got, golden, rtol=1e-5)
+
+    def test_conv1d_transpose(self):
+        for pad, stride in (("same", 2), ("valid", 1), ("same", 1)):
+            model = tf.keras.Sequential([
+                tf.keras.layers.Input((8, 3)),
+                tf.keras.layers.Conv1DTranspose(5, 3, strides=stride,
+                                                padding=pad,
+                                                activation="relu"),
+            ])
+            net = import_keras_model(model)
+            x = np.random.RandomState(14).randn(2, 8, 3).astype(np.float32)
+            assert_outputs_match(model, net, x)
+
+    def test_permute_then_dense(self):
+        """Permute keeps a structured InputType so a following Dense applies
+        to the (permuted) trailing axis, exactly like keras."""
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 6)),
+            tf.keras.layers.Permute((2, 1)),
+            tf.keras.layers.Dense(3),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(15).randn(2, 4, 6).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_layer_norm_direct_build(self):
+        """LayerNormalization/GroupNormalization infer n_out at build like
+        BatchNormalization (no (0,)-shaped params)."""
+        from deeplearning4j_tpu import nn
+
+        bld = nn.builder().seed(0).list()
+        bld.layer(nn.DenseLayer(n_out=6, activation="tanh"))
+        bld.layer(nn.conf.LayerNormalization())
+        net = nn.MultiLayerNetwork(
+            bld.set_input_type(nn.InputType.feed_forward(4)).build()).init()
+        assert net.params[1]["gain"].shape == (6,)
+        x = np.random.RandomState(16).randn(3, 4).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (3, 6) and np.isfinite(out).all()
