@@ -1,9 +1,14 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench native
+.PHONY: test gate gate-fast bench native native-test
 
-test:
-	python -m pytest tests/ -q
+# DL4J_TPU_REQUIRE_NATIVE=1: a missing native lib FAILS the ctypes tests
+# instead of silently exercising the numpy fallback (SURVEY §5.3)
+test: native-test
+	DL4J_TPU_REQUIRE_NATIVE=1 python -m pytest tests/ -q
+
+native-test: native
+	ctest --test-dir native/build --output-on-failure
 
 # full pre-snapshot gate: pytest + on-chip consistency + bench smoke +
 # multichip dryrun (tools/gate.py). Run before any round-end commit.
@@ -17,4 +22,4 @@ bench:
 	python bench.py
 
 native:
-	cmake -S native -B native/build -G Ninja && cmake --build native/build
+	cmake -S native -B native/build && cmake --build native/build -j

@@ -914,6 +914,9 @@ def _layer_norm(cfg, weights):
 
 @KerasLayerMapper.register("GroupNormalization")
 def _group_norm(cfg, weights):
+    if cfg.get("axis", -1) not in (-1,):
+        raise NotImplementedError("GroupNormalization import requires the "
+                                  "trailing (channels_last) axis")
     lc = C.GroupNormalization(groups=int(cfg.get("groups", 32)),
                               eps=float(cfg.get("epsilon", 1e-3)),
                               activation="identity", name=cfg.get("name"))
@@ -1041,6 +1044,8 @@ def _locally_connected_2d(cfg, weights):
 def _conv_lstm_2d(cfg, weights):
     if cfg.get("go_backwards", False):
         raise NotImplementedError("ConvLSTM2D with go_backwards=True")
+    if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+        raise NotImplementedError("ConvLSTM2D import with dilation_rate != 1")
     strides = cfg.get("strides", (1, 1))
     if _pair(strides) != (1, 1):
         raise NotImplementedError("ConvLSTM2D import with strides != 1")
@@ -1065,6 +1070,9 @@ def _conv_lstm_2d(cfg, weights):
 
 @KerasLayerMapper.register("SeparableConv1D")
 def _separable_conv1d(cfg, weights):
+    dil = cfg.get("dilation_rate", 1)
+    if int(dil[0] if isinstance(dil, (list, tuple)) else dil) != 1:
+        raise NotImplementedError("SeparableConv1D import with dilation_rate != 1")
     k = cfg["kernel_size"]
     k = int(k[0] if isinstance(k, (list, tuple)) else k)
     s = cfg.get("strides", 1)
@@ -1162,6 +1170,12 @@ def _additive_attention_layer(cfg, weights):
 
 @KerasLayerMapper.register("Conv1DTranspose")
 def _conv1d_transpose(cfg, weights):
+    dil = cfg.get("dilation_rate", 1)
+    if int(dil[0] if isinstance(dil, (list, tuple)) else dil) != 1:
+        raise NotImplementedError("Conv1DTranspose import with dilation_rate != 1")
+    op = cfg.get("output_padding")
+    if op not in (None, [None]) and any(v for v in (op if isinstance(op, (list, tuple)) else [op])):
+        raise NotImplementedError("Conv1DTranspose import with output_padding")
     k = cfg["kernel_size"]
     k = int(k[0] if isinstance(k, (list, tuple)) else k)
     s = cfg.get("strides", 1)

@@ -72,6 +72,12 @@ def _get_lib() -> Optional[ctypes.CDLL]:
         if not _TRIED:
             _TRIED = True
             _LIB = _build_and_load()
+        if _LIB is None and os.environ.get("DL4J_TPU_REQUIRE_NATIVE"):
+            # the CI gate sets this: a broken native build must be RED,
+            # not a silent numpy fallback (round-3 verdict weak #6)
+            raise RuntimeError(
+                "DL4J_TPU_REQUIRE_NATIVE is set but libdl4j_tpu_native.so "
+                "could not be built/loaded — fix the native toolchain stage")
     return _LIB
 
 

@@ -151,7 +151,9 @@ def separable_conv2d(x, depth_w, point_w, b=None, *, stride: IntPair = 1, paddin
 
 @op("deconv2d")
 def deconv2d(x, w, b=None, *, stride: IntPair = 1, padding="same"):
-    """Transposed conv. x: [N,H,W,C_in], w: [kH,kW,C_out,C_in] stored HWOI->use HWIO of transpose."""
+    """Transposed conv, TF conv_transpose semantics at every stride.
+    x: [N,H,W,C_in], w: [kH,kW,C_in,C_out] (same HWIO layout conv2d uses;
+    the op swaps channels internally for the gradient-form kernel)."""
     s = _pair(stride)
     pad = "SAME" if (isinstance(padding, str) and padding.upper() == "SAME") else (
         "VALID" if isinstance(padding, str) else tuple((int(p), int(p)) for p in _pair(padding))

@@ -263,3 +263,26 @@ class TestPixOps:
         norm.transform(ds)
         want = (imgs.astype(np.float32) - norm.mean) / norm.std
         np.testing.assert_allclose(ds.features, want, rtol=1e-5, atol=1e-4)
+
+
+class TestRequireNative:
+    def test_require_native_raises_when_lib_missing(self, monkeypatch):
+        """Under the gate (DL4J_TPU_REQUIRE_NATIVE=1) a missing native lib
+        is a hard error, never a silent numpy fallback."""
+        import pytest
+
+        from deeplearning4j_tpu.native_ops import threshold as T
+
+        monkeypatch.setattr(T, "_LIB", None)
+        monkeypatch.setattr(T, "_TRIED", True)
+        monkeypatch.setenv("DL4J_TPU_REQUIRE_NATIVE", "1")
+        with pytest.raises(RuntimeError, match="REQUIRE_NATIVE"):
+            T._get_lib()
+
+    def test_missing_lib_falls_back_without_flag(self, monkeypatch):
+        from deeplearning4j_tpu.native_ops import threshold as T
+
+        monkeypatch.setattr(T, "_LIB", None)
+        monkeypatch.setattr(T, "_TRIED", True)
+        monkeypatch.delenv("DL4J_TPU_REQUIRE_NATIVE", raising=False)
+        assert T._get_lib() is None  # caller uses the numpy path

@@ -9,12 +9,15 @@ snapshot/round-end commit:
     python tools/gate.py --fast     # pytest only (pre-commit speed)
 
 Stages:
-  1. full pytest suite on the 8-device CPU harness (the unit/gradcheck bar)
-  2. CPU-vs-TPU consistency suite on the real chip (skipped with a WARNING
+  1. native: cmake build + ctest, then an ASAN(-DSANITIZE=ON) build + ctest
+     (the libnd4j tests_cpu CI stage — SURVEY §5.3, §6.2)
+  2. full pytest suite on the 8-device CPU harness with
+     DL4J_TPU_REQUIRE_NATIVE=1 (a missing .so fails ctypes tests loudly)
+  3. CPU-vs-TPU consistency suite on the real chip (skipped with a WARNING
      if no TPU is reachable — never silently)
-  3. bench smoke: LeNet BENCH_ITERS=3 must print one JSON line with a
+  4. bench smoke: LeNet BENCH_ITERS=3 must print one JSON line with a
      finite value (catches "the benchmark itself is broken" regressions)
-  4. multichip dryrun (virtual 8-device CPU mesh via __graft_entry__)
+  5. multichip dryrun (virtual 8-device CPU mesh via __graft_entry__)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -83,13 +86,41 @@ def bench_smoke() -> bool:
     return ok
 
 
+def native_stage() -> bool:
+    """Build the native lib + run ctest, then an ASAN build + ctest
+    (SURVEY §5.3/§6.2 — the libnd4j tests_cpu CI stage)."""
+    steps = [
+        ("cmake configure", ["cmake", "-S", "native", "-B", "native/build"]),
+        ("cmake build", ["cmake", "--build", "native/build", "-j"]),
+        ("ctest", ["ctest", "--test-dir", "native/build",
+                   "--output-on-failure"]),
+        ("cmake configure (ASAN)",
+         ["cmake", "-S", "native", "-B", "native/build-asan",
+          "-DSANITIZE=ON"]),
+        ("cmake build (ASAN)", ["cmake", "--build", "native/build-asan",
+                                "-j"]),
+        ("ctest (ASAN)", ["ctest", "--test-dir", "native/build-asan",
+                          "--output-on-failure"]),
+    ]
+    for name, cmd in steps:
+        if not run(f"native: {name}", cmd, timeout=600):
+            return False
+    return True
+
+
 def main() -> int:
     fast = "--fast" in sys.argv
     results = {}
 
+    if not fast:  # --fast stays "pytest only" (pre-commit speed)
+        results["native"] = native_stage()
+
+    # DL4J_TPU_REQUIRE_NATIVE: under the gate, a missing .so FAILS the
+    # ctypes tests instead of silently exercising the numpy fallback
     results["pytest"] = run(
         "pytest (CPU harness)",
         [sys.executable, "-m", "pytest", "tests/", "-q", "-x"],
+        env={"DL4J_TPU_REQUIRE_NATIVE": "1"},
         timeout=2400)
 
     if not fast:
