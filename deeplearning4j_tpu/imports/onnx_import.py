@@ -702,3 +702,156 @@ def _instance_norm_onnx(sd, ins, attrs, node):
 
 
 _NEEDS_CONSTS |= {"Expand", "Tile", "Split", "Slice", "TopK", "ConvTranspose"}
+
+
+# ---------------------------------------------------------------------------
+# Round-4 widening: recurrent op imports + Resize (reference
+# samediff-import-onnx LSTM/GRU/Resize declarations).
+# ---------------------------------------------------------------------------
+
+
+@register_onnx_op("LSTM")
+def _lstm_onnx(sd, ins, attrs, node, const_values=None):
+    """ONNX LSTM (single forward direction): X:(T,N,I), W:(1,4H,I) gates
+    i,o,f,c; R:(1,4H,H); B:(1,8H). The gate/axis re-packing is RECORDED as
+    graph ops over the original W/R/B variables, so an imported model
+    fine-tunes through them (trainable_consts contract)."""
+    _reject_extra_rnn_inputs(node, {4: "sequence_lens", 5: "initial_h",
+                                    6: "initial_c", 7: "peepholes (P)"})
+    hidden = int(attrs["hidden_size"])
+    w_ih = _regate_matrix(sd, ins[1], 4, [0, 2, 3, 1])   # i,o,f,c -> i,f,c,o
+    w_hh = _regate_matrix(sd, ins[2], 4, [0, 2, 3, 1])
+    b = _rnn_bias(sd, ins, node, 3, 4, [0, 2, 3, 1], hidden)
+    x_nt = sd._record("transpose", [ins[0]], {"axes": (1, 0, 2)})
+    ys, h_t, c_t = sd._record("lstm_sequence", [x_nt, w_ih, w_hh, b],
+                              n_out=3)
+    y_tn = sd._record("transpose", [ys], {"axes": (1, 0, 2)})
+    y = sd._record("expand_dims", [y_tn], {"axis": 1})
+    h_out = sd._record("expand_dims", [h_t], {"axis": 0})
+    c_out = sd._record("expand_dims", [c_t], {"axis": 0})
+    return (y, h_out, c_out)
+
+
+@register_onnx_op("GRU")
+def _gru_onnx(sd, ins, attrs, node, const_values=None):
+    """ONNX GRU (single forward direction): gates z,r,h -> our r,z,n;
+    linear_before_reset maps directly onto gru_sequence. Weight re-packing
+    is recorded in-graph (trainable like every other imported weight)."""
+    _reject_extra_rnn_inputs(node, {4: "sequence_lens", 5: "initial_h"})
+    hidden = int(attrs["hidden_size"])
+    lbr = bool(int(attrs.get("linear_before_reset", 0)))
+    w_ih = _regate_matrix(sd, ins[1], 3, [1, 0, 2])      # z,r,h -> r,z,h
+    w_hh = _regate_matrix(sd, ins[2], 3, [1, 0, 2])
+    if len(node.inputs) > 3 and node.inputs[3]:
+        bb = sd._record("squeeze", [ins[3]], {"axis": (0,)})
+        wb, rb = sd._record("split", [bb], {"num_split": 2, "axis": 0},
+                            n_out=2)
+        b_ih = _reorder_vector(sd, wb, 3, [1, 0, 2])
+        b_hh = _reorder_vector(sd, rb, 3, [1, 0, 2])
+    else:
+        z = np.zeros(3 * hidden, np.float32)
+        b_ih = sd.constant(node.name + "_bih", z)
+        b_hh = sd.constant(node.name + "_bhh", z)
+    x_nt = sd._record("transpose", [ins[0]], {"axes": (1, 0, 2)})
+    ys, h_t = sd._record("gru_sequence", [x_nt, w_ih, w_hh, b_ih, b_hh],
+                         {"linear_before_reset": lbr}, n_out=2)
+    y_tn = sd._record("transpose", [ys], {"axes": (1, 0, 2)})
+    y = sd._record("expand_dims", [y_tn], {"axis": 1})
+    h_out = sd._record("expand_dims", [h_t], {"axis": 0})
+    return (y, h_out)
+
+
+def _reject_extra_rnn_inputs(node, slots):
+    """Raise loudly for optional recurrent inputs we do not lower yet —
+    checked on node.inputs (the wire slots), NOT the compacted ins list,
+    so an absent bias cannot shift the check off its slot."""
+    direction = node.attrs.get("direction", "forward") \
+        if hasattr(node, "attrs") else "forward"
+    if isinstance(direction, bytes):
+        direction = direction.decode()
+    if direction != "forward":
+        raise NotImplementedError(
+            f"ONNX {node.op_type} direction={direction} import")
+    for idx, what in slots.items():
+        if len(node.inputs) > idx and node.inputs[idx]:
+            raise NotImplementedError(
+                f"ONNX {node.op_type} with {what} input import")
+
+
+def _regate_matrix(sd, v, parts, order):
+    """(1, parts*H, D) gate-stacked weight -> (D, parts*H) in our gate
+    order — recorded as squeeze/split/concat/transpose graph ops."""
+    sq = sd._record("squeeze", [v], {"axis": (0,)})
+    pieces = sd._record("split", [sq], {"num_split": parts, "axis": 0},
+                        n_out=parts)
+    cat = sd._record("concat", [pieces[j] for j in order], {"axis": 0})
+    return sd._record("transpose", [cat], {"axes": (1, 0)})
+
+
+def _reorder_vector(sd, v, parts, order):
+    pieces = sd._record("split", [v], {"num_split": parts, "axis": 0},
+                        n_out=parts)
+    return sd._record("concat", [pieces[j] for j in order], {"axis": 0})
+
+
+def _rnn_bias(sd, ins, node, slot, parts, order, hidden):
+    """LSTM bias: B (1, 2*parts*H) = Wb ++ Rb, both reordered then summed;
+    absent B -> zeros."""
+    if len(node.inputs) > slot and node.inputs[slot]:
+        bb = sd._record("squeeze", [ins[slot]], {"axis": (0,)})
+        wb, rb = sd._record("split", [bb], {"num_split": 2, "axis": 0},
+                            n_out=2)
+        return sd._record("add", [_reorder_vector(sd, wb, parts, order),
+                                  _reorder_vector(sd, rb, parts, order)])
+    return sd.constant(node.name + "_b",
+                       np.zeros(parts * hidden, np.float32))
+
+
+@register_onnx_op("Resize")
+def _resize_onnx(sd, ins, attrs, node, const_values=None):
+    """ONNX Resize: NCHW X + sizes or scales. half_pixel coordinate
+    transform (the opset-11+ default) matches jax.image.resize; other
+    transforms are rejected loudly. The scales form needs a static input
+    shape to derive sizes."""
+    mode = attrs.get("mode", b"nearest")
+    mode = mode.decode() if isinstance(mode, bytes) else str(mode)
+    ct = attrs.get("coordinate_transformation_mode", b"half_pixel")
+    ct = ct.decode() if isinstance(ct, bytes) else str(ct)
+    if ct not in ("half_pixel", "pytorch_half_pixel"):
+        raise NotImplementedError(
+            f"ONNX Resize coordinate_transformation_mode={ct} import "
+            f"(half_pixel only)")
+    op_name = {"nearest": "resize_nearest_neighbor",
+               "linear": "resize_bilinear",
+               "cubic": "resize_bicubic"}.get(mode)
+    if op_name is None:
+        raise NotImplementedError(f"ONNX Resize mode={mode}")
+
+    if len(node.inputs) > 3 and node.inputs[3]:
+        sz = _require_const(const_values, node, 3, "sizes")
+        sizes = (int(sz[2]), int(sz[3]))
+    else:
+        scales = np.asarray(_require_const(const_values, node, 2, "scales"))
+        in_shape = getattr(ins[0], "shape", None)
+        if not in_shape or len(in_shape) != 4 or None in in_shape[2:]:
+            raise NotImplementedError(
+                "ONNX Resize with a scales input needs a static NCHW input "
+                "shape to derive the output size")
+        sizes = (int(round(in_shape[2] * float(scales[2]))),
+                 int(round(in_shape[3] * float(scales[3]))))
+    x = _to_nhwc(sd, ins[0])
+    y = sd._record(op_name, [x], {"size": sizes})
+    return _to_nchw(sd, y)
+
+
+def _require_const(const_values, node, idx, what):
+    name = node.inputs[idx]  # ONNX names are used verbatim (may contain ':')
+    val = (const_values or {}).get(name)
+    if val is None:
+        raise ValueError(
+            f"{node.op_type} {node.name}: dynamic (non-initializer) {what} "
+            f"operand '{name}' is unsupported")
+    return val
+
+
+_NEEDS_CONSTS |= {"LSTM", "GRU", "Resize"}

@@ -14,6 +14,7 @@ from deeplearning4j_tpu.models.zoo import (
     SqueezeNet,
     Xception,
     TinyYOLO,
+    YOLO2,
     InceptionResNetV1,
 )
 from deeplearning4j_tpu.models.hub import ModelHub

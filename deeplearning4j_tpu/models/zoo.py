@@ -719,3 +719,72 @@ class InceptionResNetV1(ZooModel):
                                           loss="mcxent"), "emb_norm")
         b.set_outputs("out")
         return ComputationGraph(b.build()).init()
+
+
+class YOLO2(ZooModel):
+    """zoo/model/YOLO2.java: Darknet19 backbone + the YOLOv2 passthrough —
+    the 26×26×512 mid-level features reorg (SpaceToDepth block 2) and
+    concatenate with the 13×13×1024 deep path before the detection conv
+    emitting B·(5+C) channels per cell (same raw-head convention as
+    TinyYOLO; pair with ops.losses yolo_loss for training)."""
+
+    def __init__(self, num_classes: int = 80, num_boxes: int = 5,
+                 seed: int = 123, updater=None,
+                 input_shape: Tuple[int, int, int] = (416, 416, 3)):
+        self.num_classes = num_classes
+        self.num_boxes = num_boxes
+        self.seed = seed
+        self.updater = updater or nn.Adam(learning_rate=1e-3)
+        self.input_shape = input_shape
+
+    def init(self) -> ComputationGraph:
+        h, w, c = self.input_shape
+        b = (graph_builder().seed(self.seed).updater(self.updater)
+             .weight_init("relu")
+             .add_inputs("input")
+             .set_input_types(input=nn.InputType.convolutional(h, w, c)))
+        idx = 0
+
+        def conv(inp, n, k):
+            nonlocal idx
+            idx += 1
+            b.add_layer(f"c{idx}", nn.ConvolutionLayer(
+                n_out=n, kernel=(k, k), convolution_mode="same",
+                activation="identity", has_bias=False), inp)
+            b.add_layer(f"bn{idx}", nn.BatchNormalization(
+                activation="leakyrelu"), f"c{idx}")
+            return f"bn{idx}"
+
+        def pool(inp):
+            nonlocal idx
+            idx += 1
+            b.add_layer(f"p{idx}", nn.SubsamplingLayer(
+                kernel=(2, 2), stride=(2, 2)), inp)
+            return f"p{idx}"
+
+        x = conv("input", 32, 3)
+        x = pool(x)
+        x = conv(x, 64, 3)
+        x = pool(x)
+        x = conv(conv(conv(x, 128, 3), 64, 1), 128, 3)
+        x = pool(x)
+        x = conv(conv(conv(x, 256, 3), 128, 1), 256, 3)
+        x = pool(x)
+        x = conv(conv(conv(conv(conv(x, 512, 3), 256, 1), 512, 3),
+                      256, 1), 512, 3)
+        route = x  # 26×26×512 passthrough source
+        x = pool(x)
+        x = conv(conv(conv(conv(conv(x, 1024, 3), 512, 1), 1024, 3),
+                      512, 1), 1024, 3)
+        x = conv(conv(x, 1024, 3), 1024, 3)
+        # passthrough: 1×1 squeeze → reorg to 13×13×256 → concat
+        sq = conv(route, 64, 1)
+        b.add_layer("reorg", nn.conf.SpaceToDepthLayer(block_size=2), sq)
+        b.add_vertex("route_cat", MergeVertex(), x, "reorg")
+        x = conv("route_cat", 1024, 3)
+        depth = self.num_boxes * (5 + self.num_classes)
+        b.add_layer("detect", nn.ConvolutionLayer(
+            n_out=depth, kernel=(1, 1), convolution_mode="same",
+            activation="identity"), x)
+        b.set_outputs("detect")
+        return ComputationGraph(b.build()).init()

@@ -1328,9 +1328,23 @@ class Deconvolution1D(LayerConf):
     def has_params(self):
         return True
 
+
+@dataclasses.dataclass(frozen=True)
+class SpaceToDepthLayer(LayerConf):
+    """conf/layers/SpaceToDepthLayer.java: (N,H,W,C) -> (N,H/b,W/b,C*b*b)
+    — the YOLOv2 passthrough/reorg block."""
+
+    block_size: int = 2
+
+    def output_type(self, itype):
+        b = self.block_size
+        return InputType.convolutional(itype.height // b, itype.width // b,
+                                       itype.channels * b * b)
+
 LAYER_TYPES = {
     c.__name__: c
     for c in [
+        SpaceToDepthLayer,
         Deconvolution1D,
         SeparableConvolution1D,
         DotAttentionLayer,

@@ -1540,6 +1540,17 @@ class Deconvolution1DImpl(Layer):
         return self.activation(z), state, None
 
 
+
+class SpaceToDepthLayerImpl(Layer):
+    """layers/convolution/SpaceToDepthLayer.java (YOLOv2 reorg)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        from deeplearning4j_tpu.ops import exec_op
+
+        return exec_op("space_to_depth", x,
+                       block_size=self.lc.block_size), state, mask
+
+
 LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.DenseLayer: DenseLayerImpl,
     C.OutputLayer: OutputLayerImpl,
@@ -1590,6 +1601,7 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.MaskLayer: MaskLayerImpl,
     C.MaskZeroLayer: MaskZeroLayerImpl,
     C.RepeatVector: RepeatVectorImpl,
+    C.SpaceToDepthLayer: SpaceToDepthLayerImpl,
     C.Deconvolution1D: Deconvolution1DImpl,
     C.SeparableConvolution1D: SeparableConvolution1DImpl,
     C.DotAttentionLayer: DotAttentionLayerImpl,

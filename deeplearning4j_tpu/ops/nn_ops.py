@@ -569,3 +569,129 @@ def clip_by_norm(x, *, clip_norm: float, axis=None):
 @op("clip_by_value")
 def clip_by_value(x, *, min_value: float, max_value: float):
     return jnp.clip(x, min_value, max_value)
+
+
+@op("lstm_sequence")
+def lstm_sequence(x, w_ih, w_hh, b, h0=None, c0=None):
+    """Full-sequence LSTM over lstm_cell (gate order i, f, g, o) — ONE
+    lax.scan, batch-major x:[N,T,I]. Returns (ys:[N,T,H], h_T, c_T).
+    The samediff-import surface for ONNX/TF LSTM nodes (reference
+    lstmLayer.cpp full-sequence mode)."""
+    h_dim = w_hh.shape[0]
+    n = x.shape[0]
+    h = jnp.zeros((n, h_dim), x.dtype) if h0 is None else h0
+    c = jnp.zeros((n, h_dim), x.dtype) if c0 is None else c0
+
+    def step(carry, xt):
+        h, c = carry
+        h, c = lstm_cell.fn(xt, h, c, w_ih, w_hh, b)
+        return (h, c), h
+
+    (h, c), ys = lax.scan(step, (h, c), jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1), h, c
+
+
+@op("gru_sequence")
+def gru_sequence(x, w_ih, w_hh, b_ih, b_hh, h0=None, *,
+                 linear_before_reset: bool = True):
+    """Full-sequence GRU, gate order r, z, n; batch-major x:[N,T,I].
+    Returns (ys:[N,T,H], h_T). linear_before_reset=True matches gru_cell
+    (and keras reset_after); False is the ONNX GRU default
+    (h_n = tanh(Wn x + Rn (r*h) + b))."""
+    h_dim = w_hh.shape[0]
+    n = x.shape[0]
+    h = jnp.zeros((n, h_dim), x.dtype) if h0 is None else h0
+
+    def step(h, xt):
+        if linear_before_reset:
+            h_new = gru_cell.fn(xt, h, w_ih, w_hh, b_ih, b_hh)
+        else:
+            gi = xt @ w_ih + b_ih
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h @ w_hh[:, :h_dim] + b_hh[:h_dim])
+            z = jax.nn.sigmoid(i_z + h @ w_hh[:, h_dim:2 * h_dim]
+                               + b_hh[h_dim:2 * h_dim])
+            nn = jnp.tanh(i_n + (r * h) @ w_hh[:, 2 * h_dim:]
+                          + b_hh[2 * h_dim:])
+            h_new = (1.0 - z) * nn + z * h
+        return h_new, h_new
+
+    h, ys = lax.scan(step, h, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1), h
+
+
+def _check_lstm_sequence():
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    n, t, i, h = 2, 5, 3, 4
+    x = r.randn(n, t, i).astype(np.float32)
+    w_ih = r.randn(i, 4 * h).astype(np.float32)
+    w_hh = r.randn(h, 4 * h).astype(np.float32)
+    b = r.randn(4 * h).astype(np.float32)
+    ys, hT, cT = lstm_sequence.fn(jnp.asarray(x), jnp.asarray(w_ih),
+                                  jnp.asarray(w_hh), jnp.asarray(b))
+    # numpy oracle
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    hh = np.zeros((n, h), np.float32)
+    cc = np.zeros((n, h), np.float32)
+    want = np.zeros((n, t, h), np.float32)
+    for s in range(t):
+        z = x[:, s] @ w_ih + hh @ w_hh + b
+        ig, fg, gg, og = np.split(z, 4, axis=-1)
+        cc = sig(fg) * cc + sig(ig) * np.tanh(gg)
+        hh = sig(og) * np.tanh(cc)
+        want[:, s] = hh
+    np.testing.assert_allclose(np.asarray(ys), want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hT), hh, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cT), cc, rtol=1e-5, atol=1e-5)
+
+
+def _check_gru_sequence():
+    import numpy as np
+
+    r = np.random.RandomState(1)
+    n, t, i, h = 2, 4, 3, 5
+    x = r.randn(n, t, i).astype(np.float32)
+    w_ih = r.randn(i, 3 * h).astype(np.float32)
+    w_hh = r.randn(h, 3 * h).astype(np.float32)
+    b_ih = r.randn(3 * h).astype(np.float32)
+    b_hh = r.randn(3 * h).astype(np.float32)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for lbr in (True, False):
+        ys, hT = gru_sequence.fn(jnp.asarray(x), jnp.asarray(w_ih),
+                                 jnp.asarray(w_hh), jnp.asarray(b_ih),
+                                 jnp.asarray(b_hh),
+                                 linear_before_reset=lbr)
+        hh = np.zeros((n, h), np.float32)
+        want = np.zeros((n, t, h), np.float32)
+        for s in range(t):
+            gi = x[:, s] @ w_ih + b_ih
+            i_r, i_z, i_n = np.split(gi, 3, axis=-1)
+            if lbr:
+                gh = hh @ w_hh + b_hh
+                h_r, h_z, h_n = np.split(gh, 3, axis=-1)
+                rr = sig(i_r + h_r)
+                zz = sig(i_z + h_z)
+                nn = np.tanh(i_n + rr * h_n)
+            else:
+                rr = sig(i_r + hh @ w_hh[:, :h] + b_hh[:h])
+                zz = sig(i_z + hh @ w_hh[:, h:2 * h] + b_hh[h:2 * h])
+                nn = np.tanh(i_n + (rr * hh) @ w_hh[:, 2 * h:]
+                             + b_hh[2 * h:])
+            hh = (1.0 - zz) * nn + zz * hh
+            want[:, s] = hh
+        np.testing.assert_allclose(np.asarray(ys), want, rtol=1e-5,
+                                   atol=1e-5, err_msg=f"lbr={lbr}")
+        np.testing.assert_allclose(np.asarray(hT), hh, rtol=1e-5, atol=1e-5)
+
+
+from deeplearning4j_tpu.ops import validation as _validation
+
+_validation.add_case("lstm_sequence", _check_lstm_sequence)
+_validation.add_case("gru_sequence", _check_gru_sequence)

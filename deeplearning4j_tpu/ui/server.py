@@ -52,6 +52,8 @@ _PAGE = """<!DOCTYPE html>
  <svg id="ratio"></svg><div id="ratio-legend" class="legend"></div></div>
 <div class="card"><h2>Parameter histograms (latest iteration)</h2>
  <div id="hists" class="legend">enable StatsListener(collect_histograms=True)</div></div>
+<div class="card"><h2>Model graph</h2>
+ <svg id="graph" style="height:auto"></svg></div>
 <script>
 const COLORS = ['#1976d2','#d32f2f','#388e3c','#f57c00','#7b1fa2',
                 '#00796b','#5d4037','#455a64','#c2185b','#afb42b'];
@@ -100,6 +102,50 @@ function drawHists(containerId, byParam) {
   });
   div.innerHTML = out;
 }
+function drawGraph(svgId, g) {
+  if (!g || !g.nodes || !g.nodes.length) return;
+  const svg = document.getElementById(svgId);
+  // layered layout: node depth = 1 + max depth of producers
+  const depth = {}, incoming = {};
+  g.nodes.forEach(n => depth[n.name] = 0);
+  g.edges.forEach(e => (incoming[e[1]] = incoming[e[1]] || []).push(e[0]));
+  for (let pass = 0; pass < g.nodes.length; pass++) {
+    let changed = false;
+    g.nodes.forEach(n => {
+      const d = 1 + Math.max(-1, ...(incoming[n.name] || [])
+                             .map(p => depth[p] ?? 0));
+      if (d > depth[n.name]) { depth[n.name] = d; changed = true; }
+    });
+    if (!changed) break;
+  }
+  const byDepth = {};
+  g.nodes.forEach(n => (byDepth[depth[n.name]] =
+                        byDepth[depth[n.name]] || []).push(n));
+  const COLW = 170, ROWH = 44, pos = {};
+  let maxRow = 1;
+  Object.keys(byDepth).forEach(d => {
+    byDepth[d].forEach((n, i) => { pos[n.name] = [d * COLW + 10, i * ROWH + 14]; });
+    maxRow = Math.max(maxRow, byDepth[d].length);
+  });
+  const H = maxRow * ROWH + 30;
+  svg.setAttribute('height', H);
+  let out = '';
+  g.edges.forEach(e => {
+    const a = pos[e[0]], b = pos[e[1]];
+    if (!a || !b) return;
+    out += `<line x1="${a[0]+140}" y1="${a[1]+12}" x2="${b[0]}" y2="${b[1]+12}"
+             stroke="#bbb"/>`;
+  });
+  g.nodes.forEach(n => {
+    const p = pos[n.name];
+    const label = n.params ? `${n.name} (${n.params})` : n.name;
+    out += `<rect x="${p[0]}" y="${p[1]}" width="140" height="24" rx="4"
+             fill="#e3f2fd" stroke="#1976d2"/>` +
+           `<text x="${p[0]+6}" y="${p[1]+16}" font-size="10">${label}</text>` +
+           `<title>${n.type}</title>`;
+  });
+  svg.innerHTML = out;
+}
 async function refresh() {
   try {
     const ov = await (await fetch('train/overview')).json();
@@ -108,6 +154,7 @@ async function refresh() {
     drawSeries('ratio', m.update_ratio_log10, 'ratio-legend');
     const hs = await (await fetch('train/histograms')).json();
     drawHists('hists', hs.histograms);
+    drawGraph('graph', await (await fetch('train/graph')).json());
   } catch (e) {}
   setTimeout(refresh, 2000);
 }
@@ -145,7 +192,8 @@ class UIServer:
     def _records(self) -> List[Dict]:
         recs: List[Dict] = []
         for st in self._storages:
-            recs.extend(getattr(st, "records", []))
+            recs.extend(r for r in getattr(st, "records", [])
+                        if "static_model_info" not in r)
         return sorted(recs, key=lambda r: r.get("iteration", 0))
 
     def overview(self) -> Dict:
@@ -185,6 +233,15 @@ class UIServer:
                         "histograms": out}
         return {"iteration": -1, "histograms": {}}
 
+    def graph(self) -> Dict:
+        """Model topology (the reference UI's model-graph pane): the
+        one-time static_model_info record StatsListener emits."""
+        for st in self._storages:
+            for r in getattr(st, "records", []):
+                if "static_model_info" in r:
+                    return r["static_model_info"]
+        return {"kind": "none", "nodes": [], "edges": []}
+
     def sessions(self) -> Dict:
         return {"sessions": list(range(len(self._storages))),
                 "records": len(self._records())}
@@ -210,6 +267,9 @@ class UIServer:
                     ctype = "application/json"
                 elif path.endswith("/train/histograms"):
                     body = json.dumps(ui.histograms()).encode()
+                    ctype = "application/json"
+                elif path.endswith("/train/graph"):
+                    body = json.dumps(ui.graph()).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

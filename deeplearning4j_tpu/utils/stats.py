@@ -67,6 +67,47 @@ class StatsListener:
         self.frequency = max(1, frequency)
         self.collect_histograms = collect_histograms
         self._prev_params: Optional[List[Dict[str, np.ndarray]]] = None
+        self._sent_static = False
+
+    def _static_info(self, model) -> Optional[Dict[str, Any]]:
+        """One-time model topology — the reference dashboard's model-graph
+        pane (StatsInitializationReport static info, SURVEY §6.5)."""
+        def nparams(p):
+            return int(sum(np.asarray(a).size for _, a in _leaves(p)))
+
+        conf = getattr(model, "conf", None)
+        if hasattr(model, "layers") and isinstance(model.layers, dict):
+            # ComputationGraph: real DAG edges from the config
+            nodes, edges = [], []
+            for inp in getattr(conf, "network_inputs", []):
+                nodes.append({"name": inp, "type": "Input", "params": 0})
+            gnodes = getattr(conf, "nodes", None) or []
+            for gn in gnodes:
+                kind = getattr(gn, "kind", "layer")
+                name = getattr(gn, "name", "?")
+                if kind == "layer":
+                    lc = model.layers.get(name)
+                    tname = type(lc.lc).__name__ if lc is not None else "?"
+                    np_ = nparams(model.params.get(name, {}))
+                else:
+                    tname = type(getattr(gn, "vertex", None)).__name__                         if getattr(gn, "vertex", None) is not None else "Vertex"
+                    np_ = 0
+                nodes.append({"name": name, "type": tname, "params": np_})
+                for i in getattr(gn, "inputs", []):
+                    edges.append([i, name])
+            return {"kind": "graph", "nodes": nodes, "edges": edges}
+        if hasattr(model, "layers") and isinstance(model.layers, list):
+            nodes, edges = [{"name": "input", "type": "Input", "params": 0}], []
+            prev = "input"
+            for i, layer in enumerate(model.layers):
+                lc = layer.lc
+                name = lc.name or f"layer_{i}"
+                nodes.append({"name": name, "type": type(lc).__name__,
+                              "params": nparams(model.params[i])})
+                edges.append([prev, name])
+                prev = name
+            return {"kind": "sequential", "nodes": nodes, "edges": edges}
+        return None
 
     def on_epoch_start(self, model):
         pass
@@ -75,6 +116,12 @@ class StatsListener:
         pass
 
     def iteration_done(self, model, iteration, epoch, score):
+        if not self._sent_static:
+            self._sent_static = True
+            info = self._static_info(model)
+            if info is not None:
+                self.storage.put({"static_model_info": info,
+                                  "iteration": -1})
         if iteration % self.frequency != 0:
             return
         rec: Dict[str, Any] = {

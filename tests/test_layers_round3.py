@@ -326,3 +326,18 @@ class TestReviewRegressions:
         out = net.output(np.random.rand(1, 96, 96, 3).astype(np.float32))[0]
         assert out.shape == (1, 4)
         np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-4)
+
+    def test_yolo2_builds_with_passthrough(self):
+        """YOLO2: Darknet19 backbone + SpaceToDepth passthrough concat —
+        zoo/model/YOLO2.java parity (round-4; the reorg halves the route's
+        spatial dims and 4x its channels before the merge)."""
+        from deeplearning4j_tpu.models import YOLO2
+
+        zoo = YOLO2(num_classes=3, num_boxes=2, input_shape=(64, 64, 3))
+        net = zoo.init()
+        x = np.random.rand(1, 64, 64, 3).astype(np.float32)
+        pred = net.output(x)[0]
+        assert pred.shape == (1, 2, 2, 2 * (5 + 3)), pred.shape
+        assert np.isfinite(pred).all()
+        # the passthrough reorg layer exists in the DAG
+        assert "reorg" in net.layers

@@ -61,7 +61,7 @@ def _free_port():
     return port
 
 
-@pytest.mark.timeout(180)
+# (no pytest-timeout in env — the inner communicate(timeout=150) bounds the run)
 def test_two_process_cluster():
     port = _free_port()
     env = {k: v for k, v in os.environ.items()

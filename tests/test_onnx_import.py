@@ -284,3 +284,202 @@ class TestOnnxImport:
                             {"w": w})
         with pytest.raises(NotImplementedError, match="group"):
             import_onnx(model)
+
+
+class TestOnnxRecurrentAndResize:
+    """Round-4 widening: LSTM/GRU sequence ops + Resize, numpy oracles
+    implementing the ONNX operator spec."""
+
+    @staticmethod
+    def _sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    def test_lstm_forward(self):
+        r = np.random.RandomState(0)
+        t, n, i, h = 4, 2, 3, 5
+        x = r.randn(t, n, i).astype(np.float32)
+        W = r.randn(1, 4 * h, i).astype(np.float32)   # gates i,o,f,c
+        R = r.randn(1, 4 * h, h).astype(np.float32)
+        B = r.randn(1, 8 * h).astype(np.float32)
+        nodes = [node_proto("LSTM", ["x", "W", "R", "B"],
+                            ["Y", "Y_h", "Y_c"], hidden_size=h)]
+        model = build_model(nodes, [("x", (t, n, i))],
+                            [("Y", (t, 1, n, h)), ("Y_h", (1, n, h)),
+                             ("Y_c", (1, n, h))],
+                            {"W": W, "R": R, "B": B})
+        from deeplearning4j_tpu.imports import import_onnx
+
+        sd = import_onnx(bytes(model))
+        res = sd.output({"x": x}, ["Y", "Y_h", "Y_c"])
+
+        # ONNX LSTM oracle (spec equations, gates i,o,f,c)
+        Wi, Wo, Wf, Wc = np.split(W[0], 4)
+        Ri, Ro, Rf, Rc = np.split(R[0], 4)
+        Wb, Rb = np.split(B[0], 2)
+        bi, bo, bf, bc = np.split(Wb, 4)
+        rbi, rbo, rbf, rbc = np.split(Rb, 4)
+        hh = np.zeros((n, h), np.float32)
+        cc = np.zeros((n, h), np.float32)
+        Y = np.zeros((t, 1, n, h), np.float32)
+        for s in range(t):
+            xi = x[s]
+            it = self._sig(xi @ Wi.T + hh @ Ri.T + bi + rbi)
+            ot = self._sig(xi @ Wo.T + hh @ Ro.T + bo + rbo)
+            ft = self._sig(xi @ Wf.T + hh @ Rf.T + bf + rbf)
+            ct = np.tanh(xi @ Wc.T + hh @ Rc.T + bc + rbc)
+            cc = ft * cc + it * ct
+            hh = ot * np.tanh(cc)
+            Y[s, 0] = hh
+        np.testing.assert_allclose(res["Y"], Y, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(res["Y_h"][0], hh, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(res["Y_c"][0], cc, rtol=1e-4, atol=1e-5)
+
+    def test_gru_forward_both_lbr(self):
+        r = np.random.RandomState(1)
+        t, n, i, h = 3, 2, 4, 3
+        x = r.randn(t, n, i).astype(np.float32)
+        W = r.randn(1, 3 * h, i).astype(np.float32)   # gates z,r,h
+        R = r.randn(1, 3 * h, h).astype(np.float32)
+        B = r.randn(1, 6 * h).astype(np.float32)
+        from deeplearning4j_tpu.imports import import_onnx
+
+        for lbr in (0, 1):
+            nodes = [node_proto("GRU", ["x", "W", "R", "B"], ["Y", "Y_h"],
+                                hidden_size=h, linear_before_reset=lbr)]
+            model = build_model(nodes, [("x", (t, n, i))],
+                                [("Y", (t, 1, n, h)), ("Y_h", (1, n, h))],
+                                {"W": W, "R": R, "B": B})
+            sd = import_onnx(bytes(model))
+            res = sd.output({"x": x}, ["Y", "Y_h"])
+
+            Wz, Wr, Wh = np.split(W[0], 3)
+            Rz, Rr, Rh = np.split(R[0], 3)
+            Wb, Rb = np.split(B[0], 2)
+            bz, br, bh = np.split(Wb, 3)
+            rbz, rbr, rbh = np.split(Rb, 3)
+            hh = np.zeros((n, h), np.float32)
+            Y = np.zeros((t, 1, n, h), np.float32)
+            for s in range(t):
+                xi = x[s]
+                zt = self._sig(xi @ Wz.T + hh @ Rz.T + bz + rbz)
+                rt = self._sig(xi @ Wr.T + hh @ Rr.T + br + rbr)
+                if lbr:
+                    ht = np.tanh(xi @ Wh.T + rt * (hh @ Rh.T + rbh) + bh)
+                else:
+                    ht = np.tanh(xi @ Wh.T + (rt * hh) @ Rh.T + bh + rbh)
+                hh = (1.0 - zt) * ht + zt * hh
+                Y[s, 0] = hh
+            np.testing.assert_allclose(res["Y"], Y, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"lbr={lbr}")
+            np.testing.assert_allclose(res["Y_h"][0], hh, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_resize_bilinear_half_pixel(self):
+        r = np.random.RandomState(2)
+        x = r.rand(1, 2, 4, 4).astype(np.float32)  # NCHW
+        sizes = np.asarray([1, 2, 8, 8], np.int64)
+        nodes = [node_proto("Resize", ["x", "", "", "sizes"], ["y"],
+                            mode="linear",
+                            coordinate_transformation_mode="half_pixel")]
+        model = build_model(nodes, [("x", (1, 2, 4, 4))],
+                            [("y", (1, 2, 8, 8))], {"sizes": sizes})
+        from deeplearning4j_tpu.imports import import_onnx
+
+        sd = import_onnx(bytes(model))
+        got = sd.output({"x": x}, "y")["y"]
+        assert got.shape == (1, 2, 8, 8)
+
+        # half-pixel bilinear oracle
+        def bilinear(img, oh, ow):
+            ih, iw = img.shape
+            out = np.zeros((oh, ow), np.float32)
+            for a in range(oh):
+                for b in range(ow):
+                    sy = (a + 0.5) * ih / oh - 0.5
+                    sx = (b + 0.5) * iw / ow - 0.5
+                    y0 = int(np.floor(sy)); x0 = int(np.floor(sx))
+                    dy = sy - y0; dx = sx - x0
+                    y0c = np.clip([y0, y0 + 1], 0, ih - 1)
+                    x0c = np.clip([x0, x0 + 1], 0, iw - 1)
+                    out[a, b] = (
+                        img[y0c[0], x0c[0]] * (1 - dy) * (1 - dx)
+                        + img[y0c[0], x0c[1]] * (1 - dy) * dx
+                        + img[y0c[1], x0c[0]] * dy * (1 - dx)
+                        + img[y0c[1], x0c[1]] * dy * dx)
+            return out
+
+        want = np.stack([bilinear(x[0, c], 8, 8) for c in range(2)])[None]
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_resize_rejects_other_transform(self):
+        import pytest
+
+        sizes = np.asarray([1, 1, 2, 2], np.int64)
+        nodes = [node_proto("Resize", ["x", "", "", "sizes"], ["y"],
+                            mode="linear",
+                            coordinate_transformation_mode="align_corners")]
+        model = build_model(nodes, [("x", (1, 1, 4, 4))],
+                            [("y", (1, 1, 2, 2))], {"sizes": sizes})
+        from deeplearning4j_tpu.imports import import_onnx
+
+        with pytest.raises(NotImplementedError, match="align_corners"):
+            import_onnx(bytes(model))
+
+    def test_lstm_weights_are_trainable(self):
+        """The gate re-packing is recorded in-graph, so gradients flow to
+        the ORIGINAL imported W/R/B variables (fine-tune contract)."""
+        r = np.random.RandomState(3)
+        t, n, i, h = 3, 2, 3, 4
+        x = r.randn(t, n, i).astype(np.float32)
+        W = r.randn(1, 4 * h, i).astype(np.float32)
+        R = r.randn(1, 4 * h, h).astype(np.float32)
+        B = r.randn(1, 8 * h).astype(np.float32)
+        nodes = [node_proto("LSTM", ["x", "W", "R", "B"],
+                            ["Y", "Y_h", "Y_c"], hidden_size=h)]
+        model = build_model(nodes, [("x", (t, n, i))],
+                            [("Y", (t, 1, n, h))], {"W": W, "R": R, "B": B})
+        from deeplearning4j_tpu.imports import import_onnx
+
+        sd = import_onnx(bytes(model))
+        assert sd._vars["W"].vtype == "VARIABLE"
+        loss = sd._record("reduce_mean", [sd._vars["Y"]],
+                          {"axes": None, "keepdims": False}).rename("l2loss")
+        grads = sd.calculate_gradients({"x": x}, "l2loss", wrt=["W", "R", "B"])
+        for k in ("W", "R", "B"):
+            assert np.isfinite(grads[k]).all()
+            assert np.abs(grads[k]).max() > 0, f"zero grad for {k}"
+
+    def test_lstm_rejects_initial_state_and_seqlens(self):
+        import pytest
+
+        r = np.random.RandomState(4)
+        h = 3
+        W = r.randn(1, 4 * h, 2).astype(np.float32)
+        R = r.randn(1, 4 * h, h).astype(np.float32)
+        h0 = np.zeros((1, 2, h), np.float32)
+        from deeplearning4j_tpu.imports import import_onnx
+
+        # initial_h on slot 5 with EMPTY B/seq_lens slots — the guard must
+        # check wire slots, not the compacted ins list
+        nodes = [node_proto("LSTM", ["x", "W", "R", "", "", "h0"], ["Y"],
+                            hidden_size=h)]
+        model = build_model(nodes, [("x", (2, 2, 2))], [("Y", (2, 1, 2, h))],
+                            {"W": W, "R": R, "h0": h0})
+        with pytest.raises(NotImplementedError, match="initial_h"):
+            import_onnx(bytes(model))
+
+    def test_resize_from_scales(self):
+        r = np.random.RandomState(5)
+        x = r.rand(1, 2, 4, 4).astype(np.float32)
+        scales = np.asarray([1.0, 1.0, 2.0, 2.0], np.float32)
+        nodes = [node_proto("Resize", ["x", "", "scales"], ["y"],
+                            mode="nearest",
+                            coordinate_transformation_mode="half_pixel")]
+        model = build_model(nodes, [("x", (1, 2, 4, 4))],
+                            [("y", (1, 2, 8, 8))], {"scales": scales})
+        from deeplearning4j_tpu.imports import import_onnx
+
+        sd = import_onnx(bytes(model))
+        got = sd.output({"x": x}, "y")["y"]
+        assert got.shape == (1, 2, 8, 8)
+        np.testing.assert_allclose(got[0, 0, ::2, ::2], x[0, 0], atol=1e-6)

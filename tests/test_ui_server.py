@@ -118,3 +118,88 @@ def test_histograms_endpoint():
         assert len(first["counts"]) == 20
     finally:
         server.stop()
+
+
+class TestModelGraphPane:
+    def test_graph_endpoint_sequential(self):
+        import json
+        import urllib.request
+
+        import numpy as np
+
+        from deeplearning4j_tpu import nn
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.utils.stats import StatsListener, StatsStorage
+
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(0).list()
+            .layer(nn.DenseLayer(n_out=4, activation="tanh"))
+            .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(3)).build()).init()
+        storage = StatsStorage()
+        net.set_listeners(StatsListener(storage))
+        x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+        y = np.eye(2)[np.random.RandomState(1).randint(0, 2, 8)]
+        net.fit(x, y)
+
+        ui = UIServer(port=0).start()
+        try:
+            ui.attach(storage)
+            data = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/train/graph", timeout=10).read())
+            assert data["kind"] == "sequential"
+            names = [n["name"] for n in data["nodes"]]
+            assert names[0] == "input" and len(names) == 3
+            assert data["edges"] == [[names[0], names[1]],
+                                     [names[1], names[2]]]
+            assert data["nodes"][1]["params"] == 3 * 4 + 4  # W + b
+            # score series still clean despite the static record
+            ov = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/train/overview",
+                timeout=10).read())
+            assert len(ov["score"]) == 1
+        finally:
+            ui.stop()
+
+    def test_graph_endpoint_dag(self):
+        import json
+        import urllib.request
+
+        import numpy as np
+
+        from deeplearning4j_tpu import nn
+        from deeplearning4j_tpu.nn.graph import (ElementWiseVertex,
+                                                 graph_builder,
+                                                 ComputationGraph)
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.utils.stats import StatsListener, StatsStorage
+
+        b = (graph_builder().seed(0).updater(nn.Sgd(learning_rate=0.1))
+             .add_inputs("in")
+             .set_input_types(**{"in": nn.InputType.feed_forward(4)}))
+        b.add_layer("d1", nn.DenseLayer(n_out=4, activation="tanh"), "in")
+        b.add_vertex("res", ElementWiseVertex(op="add"), "in", "d1")
+        b.add_layer("out", nn.OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "res")
+        b.set_outputs("out")
+        net = ComputationGraph(b.build()).init()
+        storage = StatsStorage()
+        net.set_listeners(StatsListener(storage))
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.eye(2)[np.random.RandomState(1).randint(0, 2, 8)]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        net.fit(DataSet(x, y))
+
+        ui = UIServer(port=0).start()
+        try:
+            ui.attach(storage)
+            data = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/train/graph", timeout=10).read())
+            assert data["kind"] == "graph"
+            names = {n["name"] for n in data["nodes"]}
+            assert {"in", "d1", "res", "out"} <= names
+            assert ["in", "res"] in data["edges"]
+            assert ["d1", "res"] in data["edges"]
+        finally:
+            ui.stop()
