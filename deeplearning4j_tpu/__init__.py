@@ -22,6 +22,7 @@ Package map (reference layer in parens — SURVEY §2):
   imports/    TF frozen-graph importer                    (samediff-import)
   native_ops/ C++ host-side codecs via ctypes             (libnd4j native role)
   utils/      profiling (chrome trace), UI stats shim     (OpProfiler/UI)
+  arbiter     hyperparameter search                       (arbiter-core)
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,229 @@
+"""Hyperparameter search — the Arbiter module role.
+
+Reference parity (SURVEY §2 "Arbiter(attic)"):
+  * arbiter-core ParameterSpace hierarchy (ContinuousParameterSpace,
+    IntegerParameterSpace, DiscreteParameterSpace),
+  * CandidateGenerator (RandomSearchGenerator, GridSearchCandidateGenerator),
+  * ScoreFunction (EvaluationScoreFunction, TestSetLossScoreFunction),
+  * OptimizationConfiguration + LocalOptimizationRunner with termination
+    conditions (MaxCandidatesCondition, MaxTimeCondition).
+
+TPU-native realization: candidates are plain dicts fed to a user
+``model_builder(params) -> net``; each trial is an ordinary jitted
+fit/eval on the chip. Sequential trials (one chip, XLA compile cache
+shared across same-shaped candidates); the result table is kept so search
+curves can feed the UI/stats pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Parameter spaces (arbiter-core optimize/parameter/*)
+# ---------------------------------------------------------------------------
+
+
+class ParameterSpace:
+    def sample(self, rng: np.random.RandomState):
+        raise NotImplementedError
+
+    def grid(self, n: int) -> List[Any]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class ContinuousParameterSpace(ParameterSpace):
+    """Uniform (or log-uniform) float range — ContinuousParameterSpace.java."""
+
+    low: float
+    high: float
+    log: bool = False
+
+    def sample(self, rng):
+        if self.log:
+            return float(math.exp(rng.uniform(math.log(self.low),
+                                              math.log(self.high))))
+        return float(rng.uniform(self.low, self.high))
+
+    def grid(self, n):
+        if self.log:
+            return [float(v) for v in np.exp(np.linspace(
+                math.log(self.low), math.log(self.high), n))]
+        return [float(v) for v in np.linspace(self.low, self.high, n)]
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerParameterSpace(ParameterSpace):
+    """Inclusive int range — IntegerParameterSpace.java."""
+
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return int(rng.randint(self.low, self.high + 1))
+
+    def grid(self, n):
+        return sorted({int(round(v)) for v in
+                       np.linspace(self.low, self.high, n)})
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscreteParameterSpace(ParameterSpace):
+    """Fixed candidate set — DiscreteParameterSpace.java."""
+
+    values: tuple
+
+    def __init__(self, *values):
+        object.__setattr__(self, "values", tuple(values))
+
+    def sample(self, rng):
+        return self.values[rng.randint(len(self.values))]
+
+    def grid(self, n):
+        return list(self.values)
+
+
+def _is_space(v):
+    return isinstance(v, ParameterSpace)
+
+
+# ---------------------------------------------------------------------------
+# Candidate generators (optimize/generator/*)
+# ---------------------------------------------------------------------------
+
+
+class RandomSearchGenerator:
+    """RandomSearchGenerator.java: independent draws from every space."""
+
+    def __init__(self, space: Dict[str, Any], seed: int = 0):
+        self.space = space
+        self.rng = np.random.RandomState(seed)
+
+    def __iter__(self):
+        while True:
+            yield {k: (v.sample(self.rng) if _is_space(v) else v)
+                   for k, v in self.space.items()}
+
+
+class GridSearchCandidateGenerator:
+    """GridSearchCandidateGenerator.java: cartesian product over per-space
+    discretizations (``discretization`` points for continuous ranges)."""
+
+    def __init__(self, space: Dict[str, Any], discretization: int = 3):
+        self.space = space
+        self.discretization = discretization
+
+    def __iter__(self):
+        keys = list(self.space)
+        axes = [self.space[k].grid(self.discretization)
+                if _is_space(self.space[k]) else [self.space[k]] for k in keys]
+        for combo in itertools.product(*axes):
+            yield dict(zip(keys, combo))
+
+
+# ---------------------------------------------------------------------------
+# Score functions (optimize/scoring/*)
+# ---------------------------------------------------------------------------
+
+
+def test_set_loss_score(net, data) -> float:
+    """TestSetLossScoreFunction: average loss on held-out data (minimize)."""
+    total, n = 0.0, 0
+    for ds in data:
+        total += float(net.score(ds)) * ds.num_examples()
+        n += ds.num_examples()
+    return total / max(n, 1)
+
+
+def evaluation_score(metric: str = "accuracy"):
+    """EvaluationScoreFunction: negated eval metric so LOWER is better,
+    matching the runner's minimization convention."""
+
+    def fn(net, data) -> float:
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        for ds in data:
+            ev.eval(ds.labels, net.output(ds.features))
+        return -float(getattr(ev, metric)())
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Runner (optimize/runner/LocalOptimizationRunner.java)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TrialResult:
+    index: int
+    parameters: Dict[str, Any]
+    score: float
+    duration_s: float
+    net: Any = None
+
+
+class LocalOptimizationRunner:
+    """Sequential trial runner with MaxCandidates/MaxTime termination.
+
+    model_builder(params) -> net; fit_fn(net, params) trains it (defaults
+    to net.fit over ``train_data`` for ``epochs``); score_fn(net, data) ->
+    float, LOWER is better."""
+
+    def __init__(self, model_builder: Callable[[Dict[str, Any]], Any],
+                 generator, train_data, score_data=None,
+                 score_fn: Callable = test_set_loss_score,
+                 epochs: int = 1,
+                 max_candidates: int = 10,
+                 max_time_s: Optional[float] = None,
+                 fit_fn: Optional[Callable] = None,
+                 keep_nets: bool = False):
+        self.model_builder = model_builder
+        self.generator = generator
+        self.train_data = train_data
+        self.score_data = score_data if score_data is not None else train_data
+        self.score_fn = score_fn
+        self.epochs = epochs
+        self.max_candidates = max_candidates
+        self.max_time_s = max_time_s
+        self.fit_fn = fit_fn
+        self.keep_nets = keep_nets
+        self.results: List[TrialResult] = []
+
+    def execute(self) -> TrialResult:
+        start = time.time()
+        for idx, params in enumerate(self.generator):
+            if idx >= self.max_candidates:
+                break
+            if self.max_time_s is not None and \
+                    time.time() - start > self.max_time_s:
+                break
+            t0 = time.time()
+            net = self.model_builder(dict(params))
+            if self.fit_fn is not None:
+                self.fit_fn(net, dict(params))
+            else:
+                for _ in range(self.epochs):
+                    for ds in self.train_data:
+                        net.fit(ds.features, ds.labels)
+            score = float(self.score_fn(net, self.score_data))
+            self.results.append(TrialResult(
+                index=idx, parameters=dict(params), score=score,
+                duration_s=time.time() - t0,
+                net=net if self.keep_nets else None))
+        if not self.results:
+            raise RuntimeError("no candidates were evaluated (empty "
+                               "generator or zero budget)")
+        return self.best()
+
+    def best(self) -> TrialResult:
+        return min(self.results, key=lambda r: r.score)

@@ -762,16 +762,28 @@ def _gru_onnx(sd, ins, attrs, node, const_values=None):
 
 
 def _reject_extra_rnn_inputs(node, slots):
-    """Raise loudly for optional recurrent inputs we do not lower yet —
-    checked on node.inputs (the wire slots), NOT the compacted ins list,
-    so an absent bias cannot shift the check off its slot."""
-    direction = node.attrs.get("direction", "forward") \
-        if hasattr(node, "attrs") else "forward"
+    """Raise loudly for recurrent options we do not lower yet — checked on
+    node.inputs (the wire slots), NOT the compacted ins list, so an absent
+    bias cannot shift the check off its slot. Also rejects the attrs that
+    would silently change numerics: layout=1 (batch-major), non-default
+    activations, and clip."""
+    attrs = getattr(node, "attrs", {}) or {}
+    direction = attrs.get("direction", "forward")
     if isinstance(direction, bytes):
         direction = direction.decode()
     if direction != "forward":
         raise NotImplementedError(
             f"ONNX {node.op_type} direction={direction} import")
+    if int(attrs.get("layout", 0)):
+        raise NotImplementedError(
+            f"ONNX {node.op_type} layout=1 (batch-major) import — "
+            f"re-export with the default time-major layout")
+    if attrs.get("activations"):
+        raise NotImplementedError(
+            f"ONNX {node.op_type} with non-default activations import")
+    if attrs.get("clip"):
+        raise NotImplementedError(
+            f"ONNX {node.op_type} with cell clipping import")
     for idx, what in slots.items():
         if len(node.inputs) > idx and node.inputs[idx]:
             raise NotImplementedError(
@@ -831,7 +843,12 @@ def _resize_onnx(sd, ins, attrs, node, const_values=None):
         sz = _require_const(const_values, node, 3, "sizes")
         sizes = (int(sz[2]), int(sz[3]))
     else:
-        scales = np.asarray(_require_const(const_values, node, 2, "scales"))
+        # opset-11+: (X, roi, scales); opset-10: (X, scales)
+        slot = 2 if len(node.inputs) > 2 else 1
+        if len(node.inputs) <= slot:
+            raise ValueError(f"Resize {node.name}: no scales/sizes input")
+        scales = np.asarray(_require_const(const_values, node, slot,
+                                           "scales"))
         in_shape = getattr(ins[0], "shape", None)
         if not in_shape or len(in_shape) != 4 or None in in_shape[2:]:
             raise NotImplementedError(

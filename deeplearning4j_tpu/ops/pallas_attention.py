@@ -597,8 +597,13 @@ def register_platform_attention() -> None:
         # ~1.6x FASTER than the Pallas kernel (grid overhead dominates);
         # at and above 2048 Pallas wins 1.25x-28x. Defer below the
         # crossover — the PlatformHelper::isUsable contract (SURVEY §3.1).
+        # EXCEPT with attention-prob dropout: the generic path materializes
+        # a (T, T) bernoulli mask in HBM while flash regenerates it
+        # in-kernel, which flips the crossover (BERT-base seq 512 w/
+        # dropout 0.1: 108k tok/s flash vs 77k generic — BENCH_HISTORY
+        # bert series, round 4).
         t_kv = k.shape[2] if q.ndim == 4 else k.shape[1]
-        if t_kv < FLASH_MIN_T:
+        if t_kv < FLASH_MIN_T and not kw.get("dropout_rate", 0.0):
             return False
         if q.ndim == 3:
             mask_ok = mask is None or (

@@ -97,10 +97,14 @@ function drawHists(containerId, byParam) {
     let bars = counts.map((v, j) =>
       `<rect x="${j*bw}" y="${H - v/mx*H}" width="${bw-1}" height="${v/mx*H}" fill="${c}"/>`
     ).join('');
-    out += `<div style="display:inline-block;margin:4px"><div>${k}</div>` +
+    out += `<div style="display:inline-block;margin:4px"><div>${esc(k)}</div>` +
            `<svg style="width:${W}px;height:${H}px">${bars}</svg></div>`;
   });
   div.innerHTML = out;
+}
+function esc(s) {
+  return String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;')
+                  .replace(/>/g, '&gt;');
 }
 function drawGraph(svgId, g) {
   if (!g || !g.nodes || !g.nodes.length) return;
@@ -138,11 +142,11 @@ function drawGraph(svgId, g) {
   });
   g.nodes.forEach(n => {
     const p = pos[n.name];
-    const label = n.params ? `${n.name} (${n.params})` : n.name;
+    const label = n.params ? `${esc(n.name)} (${n.params})` : esc(n.name);
     out += `<rect x="${p[0]}" y="${p[1]}" width="140" height="24" rx="4"
              fill="#e3f2fd" stroke="#1976d2"/>` +
            `<text x="${p[0]+6}" y="${p[1]+16}" font-size="10">${label}</text>` +
-           `<title>${n.type}</title>`;
+           `<title>${esc(n.type)}</title>`;
   });
   svg.innerHTML = out;
 }

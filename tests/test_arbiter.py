@@ -1,0 +1,112 @@
+"""Arbiter-role hyperparameter search tests (SURVEY §2 Arbiter module):
+parameter spaces, grid/random generators, the local runner with
+termination conditions, and an end-to-end search that actually separates
+good from bad learning rates on a toy problem."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.arbiter import (
+    ContinuousParameterSpace, DiscreteParameterSpace,
+    GridSearchCandidateGenerator, IntegerParameterSpace,
+    LocalOptimizationRunner, RandomSearchGenerator, evaluation_score)
+from deeplearning4j_tpu.arbiter import test_set_loss_score as loss_score_fn
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class TestParameterSpaces:
+    def test_continuous_bounds_and_log(self):
+        r = np.random.RandomState(0)
+        sp = ContinuousParameterSpace(0.1, 10.0, log=True)
+        vals = [sp.sample(r) for _ in range(200)]
+        assert all(0.1 <= v <= 10.0 for v in vals)
+        # log sampling: ~half the draws under the geometric mean 1.0
+        frac = sum(v < 1.0 for v in vals) / len(vals)
+        assert 0.3 < frac < 0.7
+        g = sp.grid(3)
+        np.testing.assert_allclose(g, [0.1, 1.0, 10.0], rtol=1e-6)
+
+    def test_integer_and_discrete(self):
+        r = np.random.RandomState(1)
+        isp = IntegerParameterSpace(2, 5)
+        assert set(isp.sample(r) for _ in range(100)) == {2, 3, 4, 5}
+        assert isp.grid(4) == [2, 3, 4, 5]
+        dsp = DiscreteParameterSpace("adam", "sgd")
+        assert set(dsp.grid(7)) == {"adam", "sgd"}
+
+
+class TestGenerators:
+    def test_grid_cartesian_product(self):
+        gen = GridSearchCandidateGenerator(
+            {"lr": ContinuousParameterSpace(0.1, 0.3),
+             "width": DiscreteParameterSpace(4, 8),
+             "fixed": "relu"}, discretization=3)
+        combos = list(gen)
+        assert len(combos) == 3 * 2
+        assert all(c["fixed"] == "relu" for c in combos)
+        assert {c["width"] for c in combos} == {4, 8}
+
+    def test_random_respects_bounds(self):
+        gen = iter(RandomSearchGenerator(
+            {"lr": ContinuousParameterSpace(1e-4, 1e-1, log=True),
+             "n": IntegerParameterSpace(1, 3)}, seed=7))
+        for _ in range(20):
+            c = next(gen)
+            assert 1e-4 <= c["lr"] <= 1e-1
+            assert c["n"] in (1, 2, 3)
+
+
+def _toy_data(seed=0, n=128):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, 4).astype(np.float32)
+    w = np.array([[1.0, -1.0], [2.0, 0.5], [-1.5, 1.0], [0.5, -0.5]],
+                 np.float32)
+    y = (x @ w).argmax(axis=1)
+    return [DataSet(x, np.eye(2, dtype=np.float32)[y])]
+
+
+def _builder(params):
+    return nn.MultiLayerNetwork(
+        nn.builder().seed(3)
+        .updater(nn.Sgd(learning_rate=params["lr"])).list()
+        .layer(nn.DenseLayer(n_out=params.get("width", 8), activation="tanh"))
+        .layer(nn.OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(nn.InputType.feed_forward(4)).build()).init()
+
+
+class TestRunner:
+    def test_search_separates_learning_rates(self):
+        train = _toy_data(0)
+        heldout = _toy_data(1)
+        runner = LocalOptimizationRunner(
+            _builder,
+            GridSearchCandidateGenerator(
+                {"lr": DiscreteParameterSpace(1e-5, 0.3), "width": 8}),
+            train_data=train, score_data=heldout,
+            score_fn=loss_score_fn, epochs=30, max_candidates=4)
+        best = runner.execute()
+        assert len(runner.results) == 2
+        assert best.parameters["lr"] == pytest.approx(0.3)
+        worst = max(runner.results, key=lambda r: r.score)
+        assert best.score < worst.score * 0.9  # a REAL separation
+
+    def test_max_candidates_condition(self):
+        runner = LocalOptimizationRunner(
+            _builder,
+            RandomSearchGenerator({"lr": ContinuousParameterSpace(0.01, 0.1),
+                                   "width": 4}, seed=0),
+            train_data=_toy_data(), epochs=1, max_candidates=3)
+        runner.execute()
+        assert len(runner.results) == 3
+
+    def test_evaluation_score_function(self):
+        train = _toy_data(0)
+        runner = LocalOptimizationRunner(
+            _builder,
+            GridSearchCandidateGenerator(
+                {"lr": DiscreteParameterSpace(0.2), "width": 8}),
+            train_data=train, score_fn=evaluation_score("accuracy"),
+            epochs=30, max_candidates=1)
+        best = runner.execute()
+        assert -1.0 <= best.score <= -0.8  # negated accuracy, near 1.0
