@@ -872,3 +872,100 @@ def _require_const(const_values, node, idx, what):
 
 
 _NEEDS_CONSTS |= {"LSTM", "GRU", "Resize"}
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth, second pass: einsum, scatter/gather variants, norms.
+# ---------------------------------------------------------------------------
+
+
+@register_onnx_op("Einsum")
+def _einsum_onnx(sd, ins, attrs, node):
+    eq = attrs.get("equation", "")
+    eq = eq.decode() if isinstance(eq, bytes) else str(eq)
+    return sd._record("einsum", ins, {"equation": eq})
+
+
+@register_onnx_op("GatherND")
+def _gather_nd_onnx(sd, ins, attrs, node):
+    if int(attrs.get("batch_dims", 0)):
+        raise NotImplementedError("GatherND with batch_dims import")
+    return sd._record("gather_nd", ins)
+
+
+@register_onnx_op("CumSum")
+def _cumsum_onnx(sd, ins, attrs, node, const_values=None):
+    axis = int(np.asarray(_require_const(const_values, node, 1,
+                                         "axis")).reshape(-1)[0])
+    return sd._record("cumsum", [ins[0]],
+                      {"axis": axis,
+                       "exclusive": bool(int(attrs.get("exclusive", 0))),
+                       "reverse": bool(int(attrs.get("reverse", 0)))})
+
+
+ONNX_OP_MAPPERS["Not"] = _unary("boolean_not")
+ONNX_OP_MAPPERS["IsNaN"] = _unary("isnan")
+
+
+@register_onnx_op("IsInf")
+def _isinf_onnx(sd, ins, attrs, node):
+    if not int(attrs.get("detect_positive", 1)) or \
+            not int(attrs.get("detect_negative", 1)):
+        raise NotImplementedError("IsInf with one-sided detection import")
+    return sd._record("isinf", [ins[0]])
+
+
+@register_onnx_op("Trilu")
+def _trilu_onnx(sd, ins, attrs, node, const_values=None):
+    k = 0
+    if len(node.inputs) > 1 and node.inputs[1]:
+        k = int(_require_const(const_values, node, 1, "k"))
+    op = "triu" if int(attrs.get("upper", 1)) else "tril"
+    return sd._record(op, [ins[0]], {"diag": k})
+
+
+@register_onnx_op("ThresholdedRelu")
+def _thresholded_relu_onnx(sd, ins, attrs, node):
+    return sd._record("thresholdedrelu", [ins[0]],
+                      {"theta": float(attrs.get("alpha", 1.0))})
+
+
+@register_onnx_op("Hardmax")
+def _hardmax_onnx(sd, ins, attrs, node):
+    """Documented divergence: ties mark EVERY max position (the spec keeps
+    only the first occurrence) — shape-agnostic eq-based lowering."""
+    axis = int(attrs.get("axis", -1))
+    mx = sd._record("reduce_max", [ins[0]], {"axes": (axis,),
+                                             "keepdims": True})
+    eq = sd._record("eq", [ins[0], mx])
+    one = sd.constant(node.name + "_one", np.asarray(1.0, np.float32))
+    zero = sd.constant(node.name + "_zero", np.asarray(0.0, np.float32))
+    return sd._record("select", [eq, one, zero])
+
+
+@register_onnx_op("LpNormalization")
+def _lp_norm_onnx(sd, ins, attrs, node):
+    if int(attrs.get("p", 2)) != 2:
+        raise NotImplementedError("LpNormalization p != 2 import")
+    if int(attrs.get("axis", -1)) not in (-1,):
+        raise NotImplementedError("LpNormalization axis != -1 import")
+    sq = sd._record("mul", [ins[0], ins[0]])
+    ssum = sd._record("reduce_sum", [sq], {"axes": (-1,), "keepdims": True})
+    norm = sd._record("sqrt", [ssum])
+    return sd._record("div", [ins[0], norm])
+
+
+@register_onnx_op("MeanVarianceNormalization")
+def _mvn_onnx(sd, ins, attrs, node):
+    axes = tuple(int(a) for a in attrs.get("axes", [0, 2, 3]))
+    mean = sd._record("reduce_mean", [ins[0]],
+                      {"axes": axes, "keepdims": True})
+    cent = sd._record("sub", [ins[0], mean])
+    var = sd._record("reduce_mean", [sd._record("mul", [cent, cent])],
+                     {"axes": axes, "keepdims": True})
+    eps = sd.constant(node.name + "_eps", np.asarray(1e-9, np.float32))
+    return sd._record("div", [cent, sd._record("sqrt",
+                                               [sd._record("add", [var, eps])])])
+
+
+_NEEDS_CONSTS |= {"CumSum", "Trilu"}

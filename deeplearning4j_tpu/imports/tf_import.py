@@ -339,40 +339,21 @@ def _tf_pad(sd, ins, attrs, node, const_values=None):
 
 @register_tf_op("StridedSlice")
 def _tf_strided_slice(sd, ins, attrs, node, const_values=None):
-    """Handles begin_mask/end_mask/shrink_axis_mask — what ANY python
-    slicing (``t[:, :2]``, ``t[0]``) compiles to; ellipsis/new_axis masks
-    (``t[..., None]``) still raise."""
-    if attrs.get("ellipsis_mask", 0) or attrs.get("new_axis_mask", 0):
-        raise NotImplementedError(
-            f"StridedSlice {node.name}: ellipsis/new_axis masks not "
-            "supported — rewrite without '...'/None indexing")
+    """Full mask support (begin/end/shrink/new_axis/ellipsis) — everything
+    Python slicing compiles to, resolved at trace time by the
+    strided_slice_spec op (so ellipsis works on operands whose rank is
+    only known at execution)."""
     begin = [int(b) for b in _require_const(const_values, node, 1, "begin")]
     end = [int(e) for e in _require_const(const_values, node, 2, "end")]
     strides = [int(s) for s in
                _require_const(const_values, node, 3, "strides")]
-    from deeplearning4j_tpu.imports.ir import SLICE_TO_END
-
-    bmask = int(attrs.get("begin_mask", 0))
-    emask = int(attrs.get("end_mask", 0))
-    smask = int(attrs.get("shrink_axis_mask", 0))
-    big = SLICE_TO_END
-    shrink_axes = []
-    for i in range(len(begin)):
-        if smask & (1 << i):
-            # shrink: select exactly index begin[i], then squeeze the axis
-            end[i] = begin[i] + 1 if begin[i] != -1 else big
-            strides[i] = 1
-            shrink_axes.append(i)
-            continue
-        if bmask & (1 << i):
-            begin[i] = 0 if strides[i] > 0 else big
-        if emask & (1 << i):
-            end[i] = big if strides[i] > 0 else -big
-    out = sd._record("strided_slice", [ins[0]], {
-        "begin": begin, "end": end, "strides": strides})
-    if shrink_axes:
-        out = sd._record("squeeze", [out], {"axis": tuple(shrink_axes)})
-    return out
+    return sd._record("strided_slice_spec", [ins[0]], {
+        "begin": begin, "end": end, "strides": strides,
+        "begin_mask": int(attrs.get("begin_mask", 0)),
+        "end_mask": int(attrs.get("end_mask", 0)),
+        "shrink_mask": int(attrs.get("shrink_axis_mask", 0)),
+        "new_axis_mask": int(attrs.get("new_axis_mask", 0)),
+        "ellipsis_mask": int(attrs.get("ellipsis_mask", 0))})
 
 
 @register_tf_op("Unpack")
@@ -1402,3 +1383,151 @@ def import_saved_model(path: str, *, signature: str = "serving_default",
     sd.graph_inputs = [t.split(":")[0] for t in in_tensors]
     sd.graph_outputs = [norm(t) for t in out_tensors]
     return sd
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth: the remaining common-frozen-graph ops (Einsum, GatherNd,
+# AddN, logical reductions, MirrorPad, Conv2DBackpropInput, ...).
+# ---------------------------------------------------------------------------
+
+
+@register_tf_op("Einsum")
+def _einsum_tf(sd, ins, attrs, node):
+    eq = attrs.get("equation", b"")
+    eq = eq.decode() if isinstance(eq, bytes) else str(eq)
+    return sd._record("einsum", ins, {"equation": eq})
+
+
+@register_tf_op("GatherNd")
+def _gather_nd_tf(sd, ins, attrs, node):
+    return sd._record("gather_nd", ins)
+
+
+@register_tf_op("AddN")
+def _add_n(sd, ins, attrs, node):
+    out = ins[0]
+    for x in ins[1:]:
+        out = sd._record("add", [out, x])
+    return out
+
+
+@register_tf_op("Cumprod")
+def _cumprod_tf(sd, ins, attrs, node, const_values=None):
+    axis = int(np.asarray(_require_const(const_values, node, 1,
+                                         "axis")).reshape(-1)[0])
+    return sd._record("cumprod", [ins[0]],
+                      {"axis": axis,
+                       "exclusive": bool(attrs.get("exclusive", False)),
+                       "reverse": bool(attrs.get("reverse", False))})
+
+
+@register_tf_op("MirrorPad")
+def _mirror_pad_tf(sd, ins, attrs, node, const_values=None):
+    pads = _require_const(const_values, node, 1, "paddings")
+    mode = attrs.get("mode", b"REFLECT")
+    mode = mode.decode() if isinstance(mode, bytes) else str(mode)
+    return sd._record("mirror_pad", [ins[0]],
+                      {"paddings": tuple((int(a), int(b)) for a, b in pads),
+                       "mode": mode.lower()})
+
+
+for _tf, _ours in [("Erfc", "erfc"), ("Atanh", "atanh"), ("Asinh", "asinh"),
+                   ("Acosh", "acosh"), ("Expm1", "expm1")]:
+    def _mk_unary(ours):
+        def f(sd, ins, attrs, node):
+            return sd._record(ours, ins)
+
+        return f
+
+    TF_OP_MAPPERS[_tf] = _mk_unary(_ours)
+
+
+@register_tf_op("LogicalAnd")
+def _logical_and(sd, ins, attrs, node):
+    return sd._record("boolean_and", ins)
+
+
+@register_tf_op("LogicalOr")
+def _logical_or(sd, ins, attrs, node):
+    return sd._record("boolean_or", ins)
+
+
+@register_tf_op("LogicalNot")
+def _logical_not(sd, ins, attrs, node):
+    return sd._record("boolean_not", ins)
+
+
+@register_tf_op("Xdivy")
+def _xdivy(sd, ins, attrs, node):
+    # x/y where x != 0, else 0 — composed from recorded catalog ops
+    zero = sd._record("zeros_like", [ins[0]])
+    safe_y = sd._record("select", [sd._record("eq", [ins[0], zero]),
+                                   sd._record("ones_like", [ins[1]]),
+                                   ins[1]])
+    quot = sd._record("div", [ins[0], safe_y])
+    return sd._record("select", [sd._record("eq", [ins[0], zero]),
+                                 zero, quot])
+
+
+@register_tf_op("SelectV2")
+def _select_v2_tf(sd, ins, attrs, node):
+    return sd._record("select", ins)
+
+
+@register_tf_op("Select")
+def _select_tf(sd, ins, attrs, node):
+    # TF v1 Select: rank-1 cond broadcasts over the FIRST dim of x/y
+    return sd._record("select_v1", ins)
+
+
+@register_tf_op("Where")
+def _where_tf(sd, ins, attrs, node):
+    raise NotImplementedError(
+        "1-arg tf.where (argwhere) has a data-dependent output shape XLA "
+        "cannot express — use tf.where(cond, x, y), which imports as "
+        "Select/SelectV2")
+
+
+@register_tf_op("All")
+def _reduce_all_tf(sd, ins, attrs, node, const_values=None):
+    axes = _require_const(const_values, node, 1, "reduction axes")
+    return sd._record("reduce_all", [ins[0]],
+                      {"axis": tuple(int(a) for a in np.atleast_1d(axes)),
+                       "keepdims": bool(attrs.get("keep_dims", False))})
+
+
+@register_tf_op("Any")
+def _reduce_any_tf(sd, ins, attrs, node, const_values=None):
+    axes = _require_const(const_values, node, 1, "reduction axes")
+    return sd._record("reduce_any", [ins[0]],
+                      {"axis": tuple(int(a) for a in np.atleast_1d(axes)),
+                       "keepdims": bool(attrs.get("keep_dims", False))})
+
+
+@register_tf_op("Conv2DBackpropInput")
+def _conv2d_backprop_input(sd, ins, attrs, node, const_values=None):
+    """tf.nn.conv2d_transpose lowers to this op: (output_shape, filter,
+    value) with the FORWARD filter (kh, kw, out, in) — exactly keras
+    Conv2DTranspose, so it lowers onto deconv2d the same way."""
+    strides = attrs.get("strides", [1, 1, 1, 1])
+    padding = attrs.get("padding", b"SAME")
+    pad = padding.decode() if isinstance(padding, bytes) else str(padding)
+    if pad not in ("SAME", "VALID"):
+        raise NotImplementedError(f"Conv2DBackpropInput padding={pad}")
+    if attrs.get("data_format", b"NHWC") not in (b"NHWC", "NHWC"):
+        raise NotImplementedError("only NHWC Conv2DBackpropInput import")
+    dil = [int(d) for d in attrs.get("dilations", [1, 1, 1, 1])]
+    if dil != [1, 1, 1, 1]:
+        raise NotImplementedError(
+            f"Conv2DBackpropInput with dilations={dil} import")
+    if int(strides[0]) != 1 or int(strides[3]) != 1:
+        raise NotImplementedError(
+            "Conv2DBackpropInput with batch/channel strides import")
+    w = sd._record("transpose", [ins[1]], {"axes": (0, 1, 3, 2)})
+    return sd._record("deconv2d", [ins[2], w],
+                      {"stride": (int(strides[1]), int(strides[2])),
+                       "padding": pad.lower() if pad == "SAME" else "valid"})
+
+
+_NEEDS_CONSTS |= {"Cumprod", "MirrorPad", "All", "Any",
+                  "Conv2DBackpropInput"}

@@ -240,3 +240,23 @@ def _check_set_diag():
     got = np.asarray(_REG.exec("matrix_set_diag", jnp.asarray(x),
                                jnp.asarray([1.0, 2.0, 3.0], dtype=jnp.float32)))
     np.testing.assert_array_equal(got, np.diag([1.0, 2.0, 3.0]))
+
+
+@_op("einsum")
+def einsum(*operands, equation: str):
+    """General tensor contraction (TF/ONNX Einsum parity) — XLA lowers
+    straight onto dot_general/MXU."""
+    return jnp.einsum(equation, *operands)
+
+
+@validation.case("einsum")
+def _check_einsum():
+    r = np.random.RandomState(0)
+    a = r.randn(3, 4).astype(np.float32)
+    b = r.randn(4, 5).astype(np.float32)
+    got = np.asarray(einsum(jnp.asarray(a), jnp.asarray(b),
+                            equation="ij,jk->ik"))
+    np.testing.assert_allclose(got, a @ b, rtol=1e-5, atol=1e-5)
+    c = r.randn(2, 3, 4).astype(np.float32)
+    got2 = np.asarray(einsum(jnp.asarray(c), equation="bij->bji"))
+    np.testing.assert_allclose(got2, c.transpose(0, 2, 1))

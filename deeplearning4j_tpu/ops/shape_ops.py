@@ -567,3 +567,50 @@ def _check_rev_seq():
                                jnp.asarray(lengths)))
     want = tf.reverse_sequence(x, lengths, seq_axis=1, batch_axis=0).numpy()
     np.testing.assert_array_equal(got, want)
+
+
+@_op("strided_slice_spec")
+def strided_slice_spec(x, *, begin, end, strides, begin_mask: int = 0,
+                       end_mask: int = 0, shrink_mask: int = 0,
+                       new_axis_mask: int = 0, ellipsis_mask: int = 0):
+    """TF StridedSlice with the FULL mask set, resolved at trace time when
+    x.ndim is known — supports t[None], t[..., None], shrink indexing, and
+    every Python-slicing combination (TFGraphMapper strided-slice parity)."""
+    idx = []
+    for i in range(len(begin)):
+        if ellipsis_mask & (1 << i):
+            idx.append(Ellipsis)
+        elif new_axis_mask & (1 << i):
+            idx.append(None)
+        elif shrink_mask & (1 << i):
+            idx.append(int(begin[i]))
+        else:
+            b = None if (begin_mask & (1 << i)) else int(begin[i])
+            e = None if (end_mask & (1 << i)) else int(end[i])
+            idx.append(slice(b, e, int(strides[i])))
+    return x[tuple(idx)]
+
+
+def _check_strided_slice_spec():
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    x = r.rand(3, 4, 5).astype(np.float32)
+    xj = jnp.asarray(x)
+    # t[..., None]: spec [ellipsis, new_axis]
+    got = strided_slice_spec(xj, begin=[0, 0], end=[0, 0], strides=[1, 1],
+                             ellipsis_mask=0b01, new_axis_mask=0b10)
+    np.testing.assert_array_equal(np.asarray(got), x[..., None])
+    # t[:, None, 1:, 0]: [full, new, slice(1,None), shrink 0]
+    got = strided_slice_spec(xj, begin=[0, 0, 1, 0], end=[0, 0, 0, 0],
+                             strides=[1, 1, 1, 1], begin_mask=0b0001,
+                             end_mask=0b0101, new_axis_mask=0b0010,
+                             shrink_mask=0b1000)
+    np.testing.assert_array_equal(np.asarray(got), x[:, None, 1:, 0])
+    # reverse stride t[::-1]
+    got = strided_slice_spec(xj, begin=[0], end=[0], strides=[-1],
+                             begin_mask=1, end_mask=1)
+    np.testing.assert_array_equal(np.asarray(got), x[::-1])
+
+
+validation.add_case("strided_slice_spec", _check_strided_slice_spec)

@@ -232,7 +232,16 @@ def _register_select():
         n = int(np.prod(cond.shape))
         return jnp.argwhere(cond, size=n, fill_value=-1)
 
+    def select_v1(cond, x, y):
+        """TF v1 Select semantics: a rank-1 cond broadcasts over the FIRST
+        dimension of higher-rank x/y (unlike SelectV2's numpy-style
+        trailing broadcast)."""
+        if cond.ndim == 1 and x.ndim > 1:
+            cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.where(cond, x, y)
+
     _REG.register("select", select, doc=select.__doc__)
+    _REG.register("select_v1", select_v1, doc=select_v1.__doc__)
     _REG.register("where", where_op, doc=where_op.__doc__)
 
     def check_select():
@@ -250,7 +259,23 @@ def _register_select():
         valid = got[(got >= 0).all(axis=1)]
         np.testing.assert_array_equal(valid, np.argwhere(c))
 
+    def check_select_v1():
+        r = np.random.RandomState(5)
+        c = r.rand(3) > 0.5
+        x = r.randn(3, 4).astype(np.float32)
+        y = r.randn(3, 4).astype(np.float32)
+        got = np.asarray(_REG.exec("select_v1", jnp.asarray(c),
+                                   jnp.asarray(x), jnp.asarray(y)))
+        want = np.where(c[:, None], x, y)
+        np.testing.assert_array_equal(got, want)
+        # rank-matched cond: plain elementwise select
+        cm = r.rand(3, 4) > 0.5
+        got2 = np.asarray(_REG.exec("select_v1", jnp.asarray(cm),
+                                    jnp.asarray(x), jnp.asarray(y)))
+        np.testing.assert_array_equal(got2, np.where(cm, x, y))
+
     validation.add_case("select", check_select)
+    validation.add_case("select_v1", check_select_v1)
     validation.add_case("where", check_where)
 
 

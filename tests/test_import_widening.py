@@ -281,10 +281,14 @@ class TestStridedSliceMasks:
         x = r.randn(2, 4).astype(np.float32)
         _run_tf(model, [tf.TensorSpec([2, 4], tf.float32)], [x])
 
-    def test_ellipsis_still_raises(self):
+    def test_ellipsis_new_axis_now_import(self):
+        """Round 4 made ellipsis/new_axis masks real (t[..., None]) — the
+        old raise is gone; verify golden parity instead."""
         def model(t):
             return t[..., None] * 1.0
 
         gd, ins, outs = freeze(model, tf.TensorSpec([2, 3], tf.float32))
-        with pytest.raises(NotImplementedError, match="ellipsis|new_axis"):
-            TensorflowImporter().run_import(gd)
+        x = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+        sd = TensorflowImporter().run_import(gd)
+        got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+        np.testing.assert_allclose(got, x[..., None], rtol=1e-6)

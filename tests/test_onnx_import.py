@@ -483,3 +483,70 @@ class TestOnnxRecurrentAndResize:
         got = sd.output({"x": x}, "y")["y"]
         assert got.shape == (1, 2, 8, 8)
         np.testing.assert_allclose(got[0, 0, ::2, ::2], x[0, 0], atol=1e-6)
+
+
+class TestOnnxRound4Breadth:
+    def test_einsum_gathernd_cumsum(self):
+        r = np.random.RandomState(0)
+        a = r.randn(2, 3, 4).astype(np.float32)
+        b = r.randn(2, 4, 5).astype(np.float32)
+        idx = np.asarray([[0, 1], [1, 2]], np.int64)
+        nodes = [
+            node_proto("Einsum", ["a", "b"], ["e"], equation="bij,bjk->bik"),
+            node_proto("GatherND", ["a", "idx"], ["g"]),
+            node_proto("CumSum", ["a", "ax"], ["c"]),
+        ]
+        model = build_model(nodes, [("a", (2, 3, 4)), ("b", (2, 4, 5))],
+                            [("e", (2, 3, 5)), ("g", (2, 4)),
+                             ("c", (2, 3, 4))],
+                            {"idx": idx, "ax": np.asarray(1, np.int64)})
+        from deeplearning4j_tpu.imports import import_onnx
+
+        sd = import_onnx(bytes(model))
+        res = sd.output({"a": a, "b": b}, ["e", "g", "c"])
+        np.testing.assert_allclose(res["e"], np.einsum("bij,bjk->bik", a, b),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(res["g"], a[[0, 1], [1, 2]], rtol=1e-6)
+        np.testing.assert_allclose(res["c"], np.cumsum(a, axis=1),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_trilu_not_isnan_hardmax(self):
+        r = np.random.RandomState(1)
+        x = r.randn(4, 4).astype(np.float32)
+        nodes = [
+            node_proto("Trilu", ["x"], ["u"], upper=1),
+            node_proto("Hardmax", ["x"], ["h"]),
+            node_proto("IsNaN", ["x"], ["n"]),
+            node_proto("Not", ["n"], ["nn"]),
+        ]
+        model = build_model(nodes, [("x", (4, 4))],
+                            [("u", (4, 4)), ("h", (4, 4)), ("nn", (4, 4))],
+                            {})
+        from deeplearning4j_tpu.imports import import_onnx
+
+        sd = import_onnx(bytes(model))
+        res = sd.output({"x": x}, ["u", "h", "nn"])
+        np.testing.assert_allclose(res["u"], np.triu(x), rtol=1e-6)
+        want_h = (x == x.max(axis=-1, keepdims=True)).astype(np.float32)
+        np.testing.assert_allclose(res["h"], want_h)
+        assert res["nn"].all()  # nothing is NaN
+
+    def test_lp_norm_and_mvn(self):
+        r = np.random.RandomState(2)
+        x = r.randn(3, 6).astype(np.float32)
+        xc = r.randn(2, 3, 4, 4).astype(np.float32)
+        nodes = [node_proto("LpNormalization", ["x"], ["l"], p=2, axis=-1),
+                 node_proto("MeanVarianceNormalization", ["xc"], ["m"])]
+        model = build_model(nodes, [("x", (3, 6)), ("xc", (2, 3, 4, 4))],
+                            [("l", (3, 6)), ("m", (2, 3, 4, 4))], {})
+        from deeplearning4j_tpu.imports import import_onnx
+
+        sd = import_onnx(bytes(model))
+        res = sd.output({"x": x, "xc": xc}, ["l", "m"])
+        np.testing.assert_allclose(
+            res["l"], x / np.linalg.norm(x, axis=-1, keepdims=True),
+            rtol=1e-4, atol=1e-5)
+        mean = xc.mean(axis=(0, 2, 3), keepdims=True)
+        var = ((xc - mean) ** 2).mean(axis=(0, 2, 3), keepdims=True)
+        np.testing.assert_allclose(res["m"], (xc - mean) / np.sqrt(var + 1e-9),
+                                   rtol=1e-3, atol=1e-4)

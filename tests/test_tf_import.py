@@ -522,3 +522,104 @@ class TestSavedModelImport:
         vals = sorted(np.asarray(v).tolist() for v in res.values())
         want = sorted([(x * 2.0).tolist(), (-x).tolist()])
         assert vals == want, (vals, want)
+
+
+class TestRound4OpBreadth:
+    """Round-4 widened op set, golden vs in-env TF."""
+
+    def _golden(self, model, specs, feeds, rtol=1e-5, atol=1e-6):
+        gd, ins, outs = freeze(model, *specs)
+        golden = model(*[tf.constant(f) for f in feeds]).numpy()
+        sd = TensorflowImporter().run_import(gd)
+        got = sd.output(dict(zip(ins, feeds)), outs[0])[outs[0]]
+        np.testing.assert_allclose(got, golden, rtol=rtol, atol=atol)
+
+    def test_einsum(self):
+        def model(a, b):
+            return tf.einsum("bij,bjk->bik", a, b)
+
+        r = np.random.RandomState(0)
+        self._golden(model,
+                     [tf.TensorSpec([2, 3, 4], tf.float32),
+                      tf.TensorSpec([2, 4, 5], tf.float32)],
+                     [r.randn(2, 3, 4).astype(np.float32),
+                      r.randn(2, 4, 5).astype(np.float32)], rtol=1e-4)
+
+    def test_gather_nd_addn_cumprod(self):
+        def model(x):
+            idx = tf.constant([[0, 1], [1, 0]])
+            g = tf.gather_nd(x, idx)           # (2,)
+            s = tf.add_n([x, x * 2.0, x + 1.0])
+            c = tf.math.cumprod(x, axis=1)
+            return tf.reduce_sum(s) + tf.reduce_sum(c) + tf.reduce_sum(g)
+
+        x = np.random.RandomState(1).rand(2, 3).astype(np.float32) + 0.5
+        self._golden(model, [tf.TensorSpec([2, 3], tf.float32)], [x],
+                     rtol=1e-4)
+
+    def test_mirror_pad_and_logicals(self):
+        def model(x):
+            p = tf.pad(x, [[1, 1], [2, 2]], mode="REFLECT")
+            m = tf.logical_and(x > 0.3, tf.logical_not(x > 0.7))
+            return p * 1.0 + tf.reduce_sum(tf.cast(m, tf.float32))
+
+        x = np.random.RandomState(2).rand(3, 4).astype(np.float32)
+        self._golden(model, [tf.TensorSpec([3, 4], tf.float32)], [x])
+
+    def test_xdivy_and_select(self):
+        def model(x, y):
+            return tf.math.xdivy(x, y) + tf.where(x > 0.5, x, -y)
+
+        r = np.random.RandomState(3)
+        x = r.rand(3, 4).astype(np.float32)
+        x[0, 0] = 0.0  # xdivy special case
+        y = np.zeros((3, 4), np.float32)
+        y[0, 0] = 0.0  # 0/0 must be 0, not nan
+        y += r.rand(3, 4).astype(np.float32) * (x != 0)
+        y[y == 0] = 1.0
+        y[0, 0] = 0.0
+        self._golden(model, [tf.TensorSpec([3, 4], tf.float32),
+                             tf.TensorSpec([3, 4], tf.float32)], [x, y])
+
+    def test_reduce_all_any(self):
+        def model(x):
+            a = tf.reduce_all(x > 0.2, axis=1)
+            b = tf.reduce_any(x > 0.8, axis=0)
+            return tf.cast(a, tf.float32)[None, :] + \
+                tf.cast(b, tf.float32)[:, None] * 0.5
+
+        x = np.random.RandomState(4).rand(3, 3).astype(np.float32)
+        self._golden(model, [tf.TensorSpec([3, 3], tf.float32)], [x])
+
+    def test_conv2d_transpose(self):
+        w = np.random.RandomState(5).randn(3, 3, 5, 2).astype(np.float32)
+
+        def model(x):
+            return tf.nn.conv2d_transpose(
+                x, tf.constant(w), output_shape=[2, 8, 8, 5],
+                strides=[1, 2, 2, 1], padding="SAME")
+
+        x = np.random.RandomState(6).randn(2, 4, 4, 2).astype(np.float32)
+        self._golden(model, [tf.TensorSpec([2, 4, 4, 2], tf.float32)], [x],
+                     rtol=1e-4, atol=1e-4)
+
+    def test_inverse_hyperbolics(self):
+        def model(x):
+            return tf.asinh(x) + tf.math.expm1(x) + tf.math.erfc(x) + \
+                tf.acosh(x + 2.0) + tf.atanh(x * 0.5)
+
+        x = np.random.RandomState(7).rand(8).astype(np.float32)
+        self._golden(model, [tf.TensorSpec([8], tf.float32)], [x],
+                     rtol=1e-4, atol=1e-5)
+
+    def test_newaxis_and_ellipsis_slicing(self):
+        def model(x):
+            a = x[None]               # new_axis at front
+            b = x[..., None]          # ellipsis + trailing new_axis
+            c = x[:, None, 1:, 0]     # mixed: new_axis + slice + shrink
+            return tf.reduce_sum(a) + tf.reduce_sum(b * 2.0) + \
+                tf.reduce_sum(c * 3.0)
+
+        x = np.random.RandomState(8).rand(3, 4, 5).astype(np.float32)
+        self._golden(model, [tf.TensorSpec([3, 4, 5], tf.float32)], [x],
+                     rtol=1e-4)
