@@ -1341,9 +1341,37 @@ class SpaceToDepthLayer(LayerConf):
         return InputType.convolutional(itype.height // b, itype.width // b,
                                        itype.channels * b * b)
 
+
+@dataclasses.dataclass(frozen=True)
+class SameDiffLayer(LayerConf):
+    """conf/layers/samediff/SameDiffLayer.java: a user-defined SameDiff
+    block inside a MultiLayerNetwork/ComputationGraph stack.
+
+    ``define(sd, x, params) -> SDVariable`` builds the block's op graph
+    from an input SDVariable and a dict of parameter SDVariables (declared
+    via ``param_shapes``); the outer network differentiates through it like
+    any native layer. NOTE: holds a callable — JSON round-trip is not
+    supported for this layer (the reference serializes the subclass by
+    classname, which has no analog for ad-hoc Python callables)."""
+
+    define: Any = None
+    param_shapes: Any = None  # dict name -> shape tuple
+    n_out: int = 0
+
+    def output_type(self, itype):
+        if self.n_out:
+            if itype.kind == "recurrent":
+                return InputType.recurrent(self.n_out, itype.timesteps)
+            return InputType.feed_forward(self.n_out)
+        return itype
+
+    def has_params(self):
+        return bool(self.param_shapes)
+
 LAYER_TYPES = {
     c.__name__: c
     for c in [
+        SameDiffLayer,
         SpaceToDepthLayer,
         Deconvolution1D,
         SeparableConvolution1D,

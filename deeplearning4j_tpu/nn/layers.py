@@ -1551,6 +1551,54 @@ class SpaceToDepthLayerImpl(Layer):
                        block_size=self.lc.block_size), state, mask
 
 
+
+class SameDiffLayerImpl(Layer):
+    """layers/samediff/SameDiffLayer.java runtime: the user's define()
+    records into a private SameDiff once; apply interprets that graph with
+    the live params/input under the outer trace, so jax.grad of the whole
+    network differentiates straight through the block."""
+
+    def _graph(self):
+        if not hasattr(self, "_sd"):
+            from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+            sd = SameDiff.create()
+            x = sd.placeholder("sdl_x", shape=None)
+            pvars = {name: sd.placeholder(f"sdl_p_{name}", shape=tuple(shape))
+                     for name, shape in (self.lc.param_shapes or {}).items()}
+            out = self.lc.define(sd, x, pvars)
+            self._sd = sd
+            self._out_name = out.name
+        return self._sd, self._out_name
+
+    def init(self, key) -> Params:
+        shapes = self.lc.param_shapes or {}
+        ks = jax.random.split(key, max(len(shapes), 1))
+        params = {}
+        for k_, (name, shape) in zip(ks, sorted(shapes.items())):
+            if len(shape) >= 2:
+                params[name] = init_weights(k_, tuple(shape), self.winit,
+                                            dtype=self.dtype)
+            else:
+                params[name] = jnp.zeros(tuple(shape), self.dtype)
+        return params
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        sd, out_name = self._graph()
+        env = dict(sd._arrays)
+        env["sdl_x"] = x
+        for name, arr in params.items():
+            env[f"sdl_p_{name}"] = arr
+        out = sd._interpret(env, [out_name])[out_name]
+        # the block's output IS define()'s result — the net-wide default
+        # activation must NOT double-activate it (reference SameDiffLayer
+        # semantics); an explicit per-layer activation still applies
+        if self.lc.activation is not None:
+            out = self.activation(out)
+        return out, state, mask
+
+
 LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.DenseLayer: DenseLayerImpl,
     C.OutputLayer: OutputLayerImpl,
@@ -1601,6 +1649,7 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.MaskLayer: MaskLayerImpl,
     C.MaskZeroLayer: MaskZeroLayerImpl,
     C.RepeatVector: RepeatVectorImpl,
+    C.SameDiffLayer: SameDiffLayerImpl,
     C.SpaceToDepthLayer: SpaceToDepthLayerImpl,
     C.Deconvolution1D: Deconvolution1DImpl,
     C.SeparableConvolution1D: SeparableConvolution1DImpl,

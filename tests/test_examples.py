@@ -56,3 +56,14 @@ class TestExamples:
     def test_long_context_attention(self):
         out = _run("long_context_attention.py")
         assert "strategies agree" in out
+
+    def test_hyperparameter_search(self):
+        out = _run("hyperparameter_search.py")
+        assert "grid refinement best" in out
+        assert "search ok" in out
+
+    def test_saved_model_finetune(self):
+        pytest.importorskip("tensorflow")
+        out = _run("saved_model_finetune.py")
+        assert "imported outputs match TF: True" in out
+        assert "weights moved from the pretrained point: True" in out

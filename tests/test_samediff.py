@@ -337,3 +337,73 @@ class TestGenericOpFacade:
         x = sd.placeholder("x", shape=(2, 2))
         with pytest.raises(Exception):
             sd.op("definitely_not_an_op", x)
+
+
+class TestSameDiffLayerAdapter:
+    """conf/layers/samediff/SameDiffLayer.java — a SameDiff block inside a
+    MultiLayerNetwork, differentiated by the OUTER network's jax.grad."""
+
+    def _net(self):
+        def define(sd, x, p):
+            h = x.mmul(p["W"]) + p["b"]
+            return sd.math.tanh(h) if hasattr(sd, "math") else h.tanh()
+
+        return nn.MultiLayerNetwork(
+            nn.builder().seed(4).updater(nn.Sgd(learning_rate=0.1)).list()
+            .layer(nn.conf.SameDiffLayer(
+                define=define, param_shapes={"W": (5, 7), "b": (7,)},
+                n_out=7))
+            .layer(nn.OutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(5)).build()).init()
+
+    def test_forward_matches_manual(self):
+        net = self._net()
+        x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        W = np.asarray(net.params[0]["W"])
+        b = np.asarray(net.params[0]["b"])
+        h = np.tanh(x @ W + b)
+        out = net.feed_forward(x)[0]
+        np.testing.assert_allclose(np.asarray(out), h, rtol=1e-5, atol=1e-6)
+
+    def test_trains_through_the_block(self):
+        net = self._net()
+        rng = np.random.RandomState(1)
+        x = rng.randn(32, 5).astype(np.float32)
+        y = np.eye(3)[rng.randint(0, 3, 32)].astype(np.float32)
+        before = np.asarray(net.params[0]["W"]).copy()
+        net.fit(x, y)
+        first = float(net.score())
+        for _ in range(20):
+            net.fit(x, y)
+        assert float(net.score()) < first
+        assert not np.allclose(before, np.asarray(net.params[0]["W"]))
+
+    def test_gradcheck_through_block(self):
+        from deeplearning4j_tpu.autodiff.gradcheck import check_gradients
+
+        net = self._net()
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 5)
+        y = np.eye(3)[rng.randint(0, 3, 4)]
+        assert check_gradients(net, x, y, max_per_param=10)
+
+    def test_no_double_activation_with_net_default(self):
+        """A net-wide default activation must NOT re-activate the block's
+        output (reference SameDiffLayer semantics)."""
+        def define(sd, x, p):
+            return sd.math.tanh(x.mmul(p["W"]))
+
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(4).activation("tanh").list()
+            .layer(nn.conf.SameDiffLayer(define=define,
+                                         param_shapes={"W": (5, 7)},
+                                         n_out=7))
+            .layer(nn.OutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(5)).build()).init()
+        x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        W = np.asarray(net.params[0]["W"])
+        want = np.tanh(x @ W)  # applied ONCE
+        got = np.asarray(net.feed_forward(x)[0])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
