@@ -236,7 +236,8 @@ GRAPH_OPS: Dict[str, Callable[..., Any]] = {
     "expand_dims": lambda a, *, axis: jnp.expand_dims(a, axis),
     "squeeze": lambda a, *, axis=None: jnp.squeeze(a, axis),
     "concat": lambda *xs, axis=0: jnp.concatenate(xs, axis=axis),
-    "stack": lambda *xs, axis=0: jnp.stack(xs, axis=axis),
+    # "stack" intentionally NOT here: the registry impl preserves numpy
+    # for un-traced shape chains (tf.shape→Pack→Reshape imports)
     "unstack_first": lambda x: x[0],
     "slice": lambda a, *, begin, size: jax.lax.dynamic_slice(a, begin, size),
     "strided_slice": lambda a, *, begin, end, strides=None: a[
@@ -244,7 +245,8 @@ GRAPH_OPS: Dict[str, Callable[..., Any]] = {
     "gather": lambda params, indices, *, axis=0: jnp.take(params, indices.astype(jnp.int32), axis=axis),
     "tile": lambda a, *, reps: jnp.tile(a, reps),
     "pad": lambda a, *, paddings, value=0.0: jnp.pad(a, paddings, constant_values=value),
-    "shape_of": lambda a: jnp.asarray(a.shape, jnp.int32),
+    # "shape_of" intentionally NOT here: the registry impl returns numpy
+    # (shapes are static; keeps shape arithmetic trace-time concrete)
     "size": lambda a: jnp.asarray(a.size, jnp.int32),
     "one_hot_graph": lambda a, *, depth: jax.nn.one_hot(a.astype(jnp.int32), depth),
     "where": jnp.where,
@@ -823,18 +825,38 @@ class SameDiff:
         return {w: env[w] for w in wanted}
 
     def _exec_fn(self, out_names: Tuple[str, ...]):
-        """Build + cache the jitted whole-graph function for given outputs."""
+        """Build + cache the jitted whole-graph function for given outputs.
+
+        CONSTANT-vtype arrays are closed over (baked into the trace as
+        literals) rather than passed as jit arguments: a constant passed as
+        an argument becomes a tracer, which breaks trace-time-concrete
+        shape arithmetic (imported tf.shape→Pack→Reshape chains) and denies
+        XLA constant folding. VARIABLEs stay arguments so training updates
+        never trigger recompiles."""
         cache_key = ("exec", out_names)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            def run(arrays, feeds):
-                env = dict(arrays)
+            const_env = self._const_env()
+
+            def run(var_arrays, feeds):
+                env = dict(const_env)
+                env.update(var_arrays)
                 env.update(feeds)
                 return self._interpret(env, out_names)
 
             fn = jax.jit(run)
+            fn._const_names = frozenset(const_env)
             self._jit_cache[cache_key] = fn
         return fn
+
+    def _var_arrays(self, fn):
+        return {k: v for k, v in self._arrays.items()
+                if k not in fn._const_names}
+
+    def _const_env(self) -> Dict[str, Any]:
+        """CONSTANT-vtype arrays, for baking into traces (see _exec_fn)."""
+        return {n: a for n, a in self._arrays.items()
+                if self._vars[n].vtype == "CONSTANT"}
 
     def output(self, feeds: Dict[str, Any], outputs: Union[str, Sequence[str]]):
         """Execute the graph — ONE compiled XLA computation
@@ -842,7 +864,8 @@ class SameDiff:
         if isinstance(outputs, str):
             outputs = [outputs]
         fn = self._exec_fn(tuple(outputs))
-        res = fn(self._arrays, {k: jnp.asarray(v) for k, v in feeds.items()})
+        res = fn(self._var_arrays(fn),
+                 {k: jnp.asarray(v) for k, v in feeds.items()})
         return {k: np.asarray(v) for k, v in res.items()}
 
     exec = output  # reference SameDiff.exec alias
@@ -862,16 +885,21 @@ class SameDiff:
         cache_key = ("grad", loss_name, tuple(wrt))
         fn = self._jit_cache.get(cache_key)
         if fn is None:
+            const_env = self._const_env()
+
             def loss_of(train_vars, other_arrays, feeds_):
-                env = dict(other_arrays)
+                env = dict(const_env)  # baked: constants stay un-traced
+                env.update(other_arrays)
                 env.update(train_vars)
                 env.update(feeds_)
                 return self._interpret(env, [loss_name])[loss_name]
 
             fn = jax.jit(jax.grad(loss_of))
+            fn._const_names = frozenset(const_env)
             self._jit_cache[cache_key] = fn
         train_vars = {n: self._arrays[n] for n in wrt}
-        other = {n: a for n, a in self._arrays.items() if n not in train_vars}
+        other = {n: a for n, a in self._arrays.items()
+                 if n not in train_vars and n not in fn._const_names}
         grads = fn(train_vars, other, {k: jnp.asarray(v) for k, v in feeds.items()})
         return {k: np.asarray(v) for k, v in grads.items()}
 
@@ -882,10 +910,12 @@ class SameDiff:
     def _train_step_fn(self, loss_name: str):
         tc = self.training_config
         upd = tc.updater
+        const_env = self._const_env()
 
         def step_fn(train_vars, upd_state, step, other_arrays, feeds):
             def loss_of(tv):
-                env = dict(other_arrays)
+                env = dict(const_env)  # baked: constants stay un-traced
+                env.update(other_arrays)
                 env.update(tv)
                 env.update(feeds)
                 return self._interpret(env, [loss_name])[loss_name]
@@ -946,7 +976,10 @@ class SameDiff:
                 for name, arr in zip(tc.label_mapping, labs):
                     feeds[name] = jnp.asarray(arr)
                 train_vars = {n: self._arrays[n] for n in trainable}
-                other = {n: a for n, a in self._arrays.items() if n not in train_vars}
+                # constants are baked into step_fn's closure (_const_env)
+                other = {n: a for n, a in self._arrays.items()
+                         if n not in train_vars
+                         and self._vars[n].vtype != "CONSTANT"}
                 new_vars, self._updater_state, loss = step_fn(
                     train_vars, self._updater_state,
                     jnp.asarray(self._step, jnp.int32), other, feeds)
@@ -1115,7 +1148,7 @@ class SameDiff:
         """StableHLO text of the whole-graph computation — the artifact the
         reference's libnd4j GraphExecutioner FlatBuffers file maps to."""
         fn = self._exec_fn(tuple(outputs))
-        return fn.lower(self._arrays,
+        return fn.lower(self._var_arrays(fn),
                         {k: jnp.asarray(v) for k, v in feeds.items()}).as_text()
 
     # ------------------------------------------------------------------ misc
@@ -1132,6 +1165,10 @@ class SameDiff:
         if name not in self._vars:
             raise KeyError(name)
         self._arrays[name] = jnp.asarray(value)
+        if self._vars[name].vtype == "CONSTANT":
+            # constants are BAKED into cached traces (_exec_fn/_const_env);
+            # changing one must invalidate every cached computation
+            self._jit_cache.clear()
 
     def summary(self) -> str:
         lines = [f"SameDiff: {len(self._vars)} variables, {len(self._nodes)} ops"]

@@ -140,21 +140,21 @@ def _identity(sd, ins, attrs, node):
 def _reshape(sd, ins, attrs, node, const_values=None):
     shape = const_values.get(node.input[1]) if const_values else None
     if shape is None:
-        raise ValueError(f"Reshape {node.name}: dynamic shape input unsupported")
+        # tf.shape(...)-derived target: stays trace-time concrete through
+        # the shape_of chain, so reshape_dynamic recovers the ints there
+        return sd._record("reshape_dynamic", [ins[0], ins[1]])
     return sd._record("reshape", [ins[0]], {"shape": tuple(int(s) for s in shape)})
 
 
 @register_tf_op("Transpose")
 def _transpose(sd, ins, attrs, node, const_values=None):
-    perm = const_values.get(node.input[1]) if const_values else None
-    if perm is None:
-        raise ValueError(f"Transpose {node.name}: dynamic perm unsupported")
+    perm = _require_const(const_values, node, 1, "perm")
     return sd._record("transpose", [ins[0]], {"axes": tuple(int(p) for p in perm)})
 
 
 @register_tf_op("ExpandDims")
 def _expand(sd, ins, attrs, node, const_values=None):
-    axis = const_values.get(node.input[1])
+    axis = _require_const(const_values, node, 1, "dim")
     return sd._record("expand_dims", [ins[0]], {"axis": int(axis)})
 
 
@@ -267,7 +267,7 @@ def _pack(sd, ins, attrs, node, const_values=None):
 
 @register_tf_op("Tile")
 def _tile(sd, ins, attrs, node, const_values=None):
-    reps = const_values.get(node.input[1])
+    reps = _require_const(const_values, node, 1, "multiples")
     return sd._record("tile", [ins[0]], {"reps": tuple(int(r) for r in reps)})
 
 
@@ -1531,3 +1531,17 @@ def _conv2d_backprop_input(sd, ins, attrs, node, const_values=None):
 
 _NEEDS_CONSTS |= {"Cumprod", "MirrorPad", "All", "Any",
                   "Conv2DBackpropInput"}
+
+
+@register_tf_op("ResourceGather")
+def _resource_gather(sd, ins, attrs, node):
+    """tf.gather on a resource variable (embedding lookup path): the
+    VarHandleOp mapper already resolved the resource to its value."""
+    if int(attrs.get("batch_dims", 0)):
+        raise NotImplementedError("ResourceGather with batch_dims import")
+    return sd._record("gather", [ins[0], ins[1]], {"axis": 0})
+
+
+@register_tf_op("Shape")
+def _shape_tf(sd, ins, attrs, node):
+    return sd._record("shape_of", ins)

@@ -13,6 +13,7 @@ Every op registers a numpy-oracle validation case (ops/validation.py).
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.ops.registry import registry
@@ -67,7 +68,21 @@ def concat(*xs, axis: int = 0):
 
 @_op("stack")
 def stack(*xs, axis: int = 0):
-    """stack (generic/parity_ops/stack.cpp)."""
+    """stack (generic/parity_ops/stack.cpp). Stays in NUMPY when no input
+    is traced (shape-chain arithmetic keeps trace-time concreteness)."""
+    import numpy as np
+    from jax.core import Tracer
+
+    def host_ok(x):
+        # scalars (incl. concrete baked jnp constants) and host arrays may
+        # stack on host; a NON-scalar device array must stay on device
+        return not isinstance(x, jax.Array) or np.ndim(x) == 0
+
+    if (not any(isinstance(x, Tracer) for x in xs)
+            and all(host_ok(x) for x in xs)):
+        # shape_of chains: stays concrete under jit traces, no device
+        # round-trip for host values
+        return np.stack([np.asarray(x) for x in xs], axis=axis)
     return jnp.stack(xs, axis=axis)
 
 
@@ -150,8 +165,14 @@ def rank(x):
 
 @_op("shape_of")
 def shape_of(x):
-    """shape_of (generic/shape/shape.cpp)."""
-    return jnp.asarray(x.shape, jnp.int64 if max(x.shape, default=0) > 2**31 else jnp.int32)
+    """shape_of (generic/shape/shape.cpp). Returns NUMPY: shapes are static
+    under XLA, and keeping the result un-traced lets imported
+    tf.shape→Pack→Reshape chains recover concrete ints at trace time
+    (reshape_dynamic); jnp consumers auto-convert."""
+    import numpy as np
+
+    dt = np.int64 if max(x.shape, default=0) > 2**31 else np.int32
+    return np.asarray(x.shape, dt)
 
 
 @_op("size")
@@ -614,3 +635,39 @@ def _check_strided_slice_spec():
 
 
 validation.add_case("strided_slice_spec", _check_strided_slice_spec)
+
+
+@_op("reshape_dynamic")
+def reshape_dynamic(x, shape):
+    """Reshape where the target arrives as a tensor operand (TF Reshape
+    with a tf.shape(...)-derived input). Requires the shape chain to be
+    trace-time concrete — true whenever it derives from shape_of + consts."""
+    import numpy as np
+
+    try:
+        dims = tuple(int(s) for s in np.asarray(shape))
+    except Exception as e:  # a tracer leaked into the shape chain
+        raise NotImplementedError(
+            "reshape_dynamic: target shape is data-dependent (not derivable "
+            "from static shapes) — XLA cannot express it") from e
+    return x.reshape(dims)
+
+
+@validation.case("reshape_dynamic")
+def _check_reshape_dynamic():
+    import numpy as np
+
+    import jax
+
+    x = jnp.arange(12.0)
+    got = reshape_dynamic(x, np.asarray([3, 4]))
+    assert got.shape == (3, 4)
+    # stays concrete THROUGH a jit trace when derived from shape_of
+    # (numpy) + the numpy-preserving stack op
+    @jax.jit
+    def f(a):
+        s = _REG.exec("shape_of", a)
+        tgt = _REG.exec("stack", s[0] * s[1])
+        return reshape_dynamic(a, tgt)
+
+    assert f(jnp.zeros((3, 4))).shape == (12,)

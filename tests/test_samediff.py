@@ -407,3 +407,31 @@ class TestSameDiffLayerAdapter:
         want = np.tanh(x @ W)  # applied ONCE
         got = np.asarray(net.feed_forward(x)[0])
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestConstantBaking:
+    def test_set_arr_on_constant_invalidates_caches(self):
+        """Constants are baked into cached traces — changing one must not
+        serve stale results (round-4 const-baking regression guard)."""
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(3,))
+        c = sd.constant("c", np.asarray(2.0, np.float32))
+        out = sd._record("mul", [x, c])
+        xv = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(sd.output({"x": xv}, out.name)[out.name],
+                                   2.0 * xv)
+        sd.set_arr("c", np.asarray(5.0, np.float32))
+        np.testing.assert_allclose(sd.output({"x": xv}, out.name)[out.name],
+                                   5.0 * xv)
+
+    def test_stack_keeps_device_arrays_on_device(self):
+        import jax
+
+        from deeplearning4j_tpu.ops import exec_op
+
+        a = jnp.ones((4,))
+        out = exec_op("stack", a, a * 2)
+        assert isinstance(out, jax.Array)  # no silent host round-trip
+        # host-only inputs stay numpy (shape-chain concreteness)
+        out2 = exec_op("stack", np.int32(3), np.int32(4))
+        assert isinstance(out2, np.ndarray)
