@@ -217,9 +217,11 @@ def _assemble_sequential(specs, input_type) -> nn.MultiLayerNetwork:
                                pre.channels, -1)
                      .transpose(3, 0, 1, 2, 4)
                      .reshape(w.shape[0], -1))
-            net.params[i][k] = (
-                {kk: jnp.asarray(vv) for kk, vv in w.items()}
-                if isinstance(w, dict) else jnp.asarray(w))
+            # arbitrary nesting (Bidirectional-in-LastTimeStep wraps two
+            # levels deep): graft every leaf
+            import jax
+
+            net.params[i][k] = jax.tree.map(jnp.asarray, w)
         for k, v in st.items():
             net.net_state[i][k] = jnp.asarray(v)
     return net
@@ -581,14 +583,83 @@ def import_keras_functional_config(config, weights_map):
     return net
 
 
-def import_keras_model_and_weights(h5_path: str):
-    """KerasModelImport.importKerasModelAndWeights analog: reads the .h5
-    with h5py (own parsing — no tf.keras), dispatches Sequential →
-    MultiLayerNetwork / Functional → ComputationGraph."""
-    config, weights = read_keras_h5(h5_path)
+def import_keras_model_and_weights(path: str):
+    """KerasModelImport.importKerasModelAndWeights analog: reads legacy .h5
+    OR the Keras-3 .keras zip with own parsing (h5py + zipfile — no
+    tf.keras deserialization), dispatches Sequential → MultiLayerNetwork /
+    Functional → ComputationGraph."""
+    import zipfile
+
+    if zipfile.is_zipfile(path):
+        config, weights = read_keras_v3(path)
+    else:
+        config, weights = read_keras_h5(path)
     if config.get("class_name") == "Sequential":
         return import_keras_sequential_config(config, weights)
     return import_keras_functional_config(config, weights)
+
+
+def _keras_snake_case(name: str) -> str:
+    """Keras's to_snake_case: the rule behind .keras weight-group names."""
+    import re
+
+    name = re.sub(r"\W+", "", name)
+    name = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub(r"([a-z])([A-Z])", r"\1_\2", name).lower()
+
+
+def read_keras_v3(path: str):
+    """Parse a Keras-3 ``.keras`` zip (config.json + model.weights.h5)
+    WITHOUT tf.keras. Weight groups are keyed by snake_case(class_name)
+    with a per-class counter in MODEL order (NOT layer.name — verified
+    empirically against in-env keras saves), so the mapping is re-derived
+    from the config's layer sequence. Returns (model_config, weights_map
+    keyed by the config layer NAMES — what the assembly paths expect)."""
+    import io
+    import zipfile
+
+    import h5py
+
+    with zipfile.ZipFile(path) as z:
+        config = json.loads(z.read("config.json"))
+        with z.open("model.weights.h5") as f:
+            h5buf = io.BytesIO(f.read())
+
+    weights_map: Dict[str, List[np.ndarray]] = {}
+    with h5py.File(h5buf, "r") as h:
+        layers_grp = h.get("layers")
+        counters: Dict[str, int] = {}
+        for entry in config.get("config", {}).get("layers", []):
+            cls = entry.get("class_name", "")
+            name = entry.get("config", {}).get("name", cls)
+            snake = _keras_snake_case(cls)
+            idx = counters.get(snake, 0)
+            counters[snake] = idx + 1
+            gname = snake if idx == 0 else f"{snake}_{idx}"
+            if layers_grp is None or gname not in layers_grp:
+                continue
+            grp = layers_grp[gname]
+            ws: List[np.ndarray] = []
+
+            def collect(g):
+                # direct vars first, then sublayers in get_weights() order:
+                # RNNs store under cell/vars; Bidirectional under
+                # forward_layer then backward_layer
+                vg = g.get("vars")
+                if vg is not None:
+                    for k in sorted(vg, key=lambda s: int(s)):
+                        ws.append(np.asarray(vg[k]))
+                priority = ["cell", "forward_layer", "backward_layer"]
+                subs = [s for s in priority if s in g] + sorted(
+                    s for s in g
+                    if s not in priority and s != "vars"
+                    and isinstance(g[s], type(g)))
+                for s in subs:
+                    collect(g[s])
+
+            collect(grp)
+            weights_map[name] = ws
+    return config, weights_map
 
 
 @KerasLayerMapper.register("Conv1D")

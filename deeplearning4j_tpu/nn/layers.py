@@ -598,7 +598,13 @@ class BidirectionalImpl(Layer):
             xr = jnp.flip(x, axis=1)
             mr = None if mask is None else jnp.flip(mask, axis=1)
             yb, _, _ = self.bwd_layer.apply(params["bwd"], xr, {}, train=train, rng=rng, mask=mr)
-            yb = jnp.flip(yb, axis=1)
+            if yb.ndim == x.ndim:
+                # sequence output: restore original time order. A collapsed
+                # output (LastTimeStep-wrapped, keras return_sequences=False)
+                # is ALREADY the backward pass's final step — flipping it
+                # would scramble the FEATURE axis (round-4 bidirectional
+                # regression)
+                yb = jnp.flip(yb, axis=1)
         mode = self.lc.mode
         if mode == "concat":
             y = jnp.concatenate([yf, yb], axis=-1)

@@ -257,3 +257,92 @@ class TestRound4Mappers:
         x = np.random.RandomState(16).randn(3, 4).astype(np.float32)
         out = net.output(x)
         assert out.shape == (3, 6) and np.isfinite(out).all()
+
+
+class TestKerasV3FileImport:
+    """Own-parsing of the Keras-3 .keras zip format (config.json +
+    model.weights.h5 with snake_case(class)+counter weight groups)."""
+
+    def test_sequential_keras_file(self, tmp_path):
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights)
+
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((8, 8, 3)),
+            tf.keras.layers.Conv2D(4, 3, activation="relu", name="convA"),
+            tf.keras.layers.Flatten(name="flat"),
+            tf.keras.layers.Dense(5, activation="tanh", name="zz"),
+            tf.keras.layers.Dense(2, activation="softmax", name="aa"),
+        ])
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        net = import_keras_model_and_weights(path)
+        x = np.random.RandomState(0).rand(2, 8, 8, 3).astype(np.float32)
+        golden = model(x, training=False).numpy()
+        np.testing.assert_allclose(net.output(x), golden, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_same_class_layer_ordering(self, tmp_path):
+        """Three Dense layers whose user names sort AGAINST model order —
+        the counter rule must still assign groups by model order."""
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights)
+
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(7, activation="relu", name="zzz"),
+            tf.keras.layers.Dense(5, activation="relu", name="mmm"),
+            tf.keras.layers.Dense(2, name="aaa"),
+        ])
+        path = str(tmp_path / "m2.keras")
+        model.save(path)
+        net = import_keras_model_and_weights(path)
+        x = np.random.RandomState(1).randn(3, 6).astype(np.float32)
+        golden = model(x, training=False).numpy()
+        np.testing.assert_allclose(net.output(x), golden, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_batchnorm_in_keras_file(self, tmp_path):
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights)
+
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((5,)),
+            tf.keras.layers.Dense(8, name="d"),
+            tf.keras.layers.BatchNormalization(name="bn"),
+            tf.keras.layers.Activation("relu"),
+        ])
+        # make running stats non-trivial
+        model.compile(optimizer="sgd", loss="mse")
+        data = np.random.RandomState(2).randn(64, 5).astype(np.float32)
+        model.fit(data, np.random.RandomState(3).randn(64, 8)
+                  .astype(np.float32), epochs=1, verbose=0)
+        path = str(tmp_path / "m3.keras")
+        model.save(path)
+        net = import_keras_model_and_weights(path)
+        x = data[:4]
+        golden = model(x, training=False).numpy()
+        np.testing.assert_allclose(net.output(x), golden, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rnn_layers_in_keras_file(self, tmp_path):
+        """RNN weights live under cell/vars (Bidirectional under
+        forward_layer/backward_layer) — the recursion must flatten them in
+        get_weights() order."""
+        from deeplearning4j_tpu.imports.keras_import import (
+            import_keras_model_and_weights)
+
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((6, 3)),
+            tf.keras.layers.LSTM(4, return_sequences=True, name="l"),
+            tf.keras.layers.Bidirectional(
+                tf.keras.layers.LSTM(3, return_sequences=False), name="bi"),
+            tf.keras.layers.Dense(2, name="out"),
+        ])
+        path = str(tmp_path / "rnn.keras")
+        model.save(path)
+        net = import_keras_model_and_weights(path)
+        x = np.random.RandomState(4).randn(2, 6, 3).astype(np.float32)
+        golden = model(x, training=False).numpy()
+        np.testing.assert_allclose(net.output(x), golden, rtol=1e-4,
+                                   atol=1e-5)
