@@ -27,6 +27,10 @@ def main():
     conf = (nn.builder()
             .seed(123)
             .updater(nn.Adam(learning_rate=1e-3))
+            # "mixed": bf16 compute / f32 master params — the TPU-native
+            # policy; it also keeps CPU smoke runs fast (f32 policy forces
+            # multi-pass matmul emulation whose conv compiles take minutes)
+            .dtype("mixed")
             .list()
             .layer(nn.ConvolutionLayer(n_out=20, kernel=(5, 5),
                                        activation="relu"))
