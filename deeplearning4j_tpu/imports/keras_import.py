@@ -549,6 +549,17 @@ def import_keras_functional_config(config, weights_map):
             else:
                 gb.add_vertex(name, G.ElementWiseVertex(op=op), *inputs)
             continue
+        if cls == "Dot":
+            axes = cfg.get("axes", -1)
+            if isinstance(axes, (list, tuple)):
+                if len(set(axes)) != 1:
+                    raise NotImplementedError(
+                        "Dot merge with differing per-input axes import")
+                axes = axes[0]
+            gb.add_vertex(name, G.DotProductVertex(
+                axes=int(axes), normalize=bool(cfg.get("normalize", False))),
+                *inputs)
+            continue
         if cls == "Flatten":
             # our conv activations are NHWC like keras's — a batch-preserving
             # flatten keeps keras Dense weight order (no CHW reorder needed)
@@ -1262,3 +1273,21 @@ def _conv1d_transpose(cfg, weights):
     if cfg.get("use_bias", True) and len(weights) > 1:
         p["b"] = weights[1]
     return lc, p
+
+
+@KerasLayerMapper.register("Resizing")
+def _resizing(cfg, weights):
+    method = cfg.get("interpolation", "bilinear")
+    if method not in ("bilinear", "nearest", "bicubic"):
+        raise NotImplementedError(f"Resizing interpolation={method} import")
+    if cfg.get("crop_to_aspect_ratio") or cfg.get("pad_to_aspect_ratio"):
+        raise NotImplementedError("Resizing with aspect-ratio fitting import")
+    return C.ResizeLayer(height=int(cfg["height"]), width=int(cfg["width"]),
+                         method=method, name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("CenterCrop")
+def _center_crop(cfg, weights):
+    return C.CenterCropLayer(height=int(cfg["height"]),
+                             width=int(cfg["width"]),
+                             name=cfg.get("name")), {}

@@ -1545,3 +1545,24 @@ def _resource_gather(sd, ins, attrs, node):
 @register_tf_op("Shape")
 def _shape_tf(sd, ins, attrs, node):
     return sd._record("shape_of", ins)
+
+
+@register_tf_op("SpaceToBatchND")
+def _space_to_batch_nd_tf(sd, ins, attrs, node, const_values=None):
+    block = _require_const(const_values, node, 1, "block_shape")
+    pads = _require_const(const_values, node, 2, "paddings")
+    return sd._record("space_to_batch", [ins[0]], {
+        "block_shape": tuple(int(b) for b in np.atleast_1d(block)),
+        "paddings": tuple((int(a), int(b)) for a, b in np.atleast_2d(pads))})
+
+
+@register_tf_op("BatchToSpaceND")
+def _batch_to_space_nd_tf(sd, ins, attrs, node, const_values=None):
+    block = _require_const(const_values, node, 1, "block_shape")
+    crops = _require_const(const_values, node, 2, "crops")
+    return sd._record("batch_to_space", [ins[0]], {
+        "block_shape": tuple(int(b) for b in np.atleast_1d(block)),
+        "crops": tuple((int(a), int(b)) for a, b in np.atleast_2d(crops))})
+
+
+_NEEDS_CONSTS |= {"SpaceToBatchND", "BatchToSpaceND"}

@@ -1368,9 +1368,37 @@ class SameDiffLayer(LayerConf):
     def has_params(self):
         return bool(self.param_shapes)
 
+
+@dataclasses.dataclass(frozen=True)
+class ResizeLayer(LayerConf):
+    """Spatial resize to a fixed (height, width) — the Keras Resizing
+    preprocessing surface over the registry resize ops."""
+
+    height: int = 0
+    width: int = 0
+    method: str = "bilinear"  # bilinear | nearest | bicubic
+
+    def output_type(self, itype):
+        return InputType.convolutional(self.height, self.width,
+                                       itype.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class CenterCropLayer(LayerConf):
+    """Center crop to (height, width) — Keras CenterCrop parity."""
+
+    height: int = 0
+    width: int = 0
+
+    def output_type(self, itype):
+        return InputType.convolutional(self.height, self.width,
+                                       itype.channels)
+
 LAYER_TYPES = {
     c.__name__: c
     for c in [
+        ResizeLayer,
+        CenterCropLayer,
         SameDiffLayer,
         SpaceToDepthLayer,
         Deconvolution1D,

@@ -122,6 +122,45 @@ class ElementWiseVertex(GraphVertex):
         raise ValueError(f"unknown ElementWiseVertex op {self.op}")
 
 
+
+@dataclasses.dataclass(frozen=True)
+class DotProductVertex(GraphVertex):
+    """Keras Dot merge: batched contraction of two inputs along ``axes``
+    (an int applied to both sides; negative allowed), optional L2
+    normalization first (cosine proximity)."""
+
+    axes: int = -1
+    normalize: bool = False
+
+    def apply(self, inputs):
+        a, b = inputs
+        ax = self.axes
+        if self.normalize:
+            a = a / jnp.maximum(jnp.linalg.norm(a, axis=ax, keepdims=True),
+                                1e-12)
+            b = b / jnp.maximum(jnp.linalg.norm(b, axis=ax, keepdims=True),
+                                1e-12)
+        axa, axb = ax % a.ndim, ax % b.ndim
+        out = jax.vmap(lambda u, v: jnp.tensordot(
+            u, v, axes=((axa - 1,), (axb - 1,))))(a, b)
+        if out.ndim == 1:
+            out = out[:, None]  # keras keeps a trailing dim for vector dots
+        return out
+
+    def output_type(self, itypes):
+        a, b = itypes
+        if a.kind == "feedforward" or (a.kind == "recurrent"
+                                       and self.axes in (-1, 2)):
+            # vector dot -> (N, 1); (N,T,F)x(N,S,F) axes=-1 -> (N,T,S)
+            if a.kind == "feedforward":
+                return C.InputType.feed_forward(1)
+            return C.InputType.recurrent(
+                b.timesteps if b.timesteps else -1, a.timesteps)
+        raise NotImplementedError(
+            f"DotProductVertex shape inference for {a.kind} inputs with "
+            f"axes={self.axes}")
+
+
 @dataclasses.dataclass(frozen=True)
 class SubsetVertex(GraphVertex):
     """SubsetVertex.java: feature-axis slice [from, to] inclusive."""
@@ -253,7 +292,7 @@ VERTEX_TYPES = {
     for c in [MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex,
               ShiftVertex, L2NormalizeVertex, StackVertex, ReshapeVertex,
               FlattenVertex, UnstackVertex, DuplicateToTimeSeriesVertex,
-              LastTimeStepVertex]
+              LastTimeStepVertex, DotProductVertex]
 }
 
 

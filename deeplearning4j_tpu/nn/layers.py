@@ -1605,6 +1605,38 @@ class SameDiffLayerImpl(Layer):
         return out, state, mask
 
 
+
+class ResizeLayerImpl(Layer):
+    """Keras Resizing: NHWC resize via the registry resize ops (half-pixel
+    centers — the TF2/keras convention)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        from deeplearning4j_tpu.ops import exec_op
+
+        op = {"bilinear": "resize_bilinear",
+              "nearest": "resize_nearest_neighbor",
+              "bicubic": "resize_bicubic"}[self.lc.method]
+        return exec_op(op, x, size=(self.lc.height, self.lc.width)), \
+            state, mask
+
+
+class CenterCropLayerImpl(Layer):
+    """Keras CenterCrop: static center window (keras floor convention:
+    start = (in - out) // 2)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        h, w = x.shape[1], x.shape[2]
+        th, tw = self.lc.height, self.lc.width
+        if h < th or w < tw:
+            # keras falls back to smart_resize here; our declared output
+            # shape cannot flex, so fail loudly rather than mis-shape
+            raise ValueError(
+                f"CenterCropLayer: input {h}x{w} smaller than target "
+                f"{th}x{tw} (keras would resize; use ResizeLayer instead)")
+        y0, x0 = (h - th) // 2, (w - tw) // 2
+        return x[:, y0:y0 + th, x0:x0 + tw, :], state, mask
+
+
 LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.DenseLayer: DenseLayerImpl,
     C.OutputLayer: OutputLayerImpl,
@@ -1655,6 +1687,8 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.MaskLayer: MaskLayerImpl,
     C.MaskZeroLayer: MaskZeroLayerImpl,
     C.RepeatVector: RepeatVectorImpl,
+    C.ResizeLayer: ResizeLayerImpl,
+    C.CenterCropLayer: CenterCropLayerImpl,
     C.SameDiffLayer: SameDiffLayerImpl,
     C.SpaceToDepthLayer: SpaceToDepthLayerImpl,
     C.Deconvolution1D: Deconvolution1DImpl,

@@ -346,3 +346,66 @@ class TestKerasV3FileImport:
         golden = model(x, training=False).numpy()
         np.testing.assert_allclose(net.output(x), golden, rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestFinalMappers:
+    def test_resizing_and_center_crop(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((10, 12, 3)),
+            tf.keras.layers.Resizing(20, 24, interpolation="bilinear"),
+            tf.keras.layers.CenterCrop(8, 8),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(0).rand(2, 10, 12, 3).astype(np.float32)
+        assert_outputs_match(model, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_resizing_nearest(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((6, 6, 2)),
+            tf.keras.layers.Resizing(12, 12, interpolation="nearest"),
+        ])
+        net = import_keras_model(model)
+        x = np.random.RandomState(1).rand(2, 6, 6, 2).astype(np.float32)
+        assert_outputs_match(model, net, x)
+
+    def test_dot_merge_functional(self):
+        a = tf.keras.layers.Input((5, 8))
+        b = tf.keras.layers.Input((7, 8))
+        out = tf.keras.layers.Dot(axes=2)([a, b])  # (N, 5, 7)
+        model = tf.keras.Model([a, b], out)
+        net = import_keras_model(model)
+        r = np.random.RandomState(2)
+        xa = r.randn(2, 5, 8).astype(np.float32)
+        xb = r.randn(2, 7, 8).astype(np.float32)
+        golden = model([xa, xb], training=False).numpy()
+        got = net.output(xa, xb)[0]
+        np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+    def test_dot_merge_normalized(self):
+        a = tf.keras.layers.Input((4,))
+        b = tf.keras.layers.Input((4,))
+        out = tf.keras.layers.Dot(axes=1, normalize=True)([a, b])
+        model = tf.keras.Model([a, b], out)
+        net = import_keras_model(model)
+        r = np.random.RandomState(3)
+        xa = r.randn(3, 4).astype(np.float32)
+        xb = r.randn(3, 4).astype(np.float32)
+        golden = model([xa, xb], training=False).numpy()
+        got = net.output(xa, xb)[0]
+        np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+    def test_dot_merge_feeds_dense(self):
+        """Dot output consumed downstream: shape inference must give the
+        following Dense a real n_in (cosine-similarity-head pattern)."""
+        a = tf.keras.layers.Input((4,))
+        b = tf.keras.layers.Input((4,))
+        sim = tf.keras.layers.Dot(axes=1, normalize=True)([a, b])
+        out = tf.keras.layers.Dense(2)(sim)
+        model = tf.keras.Model([a, b], out)
+        net = import_keras_model(model)
+        r = np.random.RandomState(5)
+        xa = r.randn(3, 4).astype(np.float32)
+        xb = r.randn(3, 4).astype(np.float32)
+        golden = model([xa, xb], training=False).numpy()
+        got = net.output(xa, xb)[0]
+        np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)

@@ -738,3 +738,35 @@ class TestBertSavedModelFinetune:
                          sd.graph_outputs[0])[sd.graph_outputs[0]]
         acc = (pred.argmax(1) == ys.argmax(1)).mean()
         assert acc > 0.8, acc
+
+
+class TestSpaceBatchOps:
+    def test_atrous_conv_via_space_to_batch(self):
+        """tf.nn.atrous_conv2d lowers to SpaceToBatchND → Conv2D →
+        BatchToSpaceND in frozen graphs — the dilated-conv import path."""
+        w = np.random.RandomState(0).randn(3, 3, 2, 4).astype(np.float32)
+
+        def model(x):
+            return tf.nn.atrous_conv2d(x, tf.constant(w), rate=2,
+                                       padding="SAME")
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([1, 8, 8, 2], tf.float32))
+        assert "SpaceToBatchND" in {n.op for n in gd.node}  # real lowering
+        x = np.random.RandomState(1).rand(1, 8, 8, 2).astype(np.float32)
+        golden = model(tf.constant(x)).numpy()
+        sd = TensorflowImporter().run_import(gd)
+        got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+        np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+    def test_space_batch_round_trip(self):
+        def model(x):
+            y = tf.space_to_batch(x, paddings=[[0, 0], [0, 0]],
+                                  block_shape=[2, 2])
+            return tf.batch_to_space(y, crops=[[0, 0], [0, 0]],
+                                     block_shape=[2, 2])
+
+        gd, ins, outs = freeze(model, tf.TensorSpec([2, 4, 4, 3], tf.float32))
+        x = np.random.RandomState(2).rand(2, 4, 4, 3).astype(np.float32)
+        sd = TensorflowImporter().run_import(gd)
+        got = sd.output({ins[0]: x}, outs[0])[outs[0]]
+        np.testing.assert_allclose(got, x, rtol=1e-6)
