@@ -19,6 +19,7 @@ Supports both legacy HDF5 (.h5) files and in-memory keras model objects
 from __future__ import annotations
 
 import json
+import warnings
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -610,6 +611,24 @@ def import_keras_model_and_weights(path: str):
     return import_keras_functional_config(config, weights)
 
 
+# layer classes that legitimately save no weight group in a .keras zip
+_WEIGHTLESS_KERAS_LAYERS = {
+    "InputLayer", "Dropout", "SpatialDropout1D", "SpatialDropout2D",
+    "SpatialDropout3D", "Flatten", "Reshape", "Permute", "RepeatVector",
+    "Activation", "ActivityRegularization", "Masking", "Lambda",
+    "Add", "Subtract", "Multiply", "Average", "Maximum", "Minimum",
+    "Concatenate", "Dot", "MaxPooling1D", "MaxPooling2D", "MaxPooling3D",
+    "AveragePooling1D", "AveragePooling2D", "AveragePooling3D",
+    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "GlobalMaxPooling3D",
+    "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+    "GlobalAveragePooling3D", "UpSampling1D", "UpSampling2D", "UpSampling3D",
+    "ZeroPadding1D", "ZeroPadding2D", "ZeroPadding3D", "Cropping1D",
+    "Cropping2D", "Cropping3D", "Resizing", "CenterCrop", "Rescaling",
+    "GaussianNoise", "GaussianDropout", "AlphaDropout",
+    "LeakyReLU", "ELU", "ThresholdedReLU", "ReLU", "Softmax",
+}
+
+
 def _keras_snake_case(name: str) -> str:
     """Keras's to_snake_case: the rule behind .keras weight-group names."""
     import re
@@ -648,6 +667,15 @@ def read_keras_v3(path: str):
             counters[snake] = idx + 1
             gname = snake if idx == 0 else f"{snake}_{idx}"
             if layers_grp is None or gname not in layers_grp:
+                # a weightless layer (Dropout/Flatten/…) legitimately has no
+                # group; for anything else a naming divergence from keras's
+                # saving_lib would silently leave the layer on random init —
+                # warn loudly (ADVICE r4 #4)
+                if cls not in _WEIGHTLESS_KERAS_LAYERS:
+                    warnings.warn(
+                        f"keras-3 import: no weight group '{gname}' in "
+                        f"model.weights.h5 for layer '{name}' ({cls}); the "
+                        f"layer will use random initialization", stacklevel=2)
                 continue
             grp = layers_grp[gname]
             ws: List[np.ndarray] = []

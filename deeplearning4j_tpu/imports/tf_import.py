@@ -17,6 +17,7 @@ environment for golden-file generation; the runtime path is pure jax).
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -885,28 +886,30 @@ def _collect_subgraph(roots, leaf_names, producer, initializers):
     (exact tensor refs or bare node names) and at initializers. Returns
     (nodes in topological order, initializer subset)."""
     nodes, inits, seen = [], {}, set()
-
-    def rec(t):
+    # iterative post-order (ADVICE r4 #2: deep sequential graphs blow the
+    # Python recursion limit) — the `expanded` flag marks the second visit,
+    # after all ancestors are emitted, preserving topological order
+    stack = [(r, False) for r in reversed(list(roots))]
+    while stack:
+        t, expanded = stack.pop()
+        if expanded:
+            nodes.append(producer[_base(t)])
+            continue
         if t in leaf_names:
-            return
+            continue
         base = _base(t)
-        if base in leaf_names:
-            return
-        if base in seen:
-            return
+        if base in leaf_names or base in seen:
+            continue
         if base in initializers:
             inits[base] = initializers[base]
-            return
+            continue
         n = producer.get(base)
         if n is None:
-            return  # main-graph placeholder or unresolvable — walker errors later
+            continue  # main-graph placeholder or unresolvable — walker errors later
         seen.add(base)
-        for i in n.inputs:
-            rec(i)
-        nodes.append(n)
-
-    for r in roots:
-        rec(r)
+        stack.append((t, True))
+        for i in reversed(n.inputs):
+            stack.append((i, False))
     return nodes, inits
 
 
@@ -1026,26 +1029,29 @@ def _collapse_tf1_control_flow(ir):
     # ---- frameless conds ---------------------------------------------------
     def switch_crossings(t, seen, out):
         """Collect pred -> {slots} for every Switch crossed on any path
-        upstream of tensor ``t``. Recursion continues THROUGH a Switch's
+        upstream of tensor ``t``. The walk continues THROUGH a Switch's
         data input (so outer conds are visible past inner ones) but not
-        into its pred input (the pred is evaluated before branching)."""
-        base = _base(t)
-        # memo on the full tensor ref: the same Switch may be crossed at
-        # BOTH slots within one branch (a cond nested inside it) and each
-        # slot must be recorded
-        if t in seen or base in removed:
-            return
-        seen.add(t)
-        n = producer.get(base)
-        if n is None:
-            return
-        if n.op_type == "Switch":
-            slot = t.split(":")[1] if ":" in t else "0"
-            out.setdefault(n.inputs[1], set()).add(slot)
-            switch_crossings(n.inputs[0], seen, out)
-            return
-        for i in n.inputs:
-            switch_crossings(i, seen, out)
+        into its pred input (the pred is evaluated before branching).
+        Iterative (ADVICE r4 #2: deep graphs overflow Python recursion)."""
+        stack = [t]
+        while stack:
+            t = stack.pop()
+            base = _base(t)
+            # memo on the full tensor ref: the same Switch may be crossed at
+            # BOTH slots within one branch (a cond nested inside it) and
+            # each slot must be recorded
+            if t in seen or base in removed:
+                continue
+            seen.add(t)
+            n = producer.get(base)
+            if n is None:
+                continue
+            if n.op_type == "Switch":
+                slot = t.split(":")[1] if ":" in t else "0"
+                out.setdefault(n.inputs[1], set()).add(slot)
+                stack.append(n.inputs[0])
+                continue
+            stack.extend(n.inputs)
 
     def resolve_merge_pred(merge):
         """The cond a Merge closes is the pred whose switches are crossed
@@ -1270,6 +1276,13 @@ def _var_handle(sd, ins, attrs, node):
     shape = tuple(d.size for d in want.dim) if want is not None else None
     matches = [k for k, v in values.items() if np.shape(v) == shape]
     if len(matches) == 1:
+        # a silent mis-bind here would fine-tune from the wrong weights, so
+        # name the matched key loudly (ADVICE r4 #1)
+        warnings.warn(
+            f"{node.op_type} {node.name}: variable '{shared}' not in the "
+            f"checkpoint by name; bound by unique shape {shape} to "
+            f"checkpoint key '{matches[0]}' — verify this is the intended "
+            f"weight", stacklevel=2)
         return sd.var(node.name, np.asarray(values[matches[0]]))
     raise ValueError(
         f"{node.op_type} {node.name}: no checkpoint value for variable "
@@ -1365,8 +1378,10 @@ def import_saved_model(path: str, *, signature: str = "serving_default",
         raise ValueError(f"SavedModel has no signature '{signature}'; "
                          f"found {sorted(mg.signature_def)}")
     sig = mg.signature_def[signature]
-    out_tensors = [t.name for t in sig.outputs.values()]
-    in_tensors = [t.name for t in sig.inputs.values()]
+    # protobuf map iteration order is not contractual — sort by signature key
+    # so multi-output order is stable across environments (ADVICE r4 #3)
+    out_tensors = [t.name for _, t in sorted(sig.outputs.items())]
+    in_tensors = [t.name for _, t in sorted(sig.inputs.items())]
 
     def norm(t):
         base, _, slot = t.partition(":")

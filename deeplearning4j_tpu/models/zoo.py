@@ -225,7 +225,8 @@ class ResNet50(ZooModel):
              .set_input_types(input=nn.InputType.convolutional(h, w, c)))
         b.add_layer("conv1", nn.ConvolutionLayer(
             n_out=64, kernel=(7, 7), stride=(2, 2), convolution_mode="same",
-            activation="identity", has_bias=False), "input")
+            activation="identity", has_bias=False,
+            s2d_stem=(h % 2 == 0 and w % 2 == 0)), "input")
         b.add_layer("bn1", nn.BatchNormalization(activation="relu"), "conv1")
         b.add_layer("pool1", nn.SubsamplingLayer(
             kernel=(3, 3), stride=(2, 2), convolution_mode="same"), "bn1")

@@ -244,6 +244,12 @@ class ConvolutionLayer(LayerConf):
     dilation: Tuple[int, int] = (1, 1)
     convolution_mode: str = "truncate"
     has_bias: bool = True
+    # TPU stem optimization: lower a 7x7/stride-2/'same' conv as a 4x4/stride-1
+    # conv over a 2x2 space-to-depth input (MLPerf ResNet trick). Mathematically
+    # exact — the canonical (7,7,C,F) kernel is kept in params and zero-padded/
+    # regrouped at apply time, so checkpoints and gradients are identical; only
+    # the XLA lowering changes (C=3 convs waste the MXU's 128-wide lanes).
+    s2d_stem: bool = False
 
     def output_type(self, itype):
         kh, kw = _pair(self.kernel)

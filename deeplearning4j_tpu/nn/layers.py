@@ -160,8 +160,35 @@ class ConvolutionLayerImpl(Layer):
 
     def apply(self, params, x, state, *, train, rng, mask=None):
         x = self._maybe_dropout(x, train=train, rng=rng)
-        z = nn_ops.conv2d.fn(x, params["W"], params.get("b"), **self._conv_args())
+        if getattr(self.lc, "s2d_stem", False):
+            z = self._s2d_stem_conv(x, params["W"], params.get("b"))
+        else:
+            z = nn_ops.conv2d.fn(x, params["W"], params.get("b"), **self._conv_args())
         return self.activation(z), state, mask
+
+    def _s2d_stem_conv(self, x, W, b):
+        """7×7/2 'same' conv lowered as 4×4/1 over a 2×2 space-to-depth input.
+
+        Exact rewrite (MLPerf ResNet stem trick): pad the kernel to 8×8 with
+        zeros on the high edge, regroup Wp[2α+da, 2β+db, c, f] into
+        W2[α, β, (da·2+db)·C+c, f] (matching space_to_depth's channel order),
+        and the stride-2 'same' conv becomes a stride-1 conv with pad (1,2).
+        Gradients flow only into the canonical 7×7 entries (the pad is a
+        constant), so training is bit-for-bit the same model.
+        """
+        lc = self.lc
+        if (tuple(C._pair(lc.kernel)) != (7, 7) or tuple(C._pair(lc.stride)) != (2, 2)
+                or tuple(C._pair(lc.dilation)) != (1, 1)
+                or lc.convolution_mode != "same"
+                or x.shape[1] % 2 or x.shape[2] % 2):
+            return nn_ops.conv2d.fn(x, W, b, **self._conv_args())
+        c_in, f = W.shape[2], W.shape[3]
+        Wp = jnp.pad(W, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        W2 = (Wp.reshape(4, 2, 4, 2, c_in, f).transpose(0, 2, 1, 3, 4, 5)
+              .reshape(4, 4, 4 * c_in, f))
+        from deeplearning4j_tpu.ops import exec_op
+        x2 = exec_op("space_to_depth", x, block_size=2)
+        return nn_ops.conv2d.fn(x2, W2, b, stride=(1, 1), padding=((1, 2), (1, 2)))
 
 
 class Deconvolution2DImpl(ConvolutionLayerImpl):

@@ -126,7 +126,7 @@ def launch(nprocs: int, argv: Sequence[str], restarts: int = 0,
             procs.append(subprocess.Popen(
                 [sys.executable] + list(argv), env=env))
         deadline = time.time() + timeout
-        failed = False
+        failed = timed_out = False
         while procs:
             for p in list(procs):
                 rc = p.poll()
@@ -135,7 +135,8 @@ def launch(nprocs: int, argv: Sequence[str], restarts: int = 0,
                 procs.remove(p)
                 if rc != 0:
                     failed = True
-            if failed or time.time() > deadline:
+            timed_out = bool(procs) and time.time() > deadline
+            if failed or timed_out:
                 for p in procs:  # kill survivors (they may be blocked in a
                     p.terminate()  # collective waiting on the dead rank)
                 for p in procs:
@@ -145,9 +146,18 @@ def launch(nprocs: int, argv: Sequence[str], restarts: int = 0,
                         p.kill()
                 break
             time.sleep(0.1)
-        if not failed and procs == []:
+        if not failed and not timed_out and procs == []:
             return 0
-        print(f"[launch] attempt {attempt + 1}/{restarts + 1} failed"
+        # a timeout is a healthy-but-slow job, not a crash: report it
+        # distinctly and do not burn a restart attempt on it (ADVICE r4 #5)
+        if timed_out and not failed:
+            print(f"[launch] attempt {attempt + 1}: workers exceeded the "
+                  f"--timeout of {timeout:.0f}s and were killed (not a "
+                  f"worker failure; raise --timeout for long jobs)",
+                  flush=True)
+            return 124  # conventional timeout exit code
+        print(f"[launch] attempt {attempt + 1}/{restarts + 1} failed "
+              f"(worker crash)"
               + ("; relaunching (workers resume from checkpoint)"
                  if attempt < restarts else ""),
               flush=True)

@@ -1,0 +1,109 @@
+"""Round-5 perf levers: s2d stem exactness, fused conv+BN Pallas kernel.
+
+The levers must be *mathematically exact* rewrites — every test here checks
+the optimized path against the canonical one, not against golden numbers.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import nn
+
+from tests._helpers import _mln, _rng
+
+
+class TestS2DStem:
+    """ConvolutionLayer(s2d_stem=True): 7×7/2 'same' conv lowered over a 2×2
+    space-to-depth input (MLPerf ResNet stem trick) must match the plain
+    lowering bit-for-bit up to fp reassociation."""
+
+    def _nets(self, h=32, w=32):
+        def mk(s2d):
+            return _mln([
+                nn.ConvolutionLayer(n_out=16, kernel=(7, 7), stride=(2, 2),
+                                    convolution_mode="same", has_bias=False,
+                                    activation="identity", s2d_stem=s2d),
+                nn.GlobalPoolingLayer(pooling_type="avg"),
+                nn.OutputLayer(n_out=5, activation="softmax", loss="mcxent"),
+            ], nn.InputType.convolutional(h, w, 3))
+        a, b = mk(False), mk(True)
+        b.params = jax.tree.map(jnp.array, a.params)  # copy (donation-safe)
+        return a, b
+
+    def test_forward_matches_plain_conv(self):
+        a, b = self._nets()
+        x = _rng(0).randn(4, 32, 32, 3).astype(np.float32)
+        np.testing.assert_allclose(a.output(x), b.output(x), atol=1e-5)
+
+    def test_train_step_matches_plain_conv(self):
+        a, b = self._nets()
+        r = _rng(1)
+        x = r.randn(4, 32, 32, 3).astype(np.float32)
+        y = np.eye(5)[r.randint(0, 5, 4)].astype(np.float32)
+        a.fit(x, y)
+        b.fit(x, y)
+        diffs = jax.tree.map(
+            lambda p, q: float(jnp.max(jnp.abs(p - q))), a.params, b.params)
+        assert jax.tree.reduce(max, diffs) < 1e-5
+
+    def test_odd_input_falls_back(self):
+        # odd spatial dims can't space-to-depth; the layer must fall back to
+        # the plain conv path rather than mis-shape
+        a, b = self._nets(h=31, w=31)
+        x = _rng(2).randn(2, 31, 31, 3).astype(np.float32)
+        np.testing.assert_allclose(a.output(x), b.output(x), atol=1e-5)
+
+    def test_json_roundtrip(self):
+        lc = nn.ConvolutionLayer(n_out=8, kernel=(7, 7), stride=(2, 2),
+                                 convolution_mode="same", s2d_stem=True)
+        from deeplearning4j_tpu.nn import conf as C
+        d = lc.to_dict()
+        back = C.LayerConf.from_dict(d)
+        assert back.s2d_stem is True
+
+
+class TestFusedBnMatmulStats:
+    """Pallas fused BN-apply → matmul → shifted-stats kernel (interpret mode
+    on the CPU mesh; the real-chip timing lives in
+    tools/bench_convbn_fusion.py)."""
+
+    def test_matches_reference_chain(self):
+        from deeplearning4j_tpu.ops.pallas_convbn import (
+            fused_bn_matmul_stats, reference_bn_matmul_stats)
+        r = _rng(0)
+        m, k, n = 512, 128, 64
+        x = jnp.asarray(r.randn(m, k).astype(np.float32)).astype(jnp.bfloat16)
+        sc = jnp.asarray(r.rand(k).astype(np.float32) + 0.5)
+        sh = jnp.asarray(r.randn(k).astype(np.float32) * 0.1)
+        w = jnp.asarray((r.randn(k, n) * k ** -0.5).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        ss = jnp.asarray(r.randn(n).astype(np.float32) * 0.1)
+        z1, m1, v1 = fused_bn_matmul_stats(x, sc, sh, w, ss, interpret=True)
+        z2, m2, v2 = reference_bn_matmul_stats(x, sc, sh, w, ss)
+        np.testing.assert_allclose(np.asarray(z1, np.float32),
+                                   np.asarray(z2, np.float32), atol=1e-2)
+        np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), atol=1e-3)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=1e-2,
+                                   atol=1e-3)
+
+    def test_no_prologue_no_relu(self):
+        from deeplearning4j_tpu.ops.pallas_convbn import (
+            fused_bn_matmul_stats, reference_bn_matmul_stats)
+        r = _rng(1)
+        m, k, n = 256, 64, 128
+        x = jnp.asarray(r.randn(m, k).astype(np.float32)).astype(jnp.bfloat16)
+        sc = jnp.ones((k,), jnp.float32)
+        sh = jnp.zeros((k,), jnp.float32)
+        w = jnp.asarray((r.randn(k, n) * k ** -0.5).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        ss = jnp.zeros((n,), jnp.float32)
+        z1, m1, v1 = fused_bn_matmul_stats(
+            x, sc, sh, w, ss, relu=False, fuse_prologue=False, interpret=True)
+        z2, m2, v2 = reference_bn_matmul_stats(
+            x, sc, sh, w, ss, relu=False, fuse_prologue=False)
+        np.testing.assert_allclose(np.asarray(z1, np.float32),
+                                   np.asarray(z2, np.float32), atol=1e-2)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=1e-2,
+                                   atol=1e-3)
