@@ -1036,6 +1036,32 @@ class SameDiff:
         self._local_ops[name + "_impl"] = while_op
         return self._record(name + "_impl", list(init_vars), n_out=n)
 
+    def scan_multi(self, fn, init_vars: Sequence["SDVariable"],
+                   xs_vars: Sequence["SDVariable"], n_ys: int,
+                   length: Optional[int] = None):
+        """Recorded multi-carry multi-output lax.scan — the ONNX Scan /
+        Loop-with-scan-outputs analog (reference: onnx Scan/Loop op defs,
+        SURVEY §3.2 samediff-import-onnx).
+
+        fn: (tuple(carry), tuple(x_slices)) -> (tuple(carry), tuple(y_slices));
+        returns [final carries…] + [stacked ys…] as SDVariables."""
+        name = self._fresh("scan")
+        n_state = len(init_vars)
+        n_out = n_state + n_ys
+
+        def scan_op(*vals):
+            inits = tuple(vals[:n_state])
+            xs = tuple(vals[n_state:])
+            carry, ys = jax.lax.scan(fn, inits, xs if xs else None,
+                                     length=length)
+            outs = tuple(carry) + (tuple(ys) if isinstance(ys, tuple)
+                                   else (ys,) if n_ys else ())
+            return outs[0] if n_out == 1 else outs
+
+        self._local_ops[name + "_impl"] = scan_op
+        return self._record(name + "_impl",
+                            list(init_vars) + list(xs_vars), n_out=n_out)
+
     def cond_multi(self, pred_var: "SDVariable", true_fn, false_fn,
                    operands: Sequence["SDVariable"], n_out: int):
         """Recorded lax.cond over N operands with M outputs — the TF2

@@ -65,10 +65,15 @@ class IRImporter:
 
     def __init__(self, rules: Dict[str, Callable[..., Any]],
                  needs_consts: Sequence[str] = (),
-                 trainable_consts: bool = True):
+                 trainable_consts: bool = True,
+                 needs_scope: Sequence[str] = ()):
         self.rules = dict(rules)
         self.needs_consts = set(needs_consts)
         self.trainable_consts = trainable_consts
+        # ops whose rule receives scope= (the live name→SDVariable map built
+        # so far) — ONNX Loop/If/Scan subgraphs capture outer-scope tensors
+        # by name, unlike TF function-style control flow
+        self.needs_scope = set(needs_scope)
 
     def supported_ops(self) -> List[str]:
         return sorted(self.rules)
@@ -105,10 +110,12 @@ class IRImporter:
                     f"unresolved input(s) {missing} — its producer's mapping "
                     f"rule may not register that output slot")
             ins = [produced[n] for n in node.inputs if n]
+            kw = {}
             if node.op_type in self.needs_consts:
-                out = rule(sd, ins, node.attrs, node, const_values=const_values)
-            else:
-                out = rule(sd, ins, node.attrs, node)
+                kw["const_values"] = const_values
+            if node.op_type in self.needs_scope:
+                kw["scope"] = produced
+            out = rule(sd, ins, node.attrs, node, **kw)
             if out is None:
                 continue
             outs = out if isinstance(out, (list, tuple)) else [out]

@@ -367,6 +367,63 @@ class BatchNormalization(LayerConf):
 
 
 @dataclasses.dataclass(frozen=True)
+class MoELayer(LayerConf):
+    """Mixture-of-Experts FFN layer (GShard/Switch recipe) as a standard
+    LayerConf — usable in MultiLayerNetwork/ComputationGraph and composing
+    with ParallelWrapper(mesh={'data':…, 'expert':…}) + ``moe_ep_rules()``:
+    the dispatch/combine einsums are written dense so GSPMD partitions the
+    expert axis and inserts the all-to-alls (no hand shard_map).
+
+    top_k=1 is Switch routing, top_k=2 the GShard default. The load-balance
+    aux loss rides the layer STATE under ``_aux_loss`` (summed into the
+    training loss by the step functions); ``_dropped_frac`` reports the
+    fraction of token→expert assignments dropped at capacity — surfaced to
+    listeners/UI as a routing-health diagnostic.
+
+    Exceeds-reference axis (SURVEY §6.7): the reference has no MoE; recipe
+    per the public GShard/Switch papers.
+    """
+
+    n_in: int = 0
+    d_hidden: int = 0
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 1e-2
+
+    def output_type(self, itype):
+        return itype
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedBottleneck(LayerConf):
+    """TPU-fused ResNet v1 bottleneck block: 1×1 → BN+relu → 3×3 → BN+relu
+    → 1×1 → BN → (+shortcut) → relu as ONE layer, so the 1×1 convs can run
+    the Pallas conv+BN-fusion kernel (ops/pallas_convbn.py). Identical math
+    to the composed layers (zoo ResNet50's _bottleneck expansion); a pure
+    performance arrangement for HBM-bound conv/BN stacks.
+    """
+
+    n_in: int = 0
+    filters: int = 0
+    stride: int = 1
+    project: bool = False
+    decay: float = 0.9
+    eps: float = 1e-5
+
+    def output_type(self, itype):
+        s = self.stride
+        return InputType.convolutional(
+            -(-itype.height // s), -(-itype.width // s), 4 * self.filters)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
 class LocalResponseNormalization(LayerConf):
     """conf/layers/LocalResponseNormalization.java."""
 
@@ -1403,6 +1460,8 @@ class CenterCropLayer(LayerConf):
 LAYER_TYPES = {
     c.__name__: c
     for c in [
+        MoELayer,
+        FusedBottleneck,
         ResizeLayer,
         CenterCropLayer,
         SameDiffLayer,

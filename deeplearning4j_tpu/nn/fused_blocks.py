@@ -1,0 +1,173 @@
+"""TPU-fused ResNet bottleneck block (round-5 perf lever 1 executed).
+
+One layer = the whole canonical v1 bottleneck {1×1 → BN+relu → 3×3 →
+BN+relu → 1×1 → BN → (+shortcut) → relu}, arranged so the two 1×1 convs run
+through ``ops/pallas_convbn.fused_matmul_bn`` — a single-HBM-pass Pallas
+kernel that folds the previous BN's affine+relu into the matmul's operand
+read and this conv's BN statistics into its output write. Per block this
+eliminates the standalone BN-stats passes of bn1/bn3/bn_sc, and the
+materialized normalize pass between bn2 and c3 (docs/PERF_ANALYSIS.md: the
+step is HBM-bound on exactly these passes).
+
+Mathematically identical to the composed layers (same one-pass shifted
+moments as ``_bn_core``, same running-buffer decay semantics); the Pallas
+path engages only on TPU/bf16, so the CPU mesh runs the reference chain —
+``tests/test_fused_block.py`` pins equality against the composed-layer
+graph for forward, gradients, and running stats.
+
+Reference parity: this fuses the same (Conv, BatchNormalization, Activation)
+triple the reference builds ResNet50 from (zoo/model/ResNet50.java), the
+role cuDNN's fused ConvScaleBiasActivation kernels play on GPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.nn.layers import Layer
+from deeplearning4j_tpu.ops.pallas_convbn import fused_matmul_bn
+from deeplearning4j_tpu.ops import nn_ops
+from deeplearning4j_tpu.ops.weight_init import init_weights
+
+_F32 = jnp.float32
+
+
+def _affine(gamma, beta, mean, var, eps):
+    """Fold BN (stats, γ, β) into per-channel scale/shift, f32."""
+    inv = lax.rsqrt(var.astype(_F32) + eps)
+    sc = inv if gamma is None else inv * gamma.astype(_F32)
+    sh = -mean.astype(_F32) * sc
+    if beta is not None:
+        sh = sh + beta.astype(_F32)
+    return sc, sh
+
+
+def _shifted_stats(z, stat_shift):
+    """One-pass running-mean-shifted batch moments over all but the channel
+    axis (same numerics contract as ``_bn_core``)."""
+    sf = lax.stop_gradient(stat_shift.astype(_F32))
+    axes = tuple(range(z.ndim - 1))
+    c = z.astype(_F32) - sf
+    m1 = jnp.mean(c, axis=axes)
+    m2 = jnp.mean(jnp.square(c), axis=axes)
+    return m1 + sf, jnp.maximum(m2 - jnp.square(m1), 0.0)
+
+
+class FusedBottleneckImpl(Layer):
+    """Runtime twin of conf.FusedBottleneck."""
+
+    def init(self, key):
+        lc = self.lc
+        c_in, f = lc.n_in, lc.filters
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        dt = self.dtype
+        p = {
+            "W1": init_weights(k1, (1, 1, c_in, f), self.winit, dtype=dt),
+            "g1": jnp.ones((f,), dt), "b1": jnp.zeros((f,), dt),
+            "W2": init_weights(k2, (3, 3, f, f), self.winit, dtype=dt),
+            "g2": jnp.ones((f,), dt), "b2": jnp.zeros((f,), dt),
+            "W3": init_weights(k3, (1, 1, f, 4 * f), self.winit, dtype=dt),
+            "g3": jnp.ones((4 * f,), dt), "b3": jnp.zeros((4 * f,), dt),
+        }
+        if lc.project:
+            p["Wsc"] = init_weights(k4, (1, 1, c_in, 4 * f), self.winit, dtype=dt)
+            p["gsc"] = jnp.ones((4 * f,), dt)
+            p["bsc"] = jnp.zeros((4 * f,), dt)
+        return p
+
+    def init_state(self):
+        f = self.lc.filters
+        s = {"m1": jnp.zeros((f,), _F32), "v1": jnp.ones((f,), _F32),
+             "m2": jnp.zeros((f,), _F32), "v2": jnp.ones((f,), _F32),
+             "m3": jnp.zeros((4 * f,), _F32), "v3": jnp.ones((4 * f,), _F32)}
+        if self.lc.project:
+            s["msc"] = jnp.zeros((4 * f,), _F32)
+            s["vsc"] = jnp.ones((4 * f,), _F32)
+        return s
+
+    # ------------------------------------------------------------------
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        s = lc.stride
+        xs = x[:, ::s, ::s, :] if s != 1 else x
+        n, h, w_, c_in = xs.shape
+        m = n * h * w_
+        x2 = xs.reshape(m, c_in)
+        if not train:
+            return self._apply_eval(params, x, xs, x2, state), state, mask
+        eps, decay = lc.eps, lc.decay
+        f = lc.filters
+        ones1 = jnp.ones((c_in,), _F32)
+        zeros1 = jnp.zeros((c_in,), _F32)
+
+        # c1 (1×1, stride folded into the slice) + bn1 stats in-epilogue
+        z1, mean1, var1 = fused_matmul_bn(
+            x2, ones1, zeros1, params["W1"].reshape(c_in, f), state["m1"],
+            False, False)
+        sc1, sh1 = _affine(params["g1"], params["b1"], mean1, var1, eps)
+        # normalize+relu must materialize for the 3×3 conv (XLA convs take
+        # HBM operands) — one elementwise pass
+        y1 = jnp.maximum(z1.astype(_F32) * sc1 + sh1, 0.0).astype(z1.dtype)
+        z2 = nn_ops.conv2d.fn(y1.reshape(n, h, w_, f), params["W2"], None,
+                              stride=(1, 1), padding="same")
+        # bn2 stats: separate pass (its affine feeds c3's fused prologue,
+        # so the normalize write/read pair is eliminated instead)
+        mean2, var2 = _shifted_stats(z2, state["m2"])
+        sc2, sh2 = _affine(params["g2"], params["b2"], mean2, var2, eps)
+        z3, mean3, var3 = fused_matmul_bn(
+            z2.reshape(m, f), sc2, sh2, params["W3"].reshape(f, 4 * f),
+            state["m3"], True, True)
+        sc3, sh3 = _affine(params["g3"], params["b3"], mean3, var3, eps)
+
+        new_state = dict(state)
+        if lc.project:
+            zsc, meansc, varsc = fused_matmul_bn(
+                x2, ones1, zeros1, params["Wsc"].reshape(c_in, 4 * f),
+                state["msc"], False, False)
+            scsc, shsc = _affine(params["gsc"], params["bsc"], meansc, varsc, eps)
+            shortcut = zsc.astype(_F32) * scsc + shsc
+            self._update_running(new_state, "sc", meansc, varsc, m, decay)
+        else:
+            shortcut = x2.astype(_F32)
+        out = jnp.maximum(z3.astype(_F32) * sc3 + sh3 + shortcut, 0.0)
+        out = out.astype(x.dtype).reshape(n, h, w_, 4 * f)
+        for tag, mu, var in (("1", mean1, var1), ("2", mean2, var2),
+                             ("3", mean3, var3)):
+            self._update_running(new_state, tag, mu, var, m, decay)
+        return out, new_state, mask
+
+    @staticmethod
+    def _update_running(state, tag, mean, var, count, decay):
+        unbiased = var * count / max(count - 1, 1)
+        state["m" + tag] = (decay * state["m" + tag]
+                            + (1 - decay) * lax.stop_gradient(mean))
+        state["v" + tag] = (decay * state["v" + tag]
+                            + (1 - decay) * lax.stop_gradient(unbiased))
+
+    def _apply_eval(self, params, x, xs, x2, state):
+        lc = self.lc
+        eps = lc.eps
+        n, h, w_, c_in = xs.shape
+        f = lc.filters
+        dt = x.dtype
+
+        def bn(z, tag):
+            g, b = params["g" + tag], params["b" + tag]
+            sc, sh = _affine(g, b, state["m" + tag], state["v" + tag], eps)
+            return z.astype(_F32) * sc + sh
+
+        y1 = jnp.maximum(bn(x2 @ params["W1"].reshape(c_in, f), "1"), 0.0)
+        z2 = nn_ops.conv2d.fn(y1.astype(dt).reshape(n, h, w_, f),
+                              params["W2"], None, stride=(1, 1),
+                              padding="same")
+        y2 = jnp.maximum(bn(z2, "2"), 0.0).astype(dt)
+        z3 = bn(y2.reshape(-1, f) @ params["W3"].reshape(f, 4 * f), "3")
+        if lc.project:
+            shortcut = bn(x2 @ params["Wsc"].reshape(c_in, 4 * f), "sc")
+        else:
+            shortcut = x2.astype(_F32)
+        out = jnp.maximum(z3 + shortcut, 0.0)
+        return out.astype(dt).reshape(n, h, w_, 4 * f)

@@ -31,7 +31,7 @@ from deeplearning4j_tpu.nn.updater import Updater, get_updater
 from deeplearning4j_tpu.nn.listeners import TrainingListener
 from deeplearning4j_tpu.nn.multilayer import (
     _map_weights, _tree_l1_weights, _tree_l2_sq_weights, _sorted_leaves,
-    _unflatten_like, apply_layer_updates, reg_penalty,
+    _unflatten_like, apply_layer_updates, aux_losses, reg_penalty,
 )
 from deeplearning4j_tpu.ops.losses import get_loss
 from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
@@ -730,7 +730,8 @@ class ComputationGraph:
             def loss_of(p):
                 acts, new_state = self._forward(p, net_state, feeds, fmasks,
                                                 train=True, rng=key)
-                return self._losses(acts, labels, lmasks), new_state
+                return (self._losses(acts, labels, lmasks)
+                        + aux_losses(new_state), new_state)
 
             (loss, new_net_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
             new_params, new_opt = self._apply_updates(params, grads, opt_state, step)

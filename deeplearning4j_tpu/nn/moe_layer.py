@@ -1,0 +1,115 @@
+"""Mixture-of-Experts FFN layer (conf.MoELayer runtime twin).
+
+GShard/Switch dispatch written as dense einsums over an explicit expert
+axis, so that under ``ParallelWrapper`` with a mesh carrying an ``expert``
+dimension and ``moe_ep_rules()`` param sharding, GSPMD partitions the
+expert axis and inserts the all-to-all collectives itself — the TPU-native
+expert-parallel recipe (scaling-book; no hand-written shard_map).
+
+Routing: top-k (k=1 Switch, k=2 GShard default) with capacity
+C = ceil(cf·S·k/E); assignments beyond capacity are dropped (their tokens
+pass through the residual path unscaled — combine weights renormalize over
+the surviving assignments). Two scalars ride the layer state:
+
+* ``_aux_loss``   — Switch load-balance loss E·Σ f_e·P_e times aux_weight;
+  the network step functions add every state ``_aux_loss`` to the training
+  loss (gradient flows — state is computed inside the loss closure).
+* ``_dropped_frac`` — fraction of token→expert assignments dropped at
+  capacity (stop-gradient; a routing-health metric for listeners/UI).
+
+Param names are expert-prefixed (Weg/We1/be1/We2/be2) so the data-parallel
+TP rules never mis-match them; ``parallel.mesh.moe_ep_rules()`` maps them
+onto the ``expert`` mesh axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers import Layer
+from deeplearning4j_tpu.ops.weight_init import init_weights
+
+_F32 = jnp.float32
+
+
+class MoELayerImpl(Layer):
+    def init(self, key):
+        lc = self.lc
+        d, h, e = lc.n_in, lc.d_hidden, lc.n_experts
+        k1, k2, k3 = jax.random.split(key, 3)
+        dt = self.dtype
+        return {
+            "Weg": init_weights(k1, (d, e), self.winit, dtype=dt),
+            "We1": init_weights(k2, (e, d, h), self.winit, dtype=dt),
+            "be1": jnp.zeros((e, h), dt),
+            "We2": init_weights(k3, (e, h, d), self.winit, dtype=dt),
+            "be2": jnp.zeros((e, d), dt),
+        }
+
+    def init_state(self):
+        return {"_aux_loss": jnp.zeros((), _F32),
+                "_dropped_frac": jnp.zeros((), _F32)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        e, k = lc.n_experts, int(lc.top_k)
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        xt = x.reshape(-1, d)                       # (S, d) tokens
+        s = xt.shape[0]
+        cap = max(1, int(-(-lc.capacity_factor * s * k // e)))
+
+        logits = (xt @ params["Weg"]).astype(_F32)  # (S, E)
+        gates = jax.nn.softmax(logits, axis=-1)
+
+        # ---- top-k assignment with capacity (GShard positions) ----------
+        dispatch = jnp.zeros((s, e, cap), _F32)
+        combine = jnp.zeros((s, e, cap), _F32)
+        remaining = gates
+        chosen_masks = []
+        weights = []
+        counts = jnp.zeros((e,), _F32)              # tokens already placed
+        kept = jnp.zeros((), _F32)
+        for _ in range(k):
+            idx = jnp.argmax(remaining, axis=-1)            # (S,)
+            onehot = jax.nn.one_hot(idx, e, dtype=_F32)     # (S, E)
+            w = jnp.sum(gates * onehot, axis=-1)            # (S,)
+            # position of each token within its expert, priority = token
+            # order (cumsum), offset by earlier-k placements
+            pos = jnp.cumsum(onehot, axis=0) - onehot + counts  # (S, E)
+            pos_t = jnp.sum(pos * onehot, axis=-1)              # (S,)
+            fits = pos_t < cap
+            kept = kept + jnp.sum(fits.astype(_F32))
+            sel = onehot * fits[:, None].astype(_F32)           # (S, E)
+            posh = jax.nn.one_hot(pos_t.astype(jnp.int32), cap,
+                                  dtype=_F32)                   # (S, C)
+            dispatch = dispatch + sel[:, :, None] * posh[:, None, :]
+            combine = combine + (w[:, None, None] * sel[:, :, None]
+                                 * posh[:, None, :])
+            chosen_masks.append(onehot)
+            weights.append(w)
+            counts = counts + jnp.sum(sel, axis=0)
+            remaining = remaining * (1.0 - onehot)
+        # renormalize combine weights over the surviving assignments
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+
+        # ---- expert FFN (dense over the expert axis; GSPMD partitions) --
+        cd = x.dtype
+        xin = jnp.einsum("sec,sd->ecd", dispatch.astype(cd), xt)   # (E,C,d)
+        hdn = jnp.einsum("ecd,edh->ech", xin, params["We1"])
+        hdn = self.activation(hdn + params["be1"][:, None, :])
+        out_e = jnp.einsum("ech,ehd->ecd", hdn, params["We2"])
+        out_e = out_e + params["be2"][:, None, :]
+        y = jnp.einsum("sec,ecd->sd", combine.astype(cd), out_e)   # (S, d)
+
+        # ---- aux loss + routing health ---------------------------------
+        f_e = jnp.mean(chosen_masks[0], axis=0)        # top-1 token fraction
+        p_e = jnp.mean(gates, axis=0)                  # mean gate prob
+        aux = lc.aux_weight * e * jnp.sum(f_e * p_e)
+        dropped = 1.0 - kept / (s * k)
+        new_state = {"_aux_loss": aux if train else jnp.zeros((), _F32),
+                     "_dropped_frac": lax.stop_gradient(dropped)}
+        return y.reshape(orig_shape), new_state, mask

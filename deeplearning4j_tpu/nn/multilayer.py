@@ -113,6 +113,19 @@ def apply_layer_updates(conf, items, step, normalize_fn):
     return out
 
 
+def aux_losses(new_state):
+    """Sum differentiable side losses layers stash in their state under
+    ``_aux_loss`` (MoE load-balance loss, nn/moe_layer.py). new_state is a
+    list (MultiLayerNetwork) or dict (ComputationGraph) of layer states;
+    the scalars are computed inside the loss closure, so gradients flow."""
+    states = new_state.values() if isinstance(new_state, dict) else new_state
+    total = jnp.zeros(())
+    for st in states:
+        if isinstance(st, dict) and "_aux_loss" in st:
+            total = total + st["_aux_loss"]
+    return total
+
+
 def reg_penalty(conf, items):
     """Score regularization penalty (BaseLayer.calcRegularizationScore).
     items: iterable of (params, layer_conf)."""
@@ -370,7 +383,7 @@ class MultiLayerNetwork:
                     return loss, new_state
                 out, new_state = self._forward(p, net_state, features, fmask, train=True, rng=key)
                 loss = self._loss_from_out(out, labels, lmask)
-                return loss, new_state
+                return loss + aux_losses(new_state), new_state
 
             (loss, new_net_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             new_params, new_opt = self._apply_updates(params, grads, opt_state, step)
@@ -391,7 +404,7 @@ class MultiLayerNetwork:
                     p, net_state, features, fmask, train=True, rng=key,
                     rnn_states=rnn_states)
                 loss = self._loss_from_out(out, labels, lmask)
-                return loss, (new_state, new_rnn)
+                return loss + aux_losses(new_state), (new_state, new_rnn)
 
             (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)

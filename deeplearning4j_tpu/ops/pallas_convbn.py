@@ -31,6 +31,7 @@ matmul with prologue/epilogue fusion rather than a translated kernel.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -131,6 +132,92 @@ def fused_bn_matmul_stats(x, scale, shift, w, stat_shift, *, relu: bool = True,
     mean = m1 + sf
     var = jnp.maximum(m2 - jnp.square(m1), 0.0)
     return z[0], mean, var
+
+
+def _pallas_ok(x, w) -> bool:
+    """Use the Pallas kernel only where it wins: TPU backend, bf16
+    activations, block-divisible shapes. Everywhere else (CPU mesh, f32
+    policy, ragged shapes) the reference XLA chain runs — same math."""
+    if os.environ.get("DL4J_TPU_DISABLE_PALLAS_CONVBN") == "1":
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:  # pragma: no cover
+        return False
+    m, k = x.shape
+    n = w.shape[1]
+    return (x.dtype == jnp.bfloat16 and m % 128 == 0 and k % 64 == 0
+            and n % 64 == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def fused_matmul_bn(x, a, b, w, stat_shift, prologue: bool, relu: bool):
+    """Differentiable fused [affine+relu] → matmul → shifted-BN-stats.
+
+    Forward runs the one-HBM-pass Pallas kernel on TPU (reference chain
+    elsewhere); backward is the hand-derived two-matmul VJP below — the
+    same passes XLA emits for the unfused chain, with no forward recompute.
+    ALL THREE outputs (z, mean, var) are differentiable: mean/var feed the
+    consumer's normalize affine, so their cotangents carry the batch-stats
+    term of standard BN training (reference BatchNormalization backprop).
+    ``stat_shift`` (the running mean) only stabilizes the one-pass moments
+    and is non-differentiable, exactly like ``_bn_core``.
+    """
+    z, mean, var = _fused_fwd_dispatch(x, a, b, w, stat_shift, prologue, relu)
+    return z, mean, var
+
+
+def _fused_fwd_dispatch(x, a, b, w, stat_shift, prologue, relu):
+    if _pallas_ok(x, w):
+        return fused_bn_matmul_stats(x, a, b, w, stat_shift, relu=relu,
+                                     fuse_prologue=prologue)
+    return reference_bn_matmul_stats(x, a, b, w, stat_shift, relu=relu,
+                                     fuse_prologue=prologue)
+
+
+def _fused_fwd(x, a, b, w, stat_shift, prologue, relu):
+    z, mean, var = _fused_fwd_dispatch(x, a, b, w, stat_shift, prologue, relu)
+    return (z, mean, var), (x, a, b, w, z, mean)
+
+
+def _fused_bwd(prologue, relu, res, cts):
+    x, a, b, w, z, mean = res
+    dz, dmean, dvar = cts
+    f32 = jnp.float32
+    m = x.shape[0]
+    zf = z.astype(f32)
+    # fold the stats cotangents into dz: ∂mean/∂z = 1/M,
+    # ∂var/∂z = 2(z − mean)/M per column
+    dz_eff = dz.astype(f32)
+    if dmean is not None:
+        dz_eff = dz_eff + dmean / m
+    if dvar is not None:
+        dz_eff = dz_eff + dvar * (2.0 / m) * (zf - mean)
+    if prologue:
+        u = x.astype(f32) * a.astype(f32) + b.astype(f32)
+        y = jnp.maximum(u, 0.0) if relu else u
+        yl = y.astype(x.dtype)
+    else:
+        yl = x
+    dzl = dz_eff.astype(x.dtype)
+    dw = jnp.dot(yl.T, dzl, preferred_element_type=f32).astype(w.dtype)
+    dy = jnp.dot(dzl, w.T, preferred_element_type=f32)
+    if prologue:
+        du = jnp.where(u > 0, dy, 0.0) if relu else dy
+        da = jnp.sum(du * x.astype(f32), axis=0).astype(a.dtype)
+        db = jnp.sum(du, axis=0).astype(b.dtype)
+        dx = (du * a.astype(f32)).astype(x.dtype)
+    else:
+        dx = dy.astype(x.dtype)
+        da = jnp.zeros_like(a)
+        db = jnp.zeros_like(b)
+    # stat_shift is the running mean — non-diff (running buffers are
+    # excluded from gradients, reference semantics)
+    return dx, da, db, dw, None
+
+
+fused_matmul_bn.defvjp(_fused_fwd, _fused_bwd)
 
 
 def reference_bn_matmul_stats(x, scale, shift, w, stat_shift, *,

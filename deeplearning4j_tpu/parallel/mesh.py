@@ -72,6 +72,17 @@ DEFAULT_TP_RULES: List[Tuple[str, P]] = [
 ]
 
 
+def moe_ep_rules(axis: str = "expert") -> List[Tuple[str, P]]:
+    """Expert-parallel PartitionSpec rules for nn.MoELayer params (leading
+    expert axis sharded over ``axis``); prepend to DEFAULT_TP_RULES or use
+    alone. GSPMD inserts the dispatch/combine all-to-alls."""
+    return [
+        (r".*/(We1|We2)$", P(axis, None, None)),
+        (r".*/(be1|be2)$", P(axis, None)),
+        (r".*/Weg$", P()),
+    ]
+
+
 def _spec_for(path: str, rules: Sequence[Tuple[str, P]]) -> P:
     for pat, spec in rules:
         if re.fullmatch(pat, path):
@@ -232,6 +243,33 @@ class ParallelWrapper:
                                   NamedSharding(self.mesh, P()))
         sh = NamedSharding(self.mesh, P("data", *([None] * (a.ndim - 1))))
         return jax.make_array_from_process_local_data(sh, a, gshape)
+
+    def lower_step_hlo(self, features, labels) -> str:
+        """Compile the sharded train step for one batch and return its HLO —
+        the collective-inspection hook (tests assert all-reduce/all-to-all;
+        users can eyeball what GSPMD inserted for their mesh/rules)."""
+        net = self.net
+        step_fn = net._jit_cache.get("train_step")
+        if step_fn is None:
+            step_fn = net._make_train_step()
+            net._jit_cache["train_step"] = step_fn
+        rules = self.tp_rules or [(r".*", P())]
+        with self.mesh:
+            params = shard_params(net.params, self.mesh, rules)
+            opt_state = shard_params(net.opt_state, self.mesh, rules)
+            net_state = jax.device_put(net.net_state,
+                                       NamedSharding(self.mesh, P()))
+            x = self._place(np.asarray(features))
+            y = self._place(np.asarray(labels))
+            args = (params, opt_state, net_state,
+                    jnp.asarray(0, jnp.int32), jax.random.key(0))
+            if self._is_graph:
+                in_name = net.conf.network_inputs[0]
+                out_name = net.conf.network_outputs[0]
+                args = args + ({in_name: x}, {out_name: y}, None, None)
+            else:
+                args = args + (x, y, None, None)
+            return step_fn.lower(*args).compile().as_text()
 
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
             checkpointer=None, checkpoint_every: int = 0) -> None:

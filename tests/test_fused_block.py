@@ -1,0 +1,148 @@
+"""FusedBottleneck (nn/fused_blocks.py) must equal the composed-layer
+bottleneck graph: forward (train & eval), gradients (one fit step), and
+running-stat updates. On the CPU mesh the fused layer runs the reference
+(non-Pallas) chain — the Pallas path itself is pinned against the same
+reference in test_perf_levers.py, so equality here covers both."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ElementWiseVertex, graph_builder
+
+from tests._helpers import _rng
+
+
+def _composed(c_in, filters, stride, project, h, w, updater, dtype="float32"):
+    s = (stride, stride)
+    b = (graph_builder().seed(3).updater(updater).weight_init("relu")
+         .dtype(dtype).add_inputs("input")
+         .set_input_types(input=nn.InputType.convolutional(h, w, c_in)))
+    b.add_layer("c1", nn.ConvolutionLayer(
+        n_out=filters, kernel=(1, 1), stride=s, convolution_mode="same",
+        activation="identity", has_bias=False), "input")
+    b.add_layer("bn1", nn.BatchNormalization(activation="relu"), "c1")
+    b.add_layer("c2", nn.ConvolutionLayer(
+        n_out=filters, kernel=(3, 3), convolution_mode="same",
+        activation="identity", has_bias=False), "bn1")
+    b.add_layer("bn2", nn.BatchNormalization(activation="relu"), "c2")
+    b.add_layer("c3", nn.ConvolutionLayer(
+        n_out=4 * filters, kernel=(1, 1), convolution_mode="same",
+        activation="identity", has_bias=False), "bn2")
+    b.add_layer("bn3", nn.BatchNormalization(activation="identity"), "c3")
+    if project:
+        b.add_layer("sc", nn.ConvolutionLayer(
+            n_out=4 * filters, kernel=(1, 1), stride=s, convolution_mode="same",
+            activation="identity", has_bias=False), "input")
+        b.add_layer("scbn", nn.BatchNormalization(activation="identity"), "sc")
+        shortcut = "scbn"
+    else:
+        shortcut = "input"
+    b.add_vertex("add", ElementWiseVertex(op="add"), "bn3", shortcut)
+    b.add_layer("out", nn.ActivationLayer(activation="relu"), "add")
+    b.add_layer("gap", nn.GlobalPoolingLayer(pooling_type="avg"), "out")
+    b.add_layer("fc", nn.OutputLayer(n_out=3, activation="softmax",
+                                     loss="mcxent"), "gap")
+    b.set_outputs("fc")
+    return ComputationGraph(b.build()).init()
+
+
+def _fused(c_in, filters, stride, project, h, w, updater, dtype="float32"):
+    b = (graph_builder().seed(3).updater(updater).weight_init("relu")
+         .dtype(dtype).add_inputs("input")
+         .set_input_types(input=nn.InputType.convolutional(h, w, c_in)))
+    b.add_layer("block", nn.FusedBottleneck(
+        filters=filters, stride=stride, project=project), "input")
+    b.add_layer("gap", nn.GlobalPoolingLayer(pooling_type="avg"), "block")
+    b.add_layer("fc", nn.OutputLayer(n_out=3, activation="softmax",
+                                     loss="mcxent"), "gap")
+    b.set_outputs("fc")
+    return ComputationGraph(b.build()).init()
+
+
+def _copy_weights(comp, fus, project):
+    """Map composed-layer params into the fused layer's param dict."""
+    p = {
+        "W1": comp.params["c1"]["W"], "g1": comp.params["bn1"]["gamma"],
+        "b1": comp.params["bn1"]["beta"],
+        "W2": comp.params["c2"]["W"], "g2": comp.params["bn2"]["gamma"],
+        "b2": comp.params["bn2"]["beta"],
+        "W3": comp.params["c3"]["W"], "g3": comp.params["bn3"]["gamma"],
+        "b3": comp.params["bn3"]["beta"],
+    }
+    if project:
+        p["Wsc"] = comp.params["sc"]["W"]
+        p["gsc"] = comp.params["scbn"]["gamma"]
+        p["bsc"] = comp.params["scbn"]["beta"]
+    fus.params = dict(fus.params)
+    fus.params["block"] = jax.tree.map(jnp.array, p)
+    fus.params["fc"] = jax.tree.map(jnp.array, comp.params["fc"])
+
+
+CASES = [
+    dict(c_in=8, filters=4, stride=1, project=True),
+    dict(c_in=16, filters=4, stride=1, project=False),
+    dict(c_in=8, filters=4, stride=2, project=True),
+]
+
+
+class TestFusedBottleneckEquality:
+    @pytest.mark.parametrize("case", CASES)
+    def test_train_forward_and_step(self, case):
+        h = w = 8
+        upd = nn.Sgd(learning_rate=0.05)
+        comp = _composed(h=h, w=w, updater=upd, **case)
+        fus = _fused(h=h, w=w, updater=upd, **case)
+        _copy_weights(comp, fus, case["project"])
+        r = _rng(0)
+        x = r.randn(4, h, w, case["c_in"]).astype(np.float32)
+        y = np.eye(3)[r.randint(0, 3, 4)].astype(np.float32)
+
+        oc = comp.output(x)
+        of = fus.output(x)
+        np.testing.assert_allclose(of, oc, atol=2e-5)
+
+        comp.fit(x, y)
+        fus.fit(x, y)
+        # post-step weights equal ⇒ gradients equal (incl. the BN stats term)
+        for fk, (ln, pk) in {"W1": ("c1", "W"), "g1": ("bn1", "gamma"),
+                             "b1": ("bn1", "beta"), "W2": ("c2", "W"),
+                             "g3": ("bn3", "gamma"), "W3": ("c3", "W")}.items():
+            np.testing.assert_allclose(
+                np.asarray(fus.params["block"][fk]),
+                np.asarray(comp.params[ln][pk]), atol=5e-5,
+                err_msg=f"param {fk} diverged after one step")
+        # running stats updated identically
+        np.testing.assert_allclose(
+            np.asarray(fus.net_state["block"]["m1"]),
+            np.asarray(comp.net_state["bn1"]["mean"]), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(fus.net_state["block"]["v2"]),
+            np.asarray(comp.net_state["bn2"]["var"]), atol=1e-5)
+
+        # eval-mode forward (uses running stats) must also agree
+        oc2 = comp.output(x)
+        of2 = fus.output(x)
+        np.testing.assert_allclose(of2, oc2, atol=2e-5)
+
+    def test_resnet50_fused_builds_and_runs(self):
+        from deeplearning4j_tpu import models
+        net = models.ResNet50(num_classes=5, input_shape=(32, 32, 3),
+                              updater=nn.Sgd(learning_rate=0.01),
+                              dtype="mixed", fused_blocks=True).init()
+        assert any(isinstance(l.lc, nn.FusedBottleneck)
+                   for l in net.layers.values())
+        r = _rng(1)
+        x = r.randn(2, 32, 32, 3).astype(np.float32)
+        y = np.eye(5)[r.randint(0, 5, 2)].astype(np.float32)
+        losses = net.fit_scanned(jnp.asarray(x), jnp.asarray(y), steps=3)
+        assert np.all(np.isfinite(np.asarray(losses)))
+
+    def test_json_roundtrip(self):
+        from deeplearning4j_tpu.nn import conf as C
+        lc = nn.FusedBottleneck(n_in=8, filters=4, stride=2, project=True)
+        back = C.LayerConf.from_dict(lc.to_dict())
+        assert back == lc

@@ -1,0 +1,319 @@
+"""ONNX control-flow import: Loop / If / Scan → lax.while_loop / cond / scan
+(round-5 verdict item 3). Each graph is hand-assembled with the same
+protowire helpers the parser tests use, imported, and checked against a
+hand-built numpy oracle. Subgraph outer-scope captures are exercised in
+every case (ONNX subgraphs capture by name, unlike TF function bodies).
+
+Reference: onnx/defs/controlflow op definitions as imported by the
+reference's samediff-import-onnx registry (SURVEY §3.2)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.imports import protowire as pw
+from deeplearning4j_tpu.imports.onnx_import import import_onnx
+
+from tests.test_onnx_import import (attr_proto, build_model, node_proto,
+                                    tensor_proto, value_info)
+
+
+def graph_proto(nodes, inputs, outputs, initializers=None, name="sub"):
+    g = b"".join(pw.field_bytes(1, n) for n in nodes)
+    g += pw.field_string(2, name)
+    for n, a in (initializers or {}).items():
+        g += pw.field_bytes(5, tensor_proto(n, a))
+    g += b"".join(pw.field_bytes(11, value_info(n, s)) for n, s in inputs)
+    g += b"".join(pw.field_bytes(12, value_info(n, s)) for n, s in outputs)
+    return g
+
+
+def graph_attr(name, graph_bytes):
+    return pw.field_string(1, name) + pw.field_bytes(6, graph_bytes) \
+        + pw.field_varint(20, 5)
+
+
+def node_with_graph_attrs(op_type, inputs, outputs, graph_attrs,
+                          name="", **attrs):
+    out = b"".join(pw.field_string(1, i) for i in inputs)
+    out += b"".join(pw.field_string(2, o) for o in outputs)
+    out += pw.field_string(3, name or outputs[0] + "_node")
+    out += pw.field_string(4, op_type)
+    out += b"".join(pw.field_bytes(5, attr_proto(k, v))
+                    for k, v in attrs.items())
+    out += b"".join(pw.field_bytes(5, graph_attr(k, g))
+                    for k, g in graph_attrs.items())
+    return out
+
+
+class TestOnnxLoop:
+    def test_for_loop_with_capture(self):
+        # x_{i+1} = x_i + step   (step captured from the outer scope)
+        body = graph_proto(
+            nodes=[
+                node_proto("Identity", ["cond_in"], ["cond_out"]),
+                node_proto("Add", ["x_in", "step"], ["x_out"]),
+            ],
+            inputs=[("iter", ()), ("cond_in", ()), ("x_in", (2,))],
+            outputs=[("cond_out", ()), ("x_out", (2,))])
+        nodes = [
+            node_proto("Add", ["s0", "s0"], ["step"]),  # outer tensor
+            node_with_graph_attrs("Loop", ["M", "", "x0"], ["x_final"],
+                                  {"body": body}),
+        ]
+        model = build_model(
+            nodes, [("x0", (2,))], [("x_final", (2,))],
+            {"M": np.asarray(5, np.int64), "s0": np.asarray([0.5, 1.0],
+                                                            np.float32)})
+        sd = import_onnx(model)
+        x0 = np.asarray([1.0, 2.0], np.float32)
+        out = sd.output({"x0": x0}, "x_final")["x_final"]
+        np.testing.assert_allclose(out, x0 + 5 * np.asarray([1.0, 2.0]),
+                                   atol=1e-6)
+
+    def test_while_loop_runtime_cond(self):
+        # run until x[0] >= 10 (cond computed in the body)
+        body = graph_proto(
+            nodes=[
+                node_proto("Add", ["x_in", "one"], ["x_out"]),
+                node_proto("Less", ["x_out", "ten"], ["cond_out"]),
+            ],
+            inputs=[("iter", ()), ("cond_in", ()), ("x_in", ())],
+            outputs=[("cond_out", ()), ("x_out", ())],
+            initializers={"one": np.asarray(1.0, np.float32),
+                          "ten": np.asarray(10.0, np.float32)})
+        nodes = [
+            node_proto("Less", ["x0", "c10"], ["cond0"]),
+            node_with_graph_attrs("Loop", ["", "cond0", "x0"], ["x_final"],
+                                  {"body": body}),
+        ]
+        model = build_model(nodes, [("x0", ())], [("x_final", ())],
+                            {"c10": np.asarray(10.0, np.float32)})
+        sd = import_onnx(model)
+        out = sd.output({"x0": np.asarray(3.0, np.float32)},
+                        "x_final")["x_final"]
+        assert float(out) == 10.0
+
+    def test_loop_scan_outputs_static_m(self):
+        # accumulate x_i and also emit each intermediate (scan output)
+        body = graph_proto(
+            nodes=[
+                node_proto("Identity", ["cond_in"], ["cond_out"]),
+                node_proto("Add", ["x_in", "one"], ["x_out"]),
+                node_proto("Identity", ["x_out"], ["emit"]),
+            ],
+            inputs=[("iter", ()), ("cond_in", ()), ("x_in", (3,))],
+            outputs=[("cond_out", ()), ("x_out", (3,)), ("emit", (3,))],
+            initializers={"one": np.asarray([1.0, 1.0, 1.0], np.float32)})
+        nodes = [node_with_graph_attrs("Loop", ["M", "", "x0"],
+                                       ["x_final", "trace"], {"body": body})]
+        model = build_model(nodes, [("x0", (3,))],
+                            [("x_final", (3,)), ("trace", (4, 3))],
+                            {"M": np.asarray(4, np.int64)})
+        sd = import_onnx(model)
+        x0 = np.zeros(3, np.float32)
+        res = sd.output({"x0": x0}, ["x_final", "trace"])
+        np.testing.assert_allclose(res["x_final"], x0 + 4)
+        want = np.stack([x0 + i for i in range(1, 5)])
+        np.testing.assert_allclose(res["trace"], want)
+
+
+class TestOnnxIf:
+    def _model(self):
+        then_g = graph_proto(
+            nodes=[node_proto("Add", ["a", "b"], ["z_then"])],
+            inputs=[], outputs=[("z_then", (2,))], name="then")
+        else_g = graph_proto(
+            nodes=[node_proto("Sub", ["a", "b"], ["z_else"])],
+            inputs=[], outputs=[("z_else", (2,))], name="else")
+        nodes = [
+            node_proto("Add", ["x", "x"], ["a"]),
+            node_proto("Mul", ["x", "x"], ["b"]),
+            node_proto("ReduceSum", ["x"], ["s"], keepdims=0),
+            node_proto("Greater", ["s", "zero"], ["pred"]),
+            node_with_graph_attrs("If", ["pred"], ["y"],
+                                  {"then_branch": then_g,
+                                   "else_branch": else_g}),
+        ]
+        return build_model(nodes, [("x", (2,))], [("y", (2,))],
+                           {"zero": np.asarray(0.0, np.float32)})
+
+    def test_then_branch(self):
+        sd = import_onnx(self._model())
+        x = np.asarray([1.0, 2.0], np.float32)
+        out = sd.output({"x": x}, "y")["y"]
+        np.testing.assert_allclose(out, 2 * x + x * x, atol=1e-6)
+
+    def test_else_branch(self):
+        sd = import_onnx(self._model())
+        x = np.asarray([-1.0, -2.0], np.float32)
+        out = sd.output({"x": x}, "y")["y"]
+        np.testing.assert_allclose(out, 2 * x - x * x, atol=1e-6)
+
+
+class TestOnnxScan:
+    def test_cumsum_scan(self):
+        body = graph_proto(
+            nodes=[node_proto("Add", ["s_in", "x_el"], ["s_out"]),
+                   node_proto("Identity", ["s_out"], ["y_el"])],
+            inputs=[("s_in", (2,)), ("x_el", (2,))],
+            outputs=[("s_out", (2,)), ("y_el", (2,))])
+        nodes = [node_with_graph_attrs(
+            "Scan", ["s0", "xs"], ["s_final", "ys"], {"body": body},
+            num_scan_inputs=1)]
+        model = build_model(nodes, [("s0", (2,)), ("xs", (5, 2))],
+                            [("s_final", (2,)), ("ys", (5, 2))], {})
+        sd = import_onnx(model)
+        r = np.random.RandomState(0)
+        xs = r.randn(5, 2).astype(np.float32)
+        s0 = np.zeros(2, np.float32)
+        res = sd.output({"s0": s0, "xs": xs}, ["s_final", "ys"])
+        np.testing.assert_allclose(res["s_final"], xs.sum(0), atol=1e-5)
+        np.testing.assert_allclose(res["ys"], np.cumsum(xs, 0), atol=1e-5)
+
+    def test_reverse_direction(self):
+        body = graph_proto(
+            nodes=[node_proto("Add", ["s_in", "x_el"], ["s_out"]),
+                   node_proto("Identity", ["s_out"], ["y_el"])],
+            inputs=[("s_in", (2,)), ("x_el", (2,))],
+            outputs=[("s_out", (2,)), ("y_el", (2,))])
+        nodes = [node_with_graph_attrs(
+            "Scan", ["s0", "xs"], ["s_final", "ys"], {"body": body},
+            num_scan_inputs=1, scan_input_directions=[1],
+            scan_output_directions=[1])]
+        model = build_model(nodes, [("s0", (2,)), ("xs", (4, 2))],
+                            [("s_final", (2,)), ("ys", (4, 2))], {})
+        sd = import_onnx(model)
+        xs = np.arange(8, dtype=np.float32).reshape(4, 2)
+        s0 = np.zeros(2, np.float32)
+        res = sd.output({"s0": s0, "xs": xs}, ["s_final", "ys"])
+        np.testing.assert_allclose(res["s_final"], xs.sum(0))
+        # reverse in, reverse out: ys[i] = suffix sum from the end up to i
+        want = np.cumsum(xs[::-1], 0)[::-1]
+        np.testing.assert_allclose(res["ys"], want)
+
+
+class TestOnnxIfDifferingCaptures:
+    def test_branches_capture_different_outer_tensors(self):
+        # then reads outer `a` only, else reads outer `b` only — the
+        # capture-union binding must route each branch the right tensor
+        then_g = graph_proto(nodes=[node_proto("Identity", ["a"], ["z_t"])],
+                             inputs=[], outputs=[("z_t", (2,))], name="t")
+        else_g = graph_proto(nodes=[node_proto("Identity", ["b"], ["z_e"])],
+                             inputs=[], outputs=[("z_e", (2,))], name="e")
+        nodes = [
+            node_proto("Add", ["x", "x"], ["a"]),
+            node_proto("Mul", ["x", "x"], ["b"]),
+            node_proto("ReduceSum", ["x"], ["s"], keepdims=0),
+            node_proto("Greater", ["s", "zero"], ["pred"]),
+            node_with_graph_attrs("If", ["pred"], ["y"],
+                                  {"then_branch": then_g,
+                                   "else_branch": else_g}),
+        ]
+        model = build_model(nodes, [("x", (2,))], [("y", (2,))],
+                            {"zero": np.asarray(0.0, np.float32)})
+        sd = import_onnx(model)
+        xp = np.asarray([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(sd.output({"x": xp}, "y")["y"], 2 * xp)
+        xn = np.asarray([-1.0, -2.0], np.float32)
+        np.testing.assert_allclose(sd.output({"x": xn}, "y")["y"], xn * xn)
+
+
+class TestOnnxBreadthRound5:
+    def test_scatter_nd(self):
+        nodes = [node_proto("ScatterND", ["data", "idx", "upd"], ["y"])]
+        model = build_model(nodes, [("data", (4, 2))], [("y", (4, 2))],
+                            {"idx": np.asarray([[0], [2]], np.int64),
+                             "upd": np.asarray([[9., 9.], [7., 7.]],
+                                               np.float32)})
+        sd = import_onnx(model)
+        d = np.zeros((4, 2), np.float32)
+        out = sd.output({"data": d}, "y")["y"]
+        want = d.copy(); want[0] = 9; want[2] = 7
+        np.testing.assert_allclose(out, want)
+
+    def test_gather_elements(self):
+        nodes = [node_proto("GatherElements", ["x", "i"], ["y"], axis=1)]
+        model = build_model(nodes, [("x", (2, 3))], [("y", (2, 2))],
+                            {"i": np.asarray([[0, 2], [1, 0]], np.int64)})
+        sd = import_onnx(model)
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = sd.output({"x": x}, "y")["y"]
+        np.testing.assert_allclose(out, np.take_along_axis(
+            x, np.asarray([[0, 2], [1, 0]]), axis=1))
+
+    def test_nms_padded_indices(self):
+        boxes = np.asarray([[[0, 0, 1, 1], [0, 0, 1.05, 1.05],
+                             [2, 2, 3, 3]]], np.float32)
+        scores = np.asarray([[[0.9, 0.8, 0.7]]], np.float32)
+        nodes = [node_proto("NonMaxSuppression",
+                            ["boxes", "scores", "mo", "iou", "st"], ["sel"])]
+        model = build_model(
+            nodes, [("boxes", boxes.shape), ("scores", scores.shape)],
+            [("sel", (2, 3))],
+            {"mo": np.asarray(2, np.int64),
+             "iou": np.asarray(0.5, np.float32),
+             "st": np.asarray(0.0, np.float32)})
+        sd = import_onnx(model)
+        out = np.asarray(sd.output({"boxes": boxes, "scores": scores},
+                                   "sel")["sel"])
+        # box 1 suppressed by IoU with box 0; boxes 0 and 2 selected
+        assert out[0].tolist() == [0, 0, 0]
+        assert out[1].tolist() == [0, 0, 2]
+
+    def test_roi_align_avg(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.asarray([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        nodes = [node_proto("RoiAlign", ["x", "rois", "bi"], ["y"],
+                            output_height=2, output_width=2,
+                            sampling_ratio=2, spatial_scale=1.0,
+                            coordinate_transformation_mode="output_half_pixel")]
+        model = build_model(nodes, [("x", x.shape), ("rois", rois.shape)],
+                            [("y", (1, 1, 2, 2))],
+                            {"bi": np.asarray([0], np.int64)})
+        sd = import_onnx(model)
+        out = np.asarray(sd.output({"x": x, "rois": rois}, "y")["y"])
+        assert out.shape == (1, 1, 2, 2)
+        # average pooling over an aligned roi of a linear ramp: monotone
+        assert out[0, 0, 0, 0] < out[0, 0, 1, 1]
+
+    def test_bitshift_left(self):
+        nodes = [node_proto("BitShift", ["x", "s"], ["y"], direction="LEFT")]
+        model = build_model(nodes, [("x", (3,))], [("y", (3,))],
+                            {"s": np.asarray([1, 2, 3], np.int32)})
+        sd = import_onnx(model)
+        x = np.asarray([1, 1, 1], np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(sd.output({"x": x}, "y")["y"]), [2, 4, 8])
+
+    def test_quantize_uint8_roundtrip(self):
+        nodes = [node_proto("QuantizeLinear", ["x", "sc", "zp"], ["q"]),
+                 node_proto("DequantizeLinear", ["q", "sc", "zp"], ["y"])]
+        model = build_model(nodes, [("x", (4,))], [("y", (4,))],
+                            {"sc": np.asarray(0.1, np.float32),
+                             "zp": np.asarray(128, np.uint8)})
+        sd = import_onnx(model)
+        x = np.asarray([-1.0, 0.0, 0.54, 5.0], np.float32)
+        out = np.asarray(sd.output({"x": x}, "y")["y"])
+        # 0.54/0.1 -> round-half-even(5.4) = 5 -> 0.5 (ONNX round semantics)
+        np.testing.assert_allclose(out, [-1.0, 0.0, 0.5, 5.0], atol=0.01)
+
+    def test_constant_of_shape_and_range(self):
+        nodes = [node_proto("ConstantOfShape", ["shp"], ["z"]),
+                 node_proto("Range", ["st", "li", "de"], ["r"]),
+                 node_proto("Add", ["z", "r"], ["y"])]
+        model = build_model(nodes, [], [("y", (4,))],
+                            {"shp": np.asarray([4], np.int64),
+                             "st": np.asarray(0.0, np.float32),
+                             "li": np.asarray(4.0, np.float32),
+                             "de": np.asarray(1.0, np.float32)})
+        sd = import_onnx(model)
+        np.testing.assert_allclose(np.asarray(sd.output({}, "y")["y"]),
+                                   [0, 1, 2, 3])
+
+    def test_documented_reject_message(self):
+        nodes = [node_proto("NonZero", ["x"], ["y"])]
+        model = build_model(nodes, [("x", (3,))], [("y", (1, 3))], {})
+        with pytest.raises(NotImplementedError, match="dynamic-length"):
+            import_onnx(model)
+
+
+import pytest  # noqa: E402  (used by the reject test)

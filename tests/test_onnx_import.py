@@ -23,7 +23,8 @@ from deeplearning4j_tpu.imports.onnx_import import (
 # ---------------------------------------------------------------------------
 
 _NP_DT = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
-          np.dtype(np.int32): 6, np.dtype(np.float64): 11}
+          np.dtype(np.int32): 6, np.dtype(np.float64): 11,
+          np.dtype(np.uint8): 2, np.dtype(np.int8): 3}
 
 
 def tensor_proto(name, arr):
