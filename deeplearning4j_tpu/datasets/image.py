@@ -136,6 +136,17 @@ class ImageRecordReader(DataSetIterator):
                     self.files.append((os.path.join(d, f), li))
         self._epoch = 0
 
+    def shard_files(self, process_id: int = None, num_processes: int = None
+                    ) -> "ImageRecordReader":
+        """FILE-level per-host sharding (parallel.launch.host_shard wiring):
+        this host keeps files[pid::N] and iterates ONLY those — per-host ETL
+        is O(global/N), unlike batch round-robin which decodes everything on
+        every host (SURVEY §6.8 per-host shard assignment)."""
+        from deeplearning4j_tpu.parallel.launch import host_shard
+
+        self.files = host_shard(self.files, process_id, num_processes)
+        return self
+
     @property
     def batch_size(self):
         return self._bs

@@ -219,3 +219,145 @@ class ParallelTransformExecutor:
                 results = pool.map(_run_chunk_spawn,
                                    [(tp, c) for c in chunks])
         return [r for res in results for r in res]
+
+
+class ExcelRecordReader:
+    """datavec-excel ExcelRecordReader analog: .xlsx parsing with the
+    stdlib only (an xlsx IS a zip of XML — no poi/openpyxl dependency).
+    Reads the first worksheet (or ``sheet_index``) into rows of typed cells
+    (numbers become float, shared/inline strings str, booleans bool)."""
+
+    _NS = "{http://schemas.openxmlformats.org/spreadsheetml/2006/main}"
+
+    def __init__(self, sheet_index: int = 0, skip_rows: int = 0):
+        self.sheet_index = sheet_index
+        self.skip_rows = skip_rows
+
+    def read(self, path: str) -> List[List[Any]]:
+        import xml.etree.ElementTree as ET
+        import zipfile
+
+        ns = self._NS
+        with zipfile.ZipFile(path) as z:
+            shared: List[str] = []
+            if "xl/sharedStrings.xml" in z.namelist():
+                root = ET.fromstring(z.read("xl/sharedStrings.xml"))
+                for si in root.findall(f"{ns}si"):
+                    shared.append("".join(t.text or ""
+                                          for t in si.iter(f"{ns}t")))
+            import re as _re
+
+            def _sheet_no(nm):
+                m = _re.search(r"sheet(\d+)\.xml$", nm)
+                return int(m.group(1)) if m else 0
+
+            # numeric sort: lexicographic puts sheet10 before sheet2
+            sheets = sorted((n for n in z.namelist()
+                             if n.startswith("xl/worksheets/sheet")
+                             and n.endswith(".xml")), key=_sheet_no)
+            if self.sheet_index >= len(sheets):
+                raise ValueError(
+                    f"xlsx has {len(sheets)} sheets; index "
+                    f"{self.sheet_index} out of range")
+            root = ET.fromstring(z.read(sheets[self.sheet_index]))
+        def _col_index(ref) -> Optional[int]:
+            # "BC12" -> column 54 (0-based); writers omit EMPTY cells, so
+            # alignment must come from the cell reference, not cell order
+            if not ref:
+                return None
+            col = 0
+            for ch in ref:
+                if ch.isalpha():
+                    col = col * 26 + (ord(ch.upper()) - ord("A") + 1)
+                else:
+                    break
+            return col - 1 if col else None
+
+        rows: List[List[Any]] = []
+        for row in root.iter(f"{ns}row"):
+            out: List[Any] = []
+            for c in row.findall(f"{ns}c"):
+                t = c.get("t", "n")
+                v = c.find(f"{ns}v")
+                if t == "inlineStr":
+                    is_el = c.find(f"{ns}is")
+                    val = ("".join(tt.text or ""
+                                   for tt in is_el.iter(f"{ns}t"))
+                           if is_el is not None else "")
+                elif v is None:
+                    val = None
+                elif t == "s":
+                    val = shared[int(v.text)]
+                elif t == "b":
+                    val = v.text == "1"
+                else:
+                    val = float(v.text)
+                idx = _col_index(c.get("r"))
+                if idx is None:
+                    out.append(val)
+                else:
+                    while len(out) < idx:
+                        out.append(None)  # omitted empty cells
+                    if len(out) == idx:
+                        out.append(val)
+                    else:
+                        out[idx] = val
+            rows.append(out)
+        return rows[self.skip_rows:]
+
+
+class SQLRecordReader:
+    """datavec-jdbc JDBCRecordReader analog over any DB-API 2.0 connection
+    (sqlite3 in the stdlib plays the role of the JDBC driver): run a query,
+    stream rows as records; ``schema()`` derives a datavec Schema from the
+    cursor description + first row's types."""
+
+    def __init__(self, connection, query: str):
+        self.conn = connection
+        self.query = query
+        self._cache: Optional[List[List[Any]]] = None
+
+    def read(self) -> List[List[Any]]:
+        if self._cache is not None:
+            return self._cache
+        cur = self.conn.cursor()
+        try:
+            cur.execute(self.query)
+            self._description = cur.description
+            self._cache = [list(r) for r in cur.fetchall()]
+            return self._cache
+        finally:
+            cur.close()
+
+    def schema(self):
+        from deeplearning4j_tpu.datavec.transform import Schema
+
+        rows = self.read()
+        b = Schema.Builder()
+        names = [d[0] for d in (self._description or [])]
+        first = rows[0] if rows else []
+        for i, name in enumerate(names):
+            v = first[i] if i < len(first) else None
+            if isinstance(v, bool):
+                b.add_column_categorical(name, "false", "true")
+            elif isinstance(v, int):
+                b.add_column_long(name)
+            elif isinstance(v, float):
+                b.add_column_double(name)
+            else:
+                b.add_column_string(name)
+        return b.build()
+
+
+def haversine_km(lat1, lon1, lat2, lon2) -> float:
+    """Great-circle distance (datavec-geo CoordinatesDistanceTransform
+    math)."""
+    import math
+
+    r = 6371.0088
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = math.radians(lat2 - lat1)
+    dl = math.radians(lon2 - lon1)
+    a = (math.sin(dp / 2) ** 2
+         + math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2)
+    return 2 * r * math.asin(math.sqrt(a))

@@ -184,16 +184,20 @@ def _assemble_sequential(specs, input_type) -> nn.MultiLayerNetwork:
             raise NotImplementedError(
                 f"Keras layer '{cls}' has no import mapper; register one on "
                 f"KerasLayerMapper")
-        lc, p = mapper(cfg, weights)
-        if lc == "FLATTEN":
-            continue  # shape inference inserts CnnToFeedForward automatically
-        state = {}
-        if isinstance(p, dict) and "__params__" in p:
-            state = p["__state__"]
-            p = p["__params__"]
-        layer_confs.append(lc)
-        params_list.append(p)
-        states_list.append(state)
+        out = mapper(cfg, weights)
+        # a mapper may expand ONE keras layer into several of ours
+        # (RNN(cell=StackedRNNCells) → one recurrent layer per cell)
+        items = out if isinstance(out, list) else [out]
+        for lc, p in items:
+            if lc == "FLATTEN":
+                continue  # shape inference inserts CnnToFeedForward automatically
+            state = {}
+            if isinstance(p, dict) and "__params__" in p:
+                state = p["__state__"]
+                p = p["__params__"]
+            layer_confs.append(lc)
+            params_list.append(p)
+            states_list.append(state)
     b = nn.builder().list()
     for lc in layer_confs:
         b.layer(lc)
@@ -1319,3 +1323,91 @@ def _center_crop(cfg, weights):
     return C.CenterCropLayer(height=int(cfg["height"]),
                              width=int(cfg["width"]),
                              name=cfg.get("name")), {}
+
+
+# ---------------------------------------------------------------------------
+# Legacy recurrent forms (round 5, verdict item 9): CuDNNLSTM/CuDNNGRU (the
+# tf.keras v1 CuDNN-backed layers common in older h5 files) and the generic
+# RNN(cell=...) / StackedRNNCells wrappers. Reference: keras-import's
+# KerasLstm/KerasSimpleRnn layer table (SURVEY §3.3).
+# ---------------------------------------------------------------------------
+
+
+@KerasLayerMapper.register("CuDNNLSTM")
+def _cudnn_lstm(cfg, weights):
+    """CuDNNLSTM ≡ LSTM(activation=tanh, recurrent_activation=sigmoid,
+    unit_forget_bias) with a CuDNN weight layout: bias is the (8H,) stack of
+    input+recurrent biases (or (2,4H)) — they sum into the standard (4H,)."""
+    w = list(weights)
+    if len(w) > 2:
+        b = np.asarray(w[2])
+        if b.ndim == 2:                      # (2, 4H)
+            b = b[0] + b[1]
+        elif b.size % 8 == 0 and b.ndim == 1:  # (8H,)
+            half = b.size // 2
+            b = b[:half] + b[half:]
+        w[2] = b
+    cfg = dict(cfg)
+    cfg.setdefault("activation", "tanh")
+    cfg.setdefault("recurrent_activation", "sigmoid")
+    return KerasLayerMapper.MAPPERS["LSTM"](cfg, w)
+
+
+@KerasLayerMapper.register("CuDNNGRU")
+def _cudnn_gru(cfg, weights):
+    """CuDNNGRU ≡ GRU(reset_after=True, tanh/sigmoid). Bias arrives as
+    (6H,) or (2, 3H); the GRU mapper wants the (2, 3H) split form."""
+    w = list(weights)
+    if len(w) > 2:
+        b = np.asarray(w[2])
+        if b.ndim == 1:
+            b = b.reshape(2, -1)
+        w[2] = b
+    cfg = dict(cfg)
+    cfg.setdefault("activation", "tanh")
+    cfg.setdefault("recurrent_activation", "sigmoid")
+    cfg["reset_after"] = True
+    return KerasLayerMapper.MAPPERS["GRU"](cfg, w)
+
+
+_RNN_CELL_TO_LAYER = {"LSTMCell": "LSTM", "GRUCell": "GRU",
+                      "SimpleRNNCell": "SimpleRNN"}
+
+
+def _cell_spec(cell):
+    cls = cell.get("class_name")
+    layer = _RNN_CELL_TO_LAYER.get(cls)
+    if layer is None:
+        raise NotImplementedError(
+            f"RNN(cell={cls}) import: no mapper for this cell type")
+    return layer, dict(cell.get("config", {}))
+
+
+@KerasLayerMapper.register("RNN")
+def _rnn_wrapper(cfg, weights):
+    """keras.layers.RNN(cell=...) — delegate to the cell's layer mapper
+    with the wrapper's sequence semantics (return_sequences/go_backwards).
+    StackedRNNCells expands to one layer per cell (weights are concatenated
+    in cell order, 3 arrays per cell when biased)."""
+    cell = cfg.get("cell") or {}
+    if cell.get("class_name") == "StackedRNNCells":
+        cells = cell.get("config", {}).get("cells", [])
+        out = []
+        off = 0
+        for ci, c in enumerate(cells):
+            layer, ccfg = _cell_spec(c)
+            n_w = 3 if ccfg.get("use_bias", True) else 2
+            ccfg["name"] = f"{cfg.get('name', 'rnn')}_cell{ci}"
+            # every stacked cell but the LAST returns the full sequence
+            ccfg["return_sequences"] = (True if ci < len(cells) - 1
+                                        else cfg.get("return_sequences", False))
+            ccfg["go_backwards"] = cfg.get("go_backwards", False)
+            out.append(KerasLayerMapper.MAPPERS[layer](
+                ccfg, list(weights[off:off + n_w])))
+            off += n_w
+        return out  # list of (conf, params) — sequential assembly expands
+    layer, ccfg = _cell_spec(cell)
+    ccfg["name"] = cfg.get("name")
+    ccfg["return_sequences"] = cfg.get("return_sequences", False)
+    ccfg["go_backwards"] = cfg.get("go_backwards", False)
+    return KerasLayerMapper.MAPPERS[layer](ccfg, weights)

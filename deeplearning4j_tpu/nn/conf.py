@@ -367,6 +367,22 @@ class BatchNormalization(LayerConf):
 
 
 @dataclasses.dataclass(frozen=True)
+class DuelingQLayer(LayerConf):
+    """Dueling-DQN head (reference RL4J QLearning dueling configuration):
+    value stream V(s) (scalar) + advantage stream A(s,·), combined with the
+    standard identifiable aggregation Q = V + A − mean(A)."""
+
+    n_in: int = 0
+    n_actions: int = 0
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_actions)
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
 class MoELayer(LayerConf):
     """Mixture-of-Experts FFN layer (GShard/Switch recipe) as a standard
     LayerConf — usable in MultiLayerNetwork/ComputationGraph and composing
@@ -1460,6 +1476,7 @@ class CenterCropLayer(LayerConf):
 LAYER_TYPES = {
     c.__name__: c
     for c in [
+        DuelingQLayer,
         MoELayer,
         FusedBottleneck,
         ResizeLayer,

@@ -323,6 +323,27 @@ class GlobalPoolingLayerImpl(Layer):
         return getattr(self.lc, "pnorm", 2)
 
 
+class DuelingQLayerImpl(Layer):
+    """conf.DuelingQLayer runtime: Q = V + A − mean(A) (Wang et al.
+    aggregation, the RL4J dueling head)."""
+
+    def init(self, key):
+        lc = self.lc
+        k1, k2 = jax.random.split(key)
+        return {"Wv": init_weights(k1, (lc.n_in, 1), self.winit, dtype=self.dtype),
+                "bv": jnp.zeros((1,), self.dtype),
+                "Wa": init_weights(k2, (lc.n_in, lc.n_actions), self.winit,
+                                   dtype=self.dtype),
+                "ba": jnp.zeros((lc.n_actions,), self.dtype)}
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        v = x @ params["Wv"] + params["bv"]
+        a = x @ params["Wa"] + params["ba"]
+        q = v + a - jnp.mean(a, axis=-1, keepdims=True)
+        return self.activation(q), state, mask
+
+
 class BatchNormalizationImpl(Layer):
     """layers/normalization/BatchNormalization.java.
 
@@ -1678,6 +1699,7 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.Upsampling2D: Upsampling2DImpl,
     C.GlobalPoolingLayer: GlobalPoolingLayerImpl,
     C.BatchNormalization: BatchNormalizationImpl,
+    C.DuelingQLayer: DuelingQLayerImpl,
     C.LocalResponseNormalization: LocalResponseNormalizationImpl,
     C.ActivationLayer: ActivationLayerImpl,
     C.DropoutLayer: DropoutLayerImpl,

@@ -593,10 +593,12 @@ def register_platform_attention() -> None:
 
     def usable(q, k, v, mask=None, **kw):
         # Measured crossover (BENCH_HISTORY.json 'attention_sweep', v5e,
-        # bf16 fwd+bwd): below T=2048 the XLA/generic materialized path is
-        # ~1.6x FASTER than the Pallas kernel (grid overhead dominates);
-        # at and above 2048 Pallas wins 1.25x-28x. Defer below the
-        # crossover — the PlatformHelper::isUsable contract (SURVEY §3.1).
+        # bf16 fwd+bwd, round-5 DCE-proof harness w/ variance): below
+        # T=2048 the materialized paths are 1.1-1.6x FASTER than the
+        # Pallas kernel (grid overhead dominates); at 2048 it's par
+        # (+-15%); above, Pallas wins 1.5-3.6x vs XLA fused (the 19-25x
+        # rows at T=8192 are an XLA shape pathology, not the typical win).
+        # Defer below the crossover — PlatformHelper::isUsable (SURVEY §3.1).
         # EXCEPT with attention-prob dropout: the generic path materializes
         # a (T, T) bernoulli mask in HBM while flash regenerates it
         # in-kernel, which flips the crossover (BERT-base seq 512 w/

@@ -214,3 +214,121 @@ class A3CDiscrete:
 
     def train(self, batches: int = 100) -> List[float]:
         return [self.train_batch(i) for i in range(batches)]
+
+
+class AsyncNStepQLearningDiscrete:
+    """AsyncNStepQLearningDiscrete analog (RL4J async/nstep/discrete):
+    n_envs parallel MDPs, eps-greedy behavior from the online Q-net, n-step
+    bootstrapped targets from a periodically-synced target net, one batched
+    MSE update per rollout (the worker-thread gradient exchange of the
+    reference collapses into one SPMD step, like A3C above)."""
+
+    def __init__(self, mdp_factory: Callable[[], MDP],
+                 q_net: MultiLayerNetwork, n_envs: int = 8,
+                 n_steps: int = 5, gamma: float = 0.99,
+                 target_update_freq: int = 40,
+                 eps_start: float = 1.0, eps_min: float = 0.1,
+                 eps_anneal_batches: int = 200, seed: int = 0):
+        self.envs = [mdp_factory() for _ in range(n_envs)]
+        self.net = q_net
+        self.n_envs = n_envs
+        self.n_steps = n_steps
+        self.gamma = gamma
+        self.target_update_freq = target_update_freq
+        self.eps_start, self.eps_min = eps_start, eps_min
+        self.eps_anneal = eps_anneal_batches
+        self.rng = np.random.RandomState(seed)
+        self._obs = [e.reset() for e in self.envs]
+        self._ep_rewards = np.zeros(n_envs)
+        self.episode_rewards: List[float] = []
+        self.target_params = jax.tree.map(jnp.asarray, q_net.params)
+        self._fwd = jax.jit(
+            lambda p, s: q_net._forward(p, q_net.net_state, s, None,
+                                        train=False, rng=None)[0])
+        self._step = self._make_step()
+        self._batches = 0
+
+    def _eps(self) -> float:
+        f = min(1.0, self._batches / max(1, self.eps_anneal))
+        return self.eps_start + (self.eps_min - self.eps_start) * f
+
+    def _make_step(self):
+        net = self.net
+
+        def step_fn(params, opt_state, step, s, a, ret):
+            def loss_of(p):
+                q = net._forward(p, net.net_state, s, None, train=False,
+                                 rng=None)[0]
+                q_sa = jnp.take_along_axis(q, a[:, None], axis=1)[:, 0]
+                return jnp.mean((q_sa - ret) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            upd = apply_layer_updates(
+                net.conf, zip(params, grads, opt_state, net.updaters,
+                              net.conf.layers),
+                step, net._normalize_gradient)
+            return ([p for p, _ in upd], [st for _, st in upd], loss)
+
+        return jax.jit(step_fn)
+
+    def train_batch(self) -> float:
+        eps = self._eps()
+        obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+        for _ in range(self.n_steps):
+            batch = jnp.asarray(np.stack(self._obs))
+            q = np.asarray(self._fwd(self.net.params, batch))
+            acts = [int(self.rng.randint(q.shape[1]))
+                    if self.rng.rand() < eps else int(np.argmax(q[k]))
+                    for k in range(self.n_envs)]
+            obs_buf.append(np.stack(self._obs))
+            act_buf.append(acts)
+            rews, dones = [], []
+            for k, env in enumerate(self.envs):
+                nxt, r, d = env.step(acts[k])
+                self._ep_rewards[k] += r
+                if d:
+                    self.episode_rewards.append(self._ep_rewards[k])
+                    self._ep_rewards[k] = 0.0
+                    nxt = env.reset()
+                self._obs[k] = nxt
+                rews.append(r)
+                dones.append(d)
+            rew_buf.append(rews)
+            done_buf.append(dones)
+        obs = np.asarray(obs_buf, np.float32)
+        acts = np.asarray(act_buf, np.int32)
+        rews = np.asarray(rew_buf, np.float32)
+        dones = np.asarray(done_buf)
+        # n-step returns bootstrapped from the TARGET net's max-Q
+        q_boot = np.asarray(self._fwd(self.target_params,
+                                      jnp.asarray(np.stack(self._obs))))
+        running = q_boot.max(axis=1)
+        rets = np.zeros_like(rews)
+        for t in reversed(range(self.n_steps)):
+            running = rews[t] + self.gamma * running * (~dones[t])
+            rets[t] = running
+        flat = lambda x: x.reshape((-1,) + x.shape[2:])
+        self.net.params, self.net.opt_state, loss = self._step(
+            self.net.params, self.net.opt_state,
+            jnp.asarray(self._batches, jnp.int32),
+            jnp.asarray(flat(obs)), jnp.asarray(flat(acts)),
+            jnp.asarray(flat(rets)))
+        self._batches += 1
+        if self._batches % self.target_update_freq == 0:
+            self.target_params = jax.tree.map(jnp.asarray, self.net.params)
+        return float(loss)
+
+    def train(self, batches: int = 100) -> List[float]:
+        return [self.train_batch() for _ in range(batches)]
+
+    def play(self, mdp: MDP, max_steps: int = 200) -> float:
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            q = np.asarray(self._fwd(self.net.params,
+                                     jnp.asarray(obs[None])))[0]
+            obs, r, done = mdp.step(int(np.argmax(q)))
+            total += r
+            if done:
+                break
+        return total

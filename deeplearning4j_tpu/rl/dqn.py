@@ -347,3 +347,19 @@ class ActorCritic:
                 jnp.asarray(np.asarray(actions, np.int32)),
                 jnp.asarray(np.asarray(returns, np.float32)))
             self.policy_net.iteration_count += 1
+
+
+def dueling_q_net(obs_size: int, n_actions: int, hidden: int = 64,
+                  seed: int = 0, learning_rate: float = 5e-3):
+    """Dueling-DQN network builder (reference QLearning dueling config):
+    shared trunk → nn.DuelingQLayer head (Q = V + A − mean A). Drop-in for
+    the plain Q-network in QLearningDiscrete."""
+    from deeplearning4j_tpu import nn
+
+    return MultiLayerNetwork(
+        nn.builder().seed(seed).updater(nn.Adam(learning_rate=learning_rate))
+        .list()
+        .layer(nn.DenseLayer(n_out=hidden, activation="relu"))
+        .layer(nn.DuelingQLayer(n_actions=n_actions, activation="identity"))
+        .set_input_type(nn.InputType.feed_forward(obs_size)).build()
+    ).init()

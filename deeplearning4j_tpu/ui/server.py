@@ -188,6 +188,14 @@ class UIServer:
     def attach(self, storage: StatsStorage) -> None:
         self._storages.append(storage)
 
+    def remote_storage(self) -> StatsStorage:
+        """The storage remote workers post into (auto-attached on first
+        use) — the receiving half of RemoteUIStatsStorageRouter."""
+        if not hasattr(self, "_remote_storage"):
+            self._remote_storage = StatsStorage()
+            self.attach(self._remote_storage)
+        return self._remote_storage
+
     def detach(self, storage: StatsStorage) -> None:
         if storage in self._storages:
             self._storages.remove(storage)
@@ -255,6 +263,27 @@ class UIServer:
         ui = self
 
         class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (stdlib naming)
+                # RemoteUIStatsStorageRouter endpoint: workers (launcher
+                # ranks, other hosts) POST JSON stats records here; they land
+                # in the server's remote storage and show on the same charts
+                if not self.path.rstrip("/").endswith("/remote"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n) or b"[]")
+                    records = payload if isinstance(payload, list) else [payload]
+                    for rec in records:
+                        ui.remote_storage().put(rec)
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                except Exception:
+                    self.send_response(400)
+                    self.end_headers()
+
             def do_GET(self):  # noqa: N802 (stdlib naming)
                 path = self.path.rstrip("/") or "/"
                 if path == "/" or path == "/train":

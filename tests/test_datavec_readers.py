@@ -233,3 +233,72 @@ class TestModelHub:
         write_wav(p2, np.zeros((1, 4), np.float32), rate)
         back, _ = read_wav(p2)
         assert back.shape == (1, 4)
+
+
+class TestExcelSqlGeo:
+    """Round-5 DataVec residue (verdict missing #5): excel/jdbc/geo."""
+
+    def _write_xlsx(self, path):
+        # hand-rolled minimal xlsx (zip of xml) — no writer library in env
+        import zipfile
+        sheet = (
+            '<?xml version="1.0"?>'
+            '<worksheet xmlns="http://schemas.openxmlformats.org/'
+            'spreadsheetml/2006/main"><sheetData>'
+            '<row r="1"><c t="s"><v>0</v></c><c t="s"><v>1</v></c></row>'
+            '<row r="2"><c><v>1.5</v></c><c t="s"><v>2</v></c></row>'
+            '<row r="3"><c><v>2</v></c><c t="inlineStr"><is><t>inline</t>'
+            '</is></c></row>'
+            '</sheetData></worksheet>')
+        shared = (
+            '<?xml version="1.0"?>'
+            '<sst xmlns="http://schemas.openxmlformats.org/spreadsheetml/'
+            '2006/main"><si><t>value</t></si><si><t>name</t></si>'
+            '<si><t>abc</t></si></sst>')
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("xl/worksheets/sheet1.xml", sheet)
+            z.writestr("xl/sharedStrings.xml", shared)
+            z.writestr("[Content_Types].xml", "<Types/>")
+
+    def test_excel_reader(self, tmp_path):
+        from deeplearning4j_tpu.datavec.readers import ExcelRecordReader
+        p = str(tmp_path / "t.xlsx")
+        self._write_xlsx(p)
+        rows = ExcelRecordReader(skip_rows=1).read(p)
+        assert rows == [[1.5, "abc"], [2.0, "inline"]]
+
+    def test_sql_reader_with_schema(self):
+        import sqlite3
+        from deeplearning4j_tpu.datavec.readers import SQLRecordReader
+        conn = sqlite3.connect(":memory:")
+        conn.execute("create table t (age integer, score real, name text)")
+        conn.executemany("insert into t values (?,?,?)",
+                         [(30, 1.5, "a"), (40, 2.5, "b")])
+        rr = SQLRecordReader(conn, "select * from t order by age")
+        assert rr.read() == [[30, 1.5, "a"], [40, 2.5, "b"]]
+        schema = rr.schema()
+        kinds = [c["type"] for c in schema.columns]
+        assert kinds == ["long", "double", "string"]
+
+    def test_haversine(self):
+        from deeplearning4j_tpu.datavec.readers import haversine_km
+        # Paris -> London ~= 344 km
+        d = haversine_km(48.8566, 2.3522, 51.5074, -0.1278)
+        assert 335 < d < 355
+        assert haversine_km(10.0, 20.0, 10.0, 20.0) == 0.0
+
+    def test_excel_sparse_cells_align_by_reference(self, tmp_path):
+        # writers omit empty cells; alignment must come from the r= attr
+        import zipfile
+        from deeplearning4j_tpu.datavec.readers import ExcelRecordReader
+        sheet = (
+            '<?xml version="1.0"?>'
+            '<worksheet xmlns="http://schemas.openxmlformats.org/'
+            'spreadsheetml/2006/main"><sheetData>'
+            '<row r="1"><c r="B1"><v>5</v></c><c r="D1"><v>7</v></c></row>'
+            '</sheetData></worksheet>')
+        p = str(tmp_path / "sparse.xlsx")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("xl/worksheets/sheet1.xml", sheet)
+        rows = ExcelRecordReader().read(p)
+        assert rows == [[None, 5.0, None, 7.0]]

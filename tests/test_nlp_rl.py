@@ -307,3 +307,42 @@ class TestHierarchicalSoftmax:
         losses = w2v.fit(toy_corpus2())
         assert losses[-1] < losses[0]
         assert np.isfinite(w2v.similarity("king", "queen"))
+
+
+from deeplearning4j_tpu.rl.dqn import dueling_q_net
+
+
+class TestDuelingDQN:
+    def test_aggregation_formula(self):
+        # Q must equal V + A - mean(A) exactly (identifiable dueling head)
+        net = dueling_q_net(4, 3, hidden=8, seed=1)
+        p = net.params[1]
+        r = np.random.RandomState(0)
+        x = r.randn(5, 4).astype(np.float32)
+        h = np.maximum(x @ np.asarray(net.params[0]["W"])
+                       + np.asarray(net.params[0]["b"]), 0.0)
+        v = h @ np.asarray(p["Wv"]) + np.asarray(p["bv"])
+        a = h @ np.asarray(p["Wa"]) + np.asarray(p["ba"])
+        want = v + a - a.mean(axis=-1, keepdims=True)
+        np.testing.assert_allclose(net.output(x), want, atol=1e-5)
+
+    def test_dueling_dqn_learns_chain(self):
+        mdp = ChainMDP()
+        net = dueling_q_net(mdp.obs_size, mdp.num_actions, hidden=32, seed=7)
+        dqn = QLearningDiscrete(mdp, net, QLearningConfiguration(
+            gamma=0.95, batch_size=32, target_update_freq=50, start_size=32,
+            eps_anneal_steps=300, seed=7))
+        dqn.train(episodes=60, max_steps=30)
+        assert dqn.play(max_steps=30) == pytest.approx(1.0)
+
+
+class TestAsyncNStepQ:
+    def test_learns_chain(self):
+        from deeplearning4j_tpu.rl.async_rl import AsyncNStepQLearningDiscrete
+        net = q_net(5, 2, seed=11)
+        alg = AsyncNStepQLearningDiscrete(
+            ChainMDP, net, n_envs=8, n_steps=5, gamma=0.95,
+            target_update_freq=20, eps_anneal_batches=80, seed=11)
+        losses = alg.train(batches=150)
+        assert np.isfinite(losses[-1])
+        assert alg.play(ChainMDP(), max_steps=30) == pytest.approx(1.0)

@@ -74,10 +74,13 @@ class TestPipelineParallel:
 
         tr = PipelineParallelTrainer(stage_fn, head_fn, mesh,
                                      num_microbatches=4)
+        tr.init_params(stacked, head)
         step = tr.make_train_step(lr=0.05)
+        opt = tr.opt_state
         losses = []
-        for _ in range(15):
-            stacked, head, loss = step(stacked, head, x, y)
+        for i in range(15):
+            stacked, head, opt, loss = step(
+                stacked, head, opt, jnp.asarray(i, jnp.int32), x, y)
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.7, losses
 

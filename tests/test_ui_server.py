@@ -203,3 +203,48 @@ class TestModelGraphPane:
             assert ["d1", "res"] in data["edges"]
         finally:
             ui.stop()
+
+
+class TestRemoteUIStatsStorageRouter:
+    def test_worker_posts_reach_the_dashboard(self):
+        """A remote router (the launcher-worker side) posts records over
+        HTTP; the UIServer's overview chart must include them (round-4
+        missing #4: multi-host runs become observable)."""
+        import urllib.request
+
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.utils.stats import RemoteUIStatsStorageRouter
+
+        srv = UIServer(port=0).start()
+        try:
+            router = RemoteUIStatsStorageRouter(
+                f"http://127.0.0.1:{srv.port}")
+            for i in range(5):
+                router.put({"iteration": i, "score": 1.0 / (i + 1)})
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/train/overview",
+                timeout=5).read()
+            ov = json.loads(body)
+            assert [it for it, _ in ov["score"]] == list(range(5))
+        finally:
+            srv.stop()
+
+    def test_buffering_survives_server_outage(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.utils.stats import RemoteUIStatsStorageRouter
+
+        # no server yet: puts buffer without raising
+        import socket
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]; s.close()
+        router = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{port}",
+                                            timeout=0.3)
+        router.put({"iteration": 0, "score": 3.0})
+        assert router._pending  # buffered, not lost
+        srv = UIServer(port=port).start()
+        try:
+            router.put({"iteration": 1, "score": 2.0})  # flushes both
+            assert not router._pending
+            assert len(srv.remote_storage().records) == 2
+        finally:
+            srv.stop()
