@@ -574,7 +574,16 @@ def import_keras_functional_config(config, weights_map):
         if mapper is None:
             raise NotImplementedError(
                 f"Keras layer '{cls}' has no import mapper (functional)")
-        lc, p = mapper(cfg, weights_map.get(name, []))
+        out = mapper(cfg, weights_map.get(name, []))
+        if isinstance(out, list):
+            if len(out) != 1:
+                raise NotImplementedError(
+                    f"Keras layer '{cls}' ({name}) expands to {len(out)} "
+                    f"layers (StackedRNNCells) — supported in Sequential "
+                    f"models only; restructure the functional graph with "
+                    f"explicit RNN layers")
+            out = out[0]
+        lc, p = out
         state = {}
         if isinstance(p, dict) and "__params__" in p:
             state = p["__state__"]

@@ -12,9 +12,14 @@ Layout note: ONNX is NCHW; the graph records transposes around conv/pool
 (our declarable conv2d/maxpool2d are NHWC, the TPU-friendly layout) and
 XLA folds adjacent transposes away.
 
-Supported surface: the ~35 ops covering MLP/CNN inference graphs (Gemm,
-MatMul, Conv, pooling, BatchNormalization, activations, elementwise,
-shape ops, reductions, Softmax/LogSoftmax, Pad, Clip, Cast, LRN, …).
+Supported surface (round 5): 151 mapped ops — MLP/CNN/RNN graphs (Gemm,
+MatMul, Conv/ConvTranspose, pooling, BatchNormalization, LSTM/GRU/RNN,
+Resize, Einsum), CONTROL FLOW (Loop/If/Scan onto lax.while_loop/cond/scan
+with outer-scope subgraph captures), detection ops (NonMaxSuppression with
+padded static output, exact RoiAlign), the Scatter/Gather families, the
+QuantizeLinear family, random ops, and documented rejects for
+dynamic-output-shape ops (NonZero/Unique/Compress) that XLA's static
+shapes cannot express.
 """
 
 from __future__ import annotations

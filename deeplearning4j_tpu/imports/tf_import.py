@@ -1581,3 +1581,334 @@ def _batch_to_space_nd_tf(sd, ins, attrs, node, const_values=None):
 
 
 _NEEDS_CONSTS |= {"SpaceToBatchND", "BatchToSpaceND"}
+
+
+# ---------------------------------------------------------------------------
+# Dialect widening, round 5: segment/scatter/linalg/image/math tails toward
+# the reference tensorflow mapping ruleset (samediff-import-tensorflow,
+# SURVEY §3.2). All map 1:1 onto catalog declarables.
+# ---------------------------------------------------------------------------
+
+for _tf2, _ours2 in [("Rint", "rint"), ("Digamma", "digamma"),
+                     ("Lgamma", "lgamma"), ("Cholesky", "cholesky"),
+                     ("MatrixInverse", "matrix_inverse"),
+                     ("MatrixSolve", "solve"), ("Diag", "diag"),
+                     ("DiagPart", "diag_part"),
+                     ("MatrixDiag", "matrix_diag"),
+                     ("InvertPermutation", "invert_permutation"),
+                     ("Betainc", "betainc"), ("Igamma", "igamma"),
+                     ("Igammac", "igammac"), ("Polygamma", "polygamma")]:
+    def _mk_direct(ours):
+        def f(sd, ins, attrs, node):
+            return sd._record(ours, ins)
+
+        return f
+
+    TF_OP_MAPPERS[_tf2] = _mk_direct(_ours2)
+
+
+def _mk_segment(ours, needs_num: bool):
+    def f(sd, ins, attrs, node, const_values=None):
+        if needs_num:
+            num = int(np.asarray(
+                _require_const(const_values, node, 2, "num_segments")))
+            return sd._record(ours, ins[:2], {"num_segments": num})
+        # sorted Segment* ops carry no num_segments input — it must come
+        # from the (constant) segment id tensor itself
+        ids = (const_values or {}).get(node.input[1].split(":")[0])
+        if ids is None:
+            raise ValueError(
+                f"{node.op_type} {node.name}: segment_ids must be constant "
+                f"(XLA needs a static segment count)")
+        return sd._record(ours, ins[:2],
+                          {"num_segments": int(np.asarray(ids).max()) + 1})
+
+    return f
+
+
+for _tf2, _ours2 in [("SegmentSum", "segment_sum"),
+                     ("SegmentMax", "segment_max"),
+                     ("SegmentMin", "segment_min"),
+                     ("SegmentMean", "segment_mean"),
+                     ("SegmentProd", "segment_prod")]:
+    TF_OP_MAPPERS[_tf2] = _mk_segment(_ours2, needs_num=False)
+    _NEEDS_CONSTS.add(_tf2)
+
+for _tf2, _ours2 in [("UnsortedSegmentSum", "unsorted_segment_sum"),
+                     ("UnsortedSegmentMax", "unsorted_segment_max"),
+                     ("UnsortedSegmentMin", "unsorted_segment_min"),
+                     ("UnsortedSegmentProd", "unsorted_segment_prod")]:
+    TF_OP_MAPPERS[_tf2] = _mk_segment(_ours2, needs_num=True)
+    _NEEDS_CONSTS.add(_tf2)
+
+
+@register_tf_op("ScatterNd")
+def _tf_scatter_nd(sd, ins, attrs, node, const_values=None):
+    shape = tuple(int(s) for s in np.asarray(
+        _require_const(const_values, node, 2, "shape")).reshape(-1))
+    return sd._record("scatter_nd", ins[:2], {"shape": shape})
+
+
+_NEEDS_CONSTS.add("ScatterNd")
+
+
+@register_tf_op("TensorScatterUpdate")
+def _tf_tensor_scatter_update(sd, ins, attrs, node):
+    return sd._record("scatter_nd_update", ins)
+
+
+@register_tf_op("TensorScatterAdd")
+def _tf_tensor_scatter_add(sd, ins, attrs, node):
+    return sd._record("scatter_nd_add", ins)
+
+
+@register_tf_op("ReverseV2")
+def _tf_reverse(sd, ins, attrs, node, const_values=None):
+    axis = np.asarray(_require_const(const_values, node, 1, "axis")).reshape(-1)
+    return sd._record("reverse", [ins[0]],
+                      {"axis": tuple(int(a) for a in axis)})
+
+
+@register_tf_op("Reverse")
+def _tf_reverse_v1(sd, ins, attrs, node, const_values=None):
+    # TF1 Reverse's second operand is a PER-DIMENSION bool mask
+    dims = np.asarray(_require_const(const_values, node, 1, "dims")).reshape(-1)
+    axes = tuple(i for i, flag in enumerate(dims) if bool(flag))
+    if not axes:
+        return sd._record("identity", [ins[0]])
+    return sd._record("reverse", [ins[0]], {"axis": axes})
+
+
+_NEEDS_CONSTS.add("Reverse")
+
+
+_NEEDS_CONSTS.add("ReverseV2")
+
+
+@register_tf_op("Roll")
+def _tf_roll(sd, ins, attrs, node, const_values=None):
+    shift = np.asarray(_require_const(const_values, node, 1, "shift")).reshape(-1)
+    axis = np.asarray(_require_const(const_values, node, 2, "axis")).reshape(-1)
+    return sd._record("roll", [ins[0]],
+                      {"shift": tuple(int(s) for s in shift),
+                       "axis": tuple(int(a) for a in axis)})
+
+
+_NEEDS_CONSTS.add("Roll")
+
+
+@register_tf_op("MatrixBandPart")
+def _tf_band_part(sd, ins, attrs, node, const_values=None):
+    lo = int(np.asarray(_require_const(const_values, node, 1, "num_lower")))
+    hi = int(np.asarray(_require_const(const_values, node, 2, "num_upper")))
+    return sd._record("matrix_band_part", [ins[0]],
+                      {"num_lower": lo, "num_upper": hi})
+
+
+_NEEDS_CONSTS.add("MatrixBandPart")
+
+
+@register_tf_op("MatrixSetDiag")
+@register_tf_op("MatrixSetDiagV3")
+def _tf_set_diag(sd, ins, attrs, node):
+    return sd._record("matrix_set_diag", ins[:2])
+
+
+from deeplearning4j_tpu.autodiff.samediff import GRAPH_OPS as _GRAPH_OPS
+
+if "pad_to_matrix_shape" not in _GRAPH_OPS:
+    def _pad_to_matrix_shape(a, *, rows, cols):
+        import jax.numpy as _jnp
+
+        pr = rows - a.shape[-2]
+        pc = cols - a.shape[-1]
+        if pr < 0 or pc < 0:
+            raise ValueError(
+                f"pad_to_matrix_shape: target ({rows},{cols}) smaller than "
+                f"diag matrix {a.shape[-2:]}")
+        cfg = [(0, 0)] * (a.ndim - 2) + [(0, pr), (0, pc)]
+        return _jnp.pad(a, cfg)
+
+    _GRAPH_OPS["pad_to_matrix_shape"] = _pad_to_matrix_shape
+
+
+@register_tf_op("MatrixDiagV3")
+def _tf_matrix_diag_v3(sd, ins, attrs, node, const_values=None):
+    # 5-operand form (diagonal, k, num_rows, num_cols, padding_value) —
+    # what tf.eye/tf.linalg.diag lower to. Supported: main diagonal,
+    # default/square sizing, zero padding.
+    def cval(i):
+        return (const_values or {}).get(node.input[i].split(":")[0])
+
+    k = cval(1)
+    if k is not None and np.any(np.asarray(k) != 0):
+        raise NotImplementedError(
+            f"MatrixDiagV3 {node.name}: off-main diagonals (k != 0)")
+    rows, cols = cval(2), cval(3)
+    pad = cval(4)
+    if pad is not None and np.any(np.asarray(pad) != 0):
+        raise NotImplementedError(
+            f"MatrixDiagV3 {node.name}: non-zero padding_value")
+    out = sd._record("matrix_diag", [ins[0]])
+    if rows is not None and int(np.asarray(rows)) != -1:
+        if cols is None:
+            raise NotImplementedError(
+                f"MatrixDiagV3 {node.name}: constant num_rows with dynamic "
+                f"num_cols")
+        r_ = int(np.asarray(rows))
+        c_ = int(np.asarray(cols)) if int(np.asarray(cols)) != -1 else r_
+        # matrix_diag emits (…, d, d) for a length-d diagonal; a larger
+        # requested shape zero-pads on the high side (tf.linalg.diag
+        # num_rows/num_cols semantics with the main diagonal)
+        out = sd._record("pad_to_matrix_shape", [out],
+                         {"rows": r_, "cols": c_})
+    return out
+
+
+_NEEDS_CONSTS.add("MatrixDiagV3")
+
+
+@register_tf_op("Qr")
+def _tf_qr(sd, ins, attrs, node):
+    return sd._record("qr", ins, {"full_matrices":
+                                  bool(attrs.get("full_matrices", False))},
+                      n_out=2)
+
+
+@register_tf_op("LinSpace")
+def _tf_linspace(sd, ins, attrs, node, const_values=None):
+    start = float(np.asarray(_require_const(const_values, node, 0, "start")))
+    stop = float(np.asarray(_require_const(const_values, node, 1, "stop")))
+    num = int(np.asarray(_require_const(const_values, node, 2, "num")))
+    return sd._record("linspace", [], {"start": start, "stop": stop,
+                                       "num": num})
+
+
+_NEEDS_CONSTS.add("LinSpace")
+
+
+@register_tf_op("HistogramFixedWidth")
+def _tf_hist(sd, ins, attrs, node, const_values=None):
+    rng = np.asarray(_require_const(const_values, node, 1, "value_range")
+                     ).reshape(-1)
+    nbins = int(np.asarray(_require_const(const_values, node, 2, "nbins"))) \
+        if len(node.input) > 2 else 100
+    return sd._record("histogram_fixed_width", [ins[0]],
+                      {"range": (float(rng[0]), float(rng[1])),
+                       "num_bins": nbins})
+
+
+_NEEDS_CONSTS.add("HistogramFixedWidth")
+
+
+@register_tf_op("ExtractImagePatches")
+def _tf_patches(sd, ins, attrs, node):
+    ksizes = [int(k) for k in attrs["ksizes"]]
+    strides = [int(s) for s in attrs["strides"]]
+    rates = [int(r) for r in attrs.get("rates", [1, 1, 1, 1])]
+    pad = attrs.get("padding", b"VALID")
+    pad = pad.decode() if isinstance(pad, bytes) else str(pad)
+    return sd._record("extract_image_patches", [ins[0]],
+                      {"kernel": (ksizes[1], ksizes[2]),
+                       "strides": (strides[1], strides[2]),
+                       "rates": (rates[1], rates[2]), "padding": pad})
+
+
+@register_tf_op("InTopKV2")
+def _tf_in_top_k(sd, ins, attrs, node, const_values=None):
+    k = int(np.asarray(_require_const(const_values, node, 2, "k")))
+    return sd._record("in_top_k", ins[:2], {"k": k})
+
+
+_NEEDS_CONSTS.add("InTopKV2")
+
+
+@register_tf_op("NthElement")
+def _tf_nth_element(sd, ins, attrs, node, const_values=None):
+    n = int(np.asarray(_require_const(const_values, node, 1, "n")))
+    return sd._record("nth_element", [ins[0]],
+                      {"n": n, "reverse": bool(attrs.get("reverse", False))})
+
+
+_NEEDS_CONSTS.add("NthElement")
+
+
+@register_tf_op("CropAndResize")
+def _tf_crop_and_resize(sd, ins, attrs, node, const_values=None):
+    size = np.asarray(_require_const(const_values, node, 3, "crop_size")
+                      ).reshape(-1)
+    return sd._record("crop_and_resize", ins[:3],
+                      {"crop_size": (int(size[0]), int(size[1]))})
+
+
+_NEEDS_CONSTS.add("CropAndResize")
+
+
+@register_tf_op("ListDiff")
+def _tf_listdiff(sd, ins, attrs, node, const_values=None):
+    # dynamic output length: supported only when both operands are Const
+    x = (const_values or {}).get(node.input[0].split(":")[0])
+    y = (const_values or {}).get(node.input[1].split(":")[0])
+    if x is None or y is None:
+        raise ValueError(
+            f"ListDiff {node.name}: dynamic-length output needs constant "
+            f"operands under XLA static shapes")
+    xa = np.asarray(x).reshape(-1)
+    ys = set(np.asarray(y).reshape(-1).tolist())
+    keep = [i for i, v in enumerate(xa.tolist()) if v not in ys]
+    # TF semantics: preserve x's order AND duplicates (np.setdiff1d sorts
+    # and dedups — wrong here)
+    return (sd.constant(node.name + "_out", xa[keep]),
+            sd.constant(node.name + "_idx", np.asarray(keep, np.int32)))
+
+
+_NEEDS_CONSTS.add("ListDiff")
+
+
+@register_tf_op("Bincount")
+@register_tf_op("DenseBincount")
+def _tf_bincount(sd, ins, attrs, node, const_values=None):
+    size = (const_values or {}).get(node.input[1].split(":")[0])
+    if size is None:
+        raise ValueError(f"Bincount {node.name}: size must be constant")
+    n = int(np.asarray(size))
+    if len(node.input) > 2 and node.input[2]:
+        w = (const_values or {}).get(node.input[2].split(":")[0])
+        # reject ANY weights operand unless it is a constant empty tensor
+        # (silently dropping runtime weights would yield unweighted counts)
+        if w is None or np.asarray(w).size:
+            raise NotImplementedError(
+                f"Bincount {node.name}: weighted bincount import is not "
+                f"supported — precompute outside the graph")
+    out = sd._record("bincount", [ins[0]], {"minlength": n, "maxlength": n})
+    if bool(attrs.get("binary_output", False)):
+        zero = sd.constant(node.name + "_z", np.asarray(0, np.int32))
+        out = sd._record("cast", [sd._record("gt", [out, zero])],
+                         {"dtype": "int32"})
+    return out
+
+
+_NEEDS_CONSTS.add("Bincount")
+_NEEDS_CONSTS.add("DenseBincount")
+
+
+@register_tf_op("BroadcastArgs")
+def _tf_broadcast_args(sd, ins, attrs, node, const_values=None):
+    # shape-arithmetic helper tf.linspace/broadcasting emit; both operands
+    # are shape tensors — constant in frozen graphs
+    s0 = (const_values or {}).get(node.input[0].split(":")[0])
+    s1 = (const_values or {}).get(node.input[1].split(":")[0])
+    if s0 is None or s1 is None:
+        raise ValueError(
+            f"BroadcastArgs {node.name}: dynamic shape operands unsupported")
+    out = np.broadcast_shapes(tuple(np.asarray(s0).reshape(-1)),
+                              tuple(np.asarray(s1).reshape(-1)))
+    arr = np.asarray(out, np.int32)
+    if const_values is not None:
+        # downstream shape consumers (BroadcastTo/Reshape) resolve their
+        # shape operand through const_values — publish the folded result
+        const_values[node.name] = arr
+    return sd.constant(node.name, arr)
+
+
+_NEEDS_CONSTS.add("BroadcastArgs")

@@ -492,6 +492,9 @@ class MultiLayerNetwork:
                 lst.on_epoch_start(self)
             for ds in data:
                 self.last_batch_size = ds.num_examples()
+                # host-side reference only (no copy): StatsListener's
+                # activation charts feed_forward this batch on demand
+                self._last_features = ds.features
                 if tbptt:
                     loss = self._fit_tbptt_batch(ds, step_fn)
                 else:

@@ -161,6 +161,41 @@ def _cases() -> List[Case]:
         return fn, (jnp.asarray(r.rand(4, 784).astype(np.float32)),)
 
     cases.append(Case("lenet_forward", lenet_fwd, rtol=2e-2, atol=1e-2))
+
+    # round-5 layers: MoE routing (argmax gates could tie-break differently
+    # across backends — the case proves they don't on realistic data) and
+    # the dueling-Q aggregation
+    def moe_fwd():
+        from deeplearning4j_tpu import nn
+
+        b = (nn.builder().seed(3).updater(nn.Sgd(learning_rate=0.1)).list()
+             .layer(nn.MoELayer(d_hidden=16, n_experts=4, top_k=2,
+                                capacity_factor=2.0, activation="relu"))
+             .layer(nn.OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent")))
+        net = nn.MultiLayerNetwork(
+            b.set_input_type(nn.InputType.feed_forward(8)).build()).init()
+
+        def fn(x):
+            return net._forward(net.params, net.net_state, x, None,
+                                train=False, rng=None)[0]
+
+        return fn, (jnp.asarray(r.rand(16, 8).astype(np.float32)),)
+
+    cases.append(Case("moe_layer_forward", moe_fwd, rtol=2e-2, atol=1e-2))
+
+    def dueling_fwd():
+        from deeplearning4j_tpu.rl.dqn import dueling_q_net
+
+        net = dueling_q_net(6, 3, hidden=16, seed=2)
+
+        def fn(x):
+            return net._forward(net.params, net.net_state, x, None,
+                                train=False, rng=None)[0]
+
+        return fn, (jnp.asarray(r.rand(5, 6).astype(np.float32)),)
+
+    cases.append(Case("dueling_q_forward", dueling_fwd, rtol=2e-2, atol=1e-2))
     return cases
 
 

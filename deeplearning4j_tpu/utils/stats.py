@@ -62,10 +62,15 @@ class StatsListener:
     """StatsListener.java analog: per-iteration stats into a StatsStorage."""
 
     def __init__(self, storage: StatsStorage, frequency: int = 1,
-                 collect_histograms: bool = False):
+                 collect_histograms: bool = False,
+                 collect_activations: bool = False):
         self.storage = storage
         self.frequency = max(1, frequency)
         self.collect_histograms = collect_histograms
+        # per-layer activation mean-magnitude/stdev (the reference model
+        # view's activation charts) — costs one extra forward per report,
+        # exactly as the reference's stats collection does
+        self.collect_activations = collect_activations
         self._prev_params: Optional[List[Dict[str, np.ndarray]]] = None
         self._sent_static = False
 
@@ -154,8 +159,33 @@ class StatsListener:
                                        "edges": edges.tolist()}
                 layer_stats[name] = st
         rec["layers"] = layer_stats
+        if self.collect_activations:
+            acts = self._activation_stats(model)
+            if acts:
+                rec["activations"] = acts
         self.storage.put(rec)
         self._prev_params = _snapshot(params)
+
+    def _activation_stats(self, model):
+        """Per-layer activation mean|a|/std via one feed_forward on the
+        model's last-seen batch (stashed by fit); MLN only — graph
+        activations are a dict of DAG nodes and chart the same way when
+        exposed."""
+        feats = getattr(model, "_last_features", None)
+        if feats is None or not hasattr(model, "feed_forward"):
+            return None
+        try:
+            acts = model.feed_forward(np.asarray(feats), train=False)
+        except Exception:
+            return None
+        out = {}
+        for i, a in enumerate(acts):
+            arr = np.asarray(a)
+            lc = model.layers[i].lc if i < len(model.layers) else None
+            name = (getattr(lc, "name", None) or f"layer_{i}")
+            out[name] = {"mean_magnitude": float(np.abs(arr).mean()),
+                         "stdev": float(arr.std())}
+        return out
 
 
 def _leaves(tree, prefix=""):

@@ -67,3 +67,7 @@ class TestExamples:
         out = _run("saved_model_finetune.py")
         assert "imported outputs match TF: True" in out
         assert "weights moved from the pretrained point: True" in out
+
+    def test_moe_pipeline_parallel(self):
+        out = _run("moe_pipeline_parallel.py")
+        assert "MoE dp×ep" in out and "pipeline dp×pp" in out

@@ -248,3 +248,30 @@ class TestRemoteUIStatsStorageRouter:
             assert len(srv.remote_storage().records) == 2
         finally:
             srv.stop()
+
+
+class TestActivationStats:
+    def test_per_layer_activation_drilldown(self):
+        """StatsListener(collect_activations=True) reports per-layer
+        activation mean|a|/std — the reference model view's activation
+        charts (round-4 weak #8)."""
+        from deeplearning4j_tpu import nn
+        from deeplearning4j_tpu.utils.stats import StatsListener, StatsStorage
+
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(0).updater(nn.Sgd(learning_rate=0.1)).list()
+            .layer(nn.DenseLayer(n_out=8, activation="relu", name="d1"))
+            .layer(nn.OutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent", name="out"))
+            .set_input_type(nn.InputType.feed_forward(5)).build()).init()
+        storage = StatsStorage()
+        net.listeners = [StatsListener(storage, collect_activations=True)]
+        r = np.random.RandomState(0)
+        x = r.randn(6, 5).astype(np.float32)
+        y = np.eye(3)[r.randint(0, 3, 6)].astype(np.float32)
+        net.fit(x, y)
+        rec = storage.latest()
+        assert "activations" in rec
+        assert set(rec["activations"]) == {"d1", "out"}
+        for st in rec["activations"].values():
+            assert st["mean_magnitude"] >= 0 and st["stdev"] >= 0
