@@ -159,7 +159,7 @@ class PipelineParallelTrainer:
 
     # ------------------------------------------------------------- builders
     @classmethod
-    def from_confs(cls, block_confs, head_fn: Callable, input_feats: int,
+    def from_confs(cls, block_confs, head_fn: Callable, input_feats,
                    mesh: Mesh, *, num_microbatches: int, n_stages=None,
                    seed: int = 0, head_params=None, axis: str = "pipe",
                    **kw) -> "PipelineParallelTrainer":
@@ -174,21 +174,24 @@ class PipelineParallelTrainer:
         from deeplearning4j_tpu.nn.layers import build_layer
 
         n_stages = n_stages or mesh.shape[axis]
+        # input_feats: an int (feed-forward width) or a full InputType
+        # (e.g. InputType.recurrent(d, T) for transformer-block stages)
+        in_type = (input_feats if isinstance(input_feats, C.InputType)
+                   else C.InputType.feed_forward(input_feats))
         b = C.builder().seed(seed).list()
         for lc in block_confs:
             b.layer(lc)
-        built = b.set_input_type(
-            C.InputType.feed_forward(input_feats)).build()
+        built = b.set_input_type(in_type).build()
         itype = built.input_type
         impls = []
         for lc in built.layers:  # n_in already inferred by build()
             impl = build_layer(built, lc, itype)
             impls.append(impl)
             itype = impl.otype
-        if itype.flat_size() != input_feats:
+        if itype.flat_size() != in_type.flat_size():
             raise ValueError(
                 f"pipeline stages must be shape-preserving: block maps "
-                f"{input_feats} -> {itype.flat_size()} features")
+                f"{in_type.flat_size()} -> {itype.flat_size()} features")
 
         def stage_fn(stage_params, x):
             for impl, p in zip(impls, stage_params):
