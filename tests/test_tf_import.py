@@ -104,11 +104,12 @@ class TestTfImport:
         np.testing.assert_allclose(list(g.values())[0], np.ones((2, 2)))
 
     def test_unsupported_op_raises_clearly(self):
+        # Betainc gained a mapper in round 5 — use a genuinely unmapped op
         def model(x):
-            return tf.raw_ops.Betainc(a=x, b=x, x=x)
+            return tf.raw_ops.Angle(input=tf.complex(x, x))
 
         gd, ins, outs = freeze(model, tf.TensorSpec([2], tf.float32))
-        with pytest.raises(NotImplementedError, match="Betainc"):
+        with pytest.raises(NotImplementedError, match="Angle|Complex"):
             TensorflowImporter().run_import(gd)
 
     def test_gelu_composite_golden(self):
