@@ -1912,3 +1912,170 @@ def _tf_broadcast_args(sd, ins, attrs, node, const_values=None):
 
 
 _NEEDS_CONSTS.add("BroadcastArgs")
+
+
+# -- round-5 continued: linalg decompositions, Conv3D, seeded random ops ----
+
+TF_OP_MAPPERS["BatchMatMulV3"] = TF_OP_MAPPERS["BatchMatMulV2"]
+
+
+if "matrix_transpose" not in _GRAPH_OPS:
+    import jax.numpy as _jnp_mt
+
+    _GRAPH_OPS["matrix_transpose"] = lambda a: _jnp_mt.swapaxes(a, -1, -2)
+
+
+@register_tf_op("Svd")
+def _tf_svd(sd, ins, attrs, node):
+    # TF Svd outputs (s, u, v); the catalog op (jnp convention) returns
+    # (u, s, vh) — reorder and un-hermitian v
+    cuv = bool(attrs.get("compute_uv", True))
+    if not cuv:
+        return sd._record("svd", ins, {"full_matrices": False,
+                                       "compute_uv": False})
+    u, s_, vh = sd._record("svd", ins, {
+        "full_matrices": bool(attrs.get("full_matrices", False)),
+        "compute_uv": True}, n_out=3)
+    v = sd._record("matrix_transpose", [vh])
+    return [s_, u, v]
+
+
+@register_tf_op("MatrixTriangularSolve")
+def _tf_tri_solve(sd, ins, attrs, node):
+    return sd._record("triangular_solve", ins, {
+        "lower": bool(attrs.get("lower", True)),
+        "adjoint": bool(attrs.get("adjoint", False))})
+
+
+@register_tf_op("Cross")
+def _tf_cross(sd, ins, attrs, node):
+    return sd._record("cross", ins)
+
+
+if "lu_tf_outputs" not in _GRAPH_OPS:
+    def _lu_tf_outputs(a):
+        import jax.numpy as _jnp
+        import jax as _jx
+
+        lu_, ipiv = _jx.scipy.linalg.lu_factor(a)
+        # LAPACK ipiv (row i swapped with ipiv[i], sequential) → TF's
+        # permutation-of-rows vector
+        n = a.shape[-1]
+
+        def to_perm(ip):
+            def body(i, perm):
+                j = ip[i]
+                pi = perm[i]
+                perm = perm.at[i].set(perm[j])
+                return perm.at[j].set(pi)
+
+            return _jx.lax.fori_loop(0, n, body, _jnp.arange(n))
+
+        if a.ndim == 2:
+            perm = to_perm(ipiv)
+        else:
+            perm = _jx.vmap(to_perm)(ipiv.reshape(-1, n)).reshape(
+                ipiv.shape[:-1] + (n,))
+        return lu_, perm.astype(_jnp.int32)
+
+    _GRAPH_OPS["lu_tf_outputs"] = _lu_tf_outputs
+
+
+@register_tf_op("Lu")
+def _tf_lu(sd, ins, attrs, node):
+    return sd._record("lu_tf_outputs", ins, n_out=2)
+
+
+if "eigh_pair" not in _GRAPH_OPS:
+    def _eigh_pair(a):
+        import jax.numpy as _jnp
+
+        e, v = _jnp.linalg.eigh(a)
+        return e, v
+
+    _GRAPH_OPS["eigh_pair"] = _eigh_pair
+
+
+@register_tf_op("SelfAdjointEigV2")
+def _tf_eigh(sd, ins, attrs, node):
+    if not attrs.get("compute_v", True):
+        return sd._record("eigh_pair", ins, n_out=2)[0]
+    return sd._record("eigh_pair", ins, n_out=2)
+
+
+@register_tf_op("Conv3D")
+def _tf_conv3d(sd, ins, attrs, node):
+    fmt = attrs.get("data_format", b"NDHWC")
+    fmt = fmt.decode() if isinstance(fmt, bytes) else str(fmt)
+    if fmt != "NDHWC":
+        raise ValueError(
+            f"Conv3D {node.name}: only NDHWC import supported (got {fmt})")
+    strides = [int(s) for s in attrs["strides"]]
+    pad = attrs.get("padding", b"SAME")
+    pad = pad.decode() if isinstance(pad, bytes) else str(pad)
+    dil = [int(d) for d in attrs.get("dilations", [1, 1, 1, 1, 1])]
+    return sd._record("conv3d", ins[:2], {
+        "stride": tuple(strides[1:4]), "padding": pad.lower(),
+        "dilation": tuple(dil[1:4])})
+
+
+def _seeded_random(op_kind):
+    """TF stateful random ops under XLA static semantics: a fixed stream
+    keyed by the op's seed attrs (seed=0 falls back to a name hash), the
+    same contract the ONNX random mappers use."""
+    def rule(sd, ins, attrs, node, const_values=None):
+        import zlib
+
+        shape = (const_values or {}).get(node.input[0].split(":")[0])
+        if shape is None:
+            raise ValueError(
+                f"{node.op_type} {node.name}: shape operand must be constant")
+        shp = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+        s1 = int(attrs.get("seed", 0))
+        s2 = int(attrs.get("seed2", 0))
+        if s1 or s2:
+            # TF puts the graph seed in `seed` and the per-op seed in
+            # `seed2` — COMBINE them (first-nonzero would collapse every
+            # op in a seeded graph onto one stream)
+            seed = (s1 * 1000003 + s2) & 0x7FFFFFFF
+        else:
+            # unseeded: stable per-name stream (hash() is
+            # PYTHONHASHSEED-randomized across processes)
+            seed = zlib.crc32(node.name.encode()) & 0x7FFFFFFF
+        dt = attrs.get("dtype")
+        kw = {"shape": shp, "seed": seed}
+        if dt is not None:
+            import tensorflow as _tf
+
+            np_dt = _tf.dtypes.as_dtype(dt).as_numpy_dtype
+            if not np.issubdtype(np_dt, np.floating):
+                raise NotImplementedError(
+                    f"{node.op_type} {node.name}: non-float random dtype "
+                    f"{np_dt} import")
+            kw["dtype"] = np.dtype(np_dt).name
+        return sd._record(op_kind, [], kw)
+
+    return rule
+
+
+if "tf_random_normal" not in _GRAPH_OPS:
+    import jax as _jax_mod
+    import jax.numpy as _jnp_mod
+
+    _GRAPH_OPS["tf_random_normal"] = (
+        lambda *, shape, seed, dtype="float32": _jax_mod.random.normal(
+            _jax_mod.random.key(seed), tuple(shape), _jnp_mod.dtype(dtype)))
+    _GRAPH_OPS["tf_random_uniform"] = (
+        lambda *, shape, seed, dtype="float32": _jax_mod.random.uniform(
+            _jax_mod.random.key(seed), tuple(shape), _jnp_mod.dtype(dtype)))
+    _GRAPH_OPS["tf_truncated_normal"] = (
+        lambda *, shape, seed, dtype="float32":
+        _jax_mod.random.truncated_normal(
+            _jax_mod.random.key(seed), -2.0, 2.0, tuple(shape),
+            _jnp_mod.dtype(dtype)))
+
+TF_OP_MAPPERS["RandomStandardNormal"] = _seeded_random("tf_random_normal")
+TF_OP_MAPPERS["RandomUniform"] = _seeded_random("tf_random_uniform")
+TF_OP_MAPPERS["TruncatedNormal"] = _seeded_random("tf_truncated_normal")
+for _r in ("RandomStandardNormal", "RandomUniform", "TruncatedNormal"):
+    _NEEDS_CONSTS.add(_r)
