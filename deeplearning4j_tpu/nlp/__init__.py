@@ -5,7 +5,7 @@ from deeplearning4j_tpu.nlp.wordpiece import (
     BertIterator,
     build_vocab,
 )
-from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, distributed_word2vec_fit
 from deeplearning4j_tpu.nlp.glove import GloVe
 from deeplearning4j_tpu.nlp.paragraph_vectors import (
     LabelledDocument,

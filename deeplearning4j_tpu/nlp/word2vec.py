@@ -291,3 +291,66 @@ class Word2Vec:
         w.syn0 = jnp.asarray(data["syn0"])
         w.syn1 = jnp.asarray(data["syn1"])
         return w
+
+
+def distributed_word2vec_fit(w2v: "Word2Vec", sentences, *, epochs=None):
+    """Cluster word2vec — the dl4j-spark-nlp SparkWord2Vec role (SURVEY
+    §3.3): the corpus shards per host (deterministic sentence round-robin,
+    the RDD-partition analog), every rank trains its shard locally for one
+    epoch, then the embedding matrices PARAMETER-AVERAGE across the
+    cluster — the same sync-averaging semantics the reference's Spark
+    training master applies to word vectors.
+
+    The vocabulary must be identical on every rank, so it is built from the
+    FULL corpus on each host (vocab building is a cheap counting pass; the
+    expensive part — training — runs on 1/N of the pairs per host).
+    Single-process runs degrade to a plain fit."""
+    import jax
+
+    sentences = [list(s) for s in sentences]
+    if not w2v.vocab:
+        w2v.build_vocab(sentences)
+    epochs = epochs if epochs is not None else w2v.epochs
+    n = jax.process_count()
+    if n == 1:
+        saved = w2v.epochs
+        w2v.epochs = epochs
+        try:
+            return w2v.fit(sentences)
+        finally:
+            w2v.epochs = saved
+    if w2v.use_hierarchic_softmax:
+        # fit() re-derives the HS tree and zeroes syn1 on every call, which
+        # would discard the averaged inner-node table each epoch
+        raise NotImplementedError(
+            "distributed_word2vec_fit supports negative sampling only "
+            "(hierarchical softmax rebuilds syn1 per fit call)")
+    from deeplearning4j_tpu.parallel.launch import host_shard
+
+    from jax.experimental import multihost_utils
+
+    shard = host_shard(sentences)
+    # every rank must hold initialized matrices BEFORE the collectives —
+    # an empty-shard rank never calls fit() and would otherwise crash out
+    # of the allgather, deadlocking the cluster
+    V, D = len(w2v.vocab), w2v.layer_size
+    if w2v.syn0 is None or w2v.syn0.shape != (V, D):
+        key = jax.random.key(w2v.seed)
+        w2v.syn0 = (jax.random.uniform(key, (V, D), jnp.float32) - 0.5) / D
+        w2v.syn1 = jnp.zeros((V, D), jnp.float32)
+    losses = []
+    saved_epochs = w2v.epochs
+    w2v.epochs = 1
+    try:
+        for _ in range(epochs):
+            if shard:
+                losses.extend(w2v.fit(shard))
+            # parameter averaging over the cluster
+            for attr in ("syn0", "syn1"):
+                gathered = multihost_utils.process_allgather(
+                    np.asarray(getattr(w2v, attr), np.float32))
+                setattr(w2v, attr, jnp.asarray(
+                    np.asarray(gathered).mean(axis=0)))
+    finally:
+        w2v.epochs = saved_epochs
+    return losses

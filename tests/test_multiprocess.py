@@ -86,3 +86,41 @@ def test_two_process_cluster():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"WORKER_{i}_OK" in out
+
+
+import numpy as np  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestDistributedWord2Vec:
+    def test_two_process_averaging_matches_vocab_and_trains(self, tmp_path):
+        """SparkWord2Vec role: 2-rank corpus-sharded training with
+        parameter averaging; rank 0 saves the vectors, and similarity
+        structure from the toy corpus must hold (cats cluster together)."""
+        worker = tmp_path / "w2v_worker.py"
+        worker.write_text("""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys, numpy as np
+sys.path.insert(0, %r)
+from deeplearning4j_tpu.parallel.launch import initialize_distributed
+initialize_distributed()
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, distributed_word2vec_fit
+corpus = ([["cat", "purrs", "softly"], ["cat", "meows", "softly"],
+           ["dog", "barks", "loudly"], ["dog", "growls", "loudly"]] * 40)
+w2v = Word2Vec(layer_size=16, window_size=2, negative_samples=3,
+               learning_rate=0.05, epochs=1, seed=3)
+losses = distributed_word2vec_fit(w2v, corpus, epochs=8)
+assert losses and np.isfinite(losses[-1])
+if jax.process_index() == 0:
+    sim_same = w2v.similarity("cat", "meows")
+    sim_diff = w2v.similarity("cat", "barks")
+    assert sim_same > sim_diff, (sim_same, sim_diff)
+    np.save(%r, np.asarray(w2v.syn0))
+""" % (REPO_ROOT, str(tmp_path / "syn0.npy")))
+        from deeplearning4j_tpu.parallel.launch import launch
+        rc = launch(2, [str(worker)], timeout=300.0)
+        assert rc == 0
+        syn0 = np.load(tmp_path / "syn0.npy")
+        assert syn0.shape[1] == 16 and np.isfinite(syn0).all()
