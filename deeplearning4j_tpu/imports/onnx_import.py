@@ -1642,13 +1642,41 @@ def _rand_uniform_impl(*, shape, low, high, seed, dtype):
     return _jax.random.uniform(k, tuple(shape), _jnp.dtype(dtype), low, high)
 
 
+_ONNX_FLOAT_DT = {1: "float32", 10: "float16", 11: "float64"}
+
+
+def _onnx_seed(attrs, node):
+    """Stable stream key: the (float) seed attr when given, else a crc32 of
+    the node name — unseeded ops must not all share key(0), and hash() is
+    PYTHONHASHSEED-randomized across processes."""
+    import zlib
+
+    s = attrs.get("seed")
+    if s is not None and float(s) != 0.0:
+        return int(float(s)) & 0x7FFFFFFF
+    return zlib.crc32(node.name.encode()) & 0x7FFFFFFF
+
+
+def _onnx_float_dtype(attrs, node):
+    code = attrs.get("dtype")
+    if code is None:
+        return "float32"
+    dt = _ONNX_FLOAT_DT.get(int(code))
+    if dt is None:
+        raise NotImplementedError(
+            f"{node.op_type} {node.name}: non-float random dtype code "
+            f"{int(code)}")
+    return dt
+
+
 @register_onnx_op("RandomNormal")
 def _onnx_random_normal(sd, ins, attrs, node):
     return sd._record("onnx_random_normal", [], {
         "shape": tuple(int(s) for s in attrs["shape"]),
         "mean": float(attrs.get("mean", 0.0)),
         "scale": float(attrs.get("scale", 1.0)),
-        "seed": int(float(attrs.get("seed", 0))), "dtype": "float32"})
+        "seed": _onnx_seed(attrs, node),
+        "dtype": _onnx_float_dtype(attrs, node)})
 
 
 @register_onnx_op("RandomUniform")
@@ -1657,7 +1685,8 @@ def _onnx_random_uniform(sd, ins, attrs, node):
         "shape": tuple(int(s) for s in attrs["shape"]),
         "low": float(attrs.get("low", 0.0)),
         "high": float(attrs.get("high", 1.0)),
-        "seed": int(float(attrs.get("seed", 0))), "dtype": "float32"})
+        "seed": _onnx_seed(attrs, node),
+        "dtype": _onnx_float_dtype(attrs, node)})
 
 
 @_graph_op("onnx_random_normal_like")
@@ -1671,7 +1700,7 @@ def _onnx_random_normal_like(sd, ins, attrs, node):
     return sd._record("onnx_random_normal_like", [ins[0]], {
         "mean": float(attrs.get("mean", 0.0)),
         "scale": float(attrs.get("scale", 1.0)),
-        "seed": int(float(attrs.get("seed", 0)))})
+        "seed": _onnx_seed(attrs, node)})
 
 
 @_graph_op("onnx_random_uniform_like")
@@ -1685,7 +1714,7 @@ def _onnx_random_uniform_like(sd, ins, attrs, node):
     return sd._record("onnx_random_uniform_like", [ins[0]], {
         "low": float(attrs.get("low", 0.0)),
         "high": float(attrs.get("high", 1.0)),
-        "seed": int(float(attrs.get("seed", 0)))})
+        "seed": _onnx_seed(attrs, node)})
 
 
 @_graph_op("onnx_bernoulli")
@@ -1696,14 +1725,14 @@ def _bernoulli_impl(x, *, seed):
 @register_onnx_op("Bernoulli")
 def _onnx_bernoulli(sd, ins, attrs, node):
     return sd._record("onnx_bernoulli", [ins[0]],
-                      {"seed": int(float(attrs.get("seed", 0)))})
+                      {"seed": _onnx_seed(attrs, node)})
 
 
 @register_onnx_op("Multinomial")
 def _onnx_multinomial(sd, ins, attrs, node):
     return sd._record("onnx_multinomial", [ins[0]], {
         "sample_size": int(attrs.get("sample_size", 1)),
-        "seed": int(float(attrs.get("seed", 0)))})
+        "seed": _onnx_seed(attrs, node)})
 
 
 @_graph_op("onnx_multinomial")

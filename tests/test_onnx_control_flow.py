@@ -317,3 +317,20 @@ class TestOnnxBreadthRound5:
 
 
 import pytest  # noqa: E402  (used by the reject test)
+
+
+class TestOnnxRandomStreams:
+    def test_unseeded_ops_get_distinct_stable_streams(self):
+        nodes = [
+            node_proto("RandomNormal", [], ["r1"], shape=[4], name="rn1"),
+            node_proto("RandomNormal", [], ["r2"], shape=[4], name="rn2"),
+            node_proto("Sub", ["r1", "r2"], ["y"]),
+        ]
+        model = build_model(nodes, [], [("y", (4,))], {})
+        sd = import_onnx(model)
+        out = np.asarray(sd.output({}, "y")["y"])
+        # distinct per-name streams: difference must not vanish
+        assert np.abs(out).max() > 1e-3
+        # and deterministic across executions
+        out2 = np.asarray(sd.output({}, "y")["y"])
+        np.testing.assert_array_equal(out, out2)
