@@ -1874,3 +1874,59 @@ def _onnx_rnn(sd, ins, attrs, node):
                       {"hidden_size": int(attrs["hidden_size"]),
                        "activation": acts[0] if isinstance(acts[0], str)
                        else acts[0].decode()}, n_out=2)
+
+
+@_graph_op("onnx_grid_sample")
+def _grid_sample_impl(x, grid, *, mode, padding_mode, align_corners):
+    """ONNX GridSample (NCHW x, NHW2 grid in [-1,1] xy order) — bilinear /
+    nearest with zeros/border padding, the torch.nn.functional.grid_sample
+    semantics detection/segmentation exports rely on."""
+    if x.ndim != 4:
+        raise NotImplementedError(
+            f"GridSample: only 4-D NCHW input is supported (got rank "
+            f"{x.ndim}; volumetric 5-D GridSample is an opset-16 extension)")
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * 0.5 * (w - 1)
+        fy = (gy + 1) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1) * w - 1) * 0.5
+        fy = ((gy + 1) * h - 1) * 0.5
+
+    def gather(yy, xx):
+        yc = _jnp.clip(yy, 0, h - 1).astype(_jnp.int32)
+        xc = _jnp.clip(xx, 0, w - 1).astype(_jnp.int32)
+        # (N, Ho, Wo) index maps into (N, C, H, W) -> (N, C, Ho, Wo)
+        vals = _jax.vmap(lambda img, y_, x_: img[:, y_, x_])(x, yc, xc)
+        if padding_mode == "zeros":
+            inb = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+            vals = vals * inb[:, None].astype(vals.dtype)
+        return vals
+
+    if mode == "nearest":
+        return gather(_jnp.round(fy), _jnp.round(fx))
+    y0 = _jnp.floor(fy)
+    x0 = _jnp.floor(fx)
+    wy = (fy - y0)[:, None]
+    wx = (fx - x0)[:, None]
+    return (gather(y0, x0) * (1 - wy) * (1 - wx)
+            + gather(y0, x0 + 1) * (1 - wy) * wx
+            + gather(y0 + 1, x0) * wy * (1 - wx)
+            + gather(y0 + 1, x0 + 1) * wy * wx)
+
+
+@register_onnx_op("GridSample")
+def _onnx_grid_sample(sd, ins, attrs, node):
+    mode = attrs.get("mode", "linear") or "linear"
+    mode = {"bilinear": "linear"}.get(mode, mode)
+    if mode not in ("linear", "nearest"):
+        raise NotImplementedError(f"GridSample mode={mode}")
+    pad = attrs.get("padding_mode", "zeros") or "zeros"
+    if pad not in ("zeros", "border"):
+        raise NotImplementedError(f"GridSample padding_mode={pad}")
+    return sd._record("onnx_grid_sample", ins, {
+        "mode": "nearest" if mode == "nearest" else "linear",
+        "padding_mode": pad,
+        "align_corners": bool(int(attrs.get("align_corners", 0)))})

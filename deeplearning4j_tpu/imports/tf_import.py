@@ -2079,3 +2079,31 @@ TF_OP_MAPPERS["RandomUniform"] = _seeded_random("tf_random_uniform")
 TF_OP_MAPPERS["TruncatedNormal"] = _seeded_random("tf_truncated_normal")
 for _r in ("RandomStandardNormal", "RandomUniform", "TruncatedNormal"):
     _NEEDS_CONSTS.add(_r)
+
+
+if "tf_softmax_xent" not in _GRAPH_OPS:
+    import jax as _jax_xe
+    import jax.numpy as _jnp_x
+
+    def _tf_softmax_xent_impl(logits, labels):
+        loss = -_jnp_x.sum(labels * _jax_xe.nn.log_softmax(logits), axis=-1)
+        grad = _jax_xe.nn.softmax(logits) - labels
+        return loss, grad
+
+    def _tf_sparse_softmax_xent_impl(logits, labels):
+        oh = _jax_xe.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
+        return _tf_softmax_xent_impl(logits, oh)
+
+    _GRAPH_OPS["tf_softmax_xent"] = _tf_softmax_xent_impl
+    _GRAPH_OPS["tf_sparse_softmax_xent"] = _tf_sparse_softmax_xent_impl
+
+
+@register_tf_op("SoftmaxCrossEntropyWithLogits")
+def _tf_softmax_xent(sd, ins, attrs, node):
+    # outputs (loss, backprop-gradient) — training-graph freezes carry both
+    return sd._record("tf_softmax_xent", ins[:2], n_out=2)
+
+
+@register_tf_op("SparseSoftmaxCrossEntropyWithLogits")
+def _tf_sparse_softmax_xent(sd, ins, attrs, node):
+    return sd._record("tf_sparse_softmax_xent", ins[:2], n_out=2)

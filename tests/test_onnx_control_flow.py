@@ -334,3 +334,25 @@ class TestOnnxRandomStreams:
         # and deterministic across executions
         out2 = np.asarray(sd.output({}, "y")["y"])
         np.testing.assert_array_equal(out, out2)
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode,align", [("bilinear", 0), ("bilinear", 1),
+                                            ("nearest", 0)])
+    def test_matches_torch(self, mode, align):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+        r = np.random.RandomState(0)
+        x = r.randn(2, 3, 5, 6).astype(np.float32)
+        grid = (r.rand(2, 4, 4, 2).astype(np.float32) * 2.2 - 1.1)
+        golden = F.grid_sample(torch.tensor(x), torch.tensor(grid),
+                               mode=mode, padding_mode="zeros",
+                               align_corners=bool(align)).numpy()
+        nodes = [node_proto("GridSample", ["x", "grid"], ["y"],
+                            mode="bilinear" if mode == "bilinear" else "nearest",
+                            padding_mode="zeros", align_corners=align)]
+        model = build_model(nodes, [("x", x.shape), ("grid", grid.shape)],
+                            [("y", golden.shape)], {})
+        sd = import_onnx(model)
+        got = np.asarray(sd.output({"x": x, "grid": grid}, "y")["y"])
+        np.testing.assert_allclose(got, golden, atol=1e-5)

@@ -213,3 +213,23 @@ class TestRound5LinalgConv3dRandom:
             lu_, p = tf.linalg.lu(a @ tf.transpose(a) + 4.0 * tf.eye(4))
             return tf.cast(p, tf.float32) + tf.reduce_sum(lu_) * 0.0
         check(model, SPEC44, [X44])
+
+
+class TestSoftmaxXent:
+    # the raw ops are what frozen training graphs carry (the python
+    # wrappers add dynamic Rank/Slice scaffolding that freezes poorly)
+    def test_softmax_xent_loss(self):
+        labels = np.eye(4)[[0, 2, 1]].astype(np.float32)
+        check(lambda a: tf.raw_ops.SoftmaxCrossEntropyWithLogits(
+            features=a, labels=tf.constant(labels))[0], SPEC34, [X34])
+
+    def test_sparse_softmax_xent_loss(self):
+        check(lambda a: tf.raw_ops.SparseSoftmaxCrossEntropyWithLogits(
+            features=a, labels=tf.constant([0, 2, 1], tf.int32))[0],
+            SPEC34, [X34])
+
+    def test_xent_backprop_output(self):
+        # output :1 is the gradient training-graph freezes consume
+        labels = np.eye(4)[[0, 2, 1]].astype(np.float32)
+        check(lambda a: tf.raw_ops.SoftmaxCrossEntropyWithLogits(
+            features=a, labels=tf.constant(labels))[1], SPEC34, [X34])
