@@ -1420,3 +1420,53 @@ def _rnn_wrapper(cfg, weights):
     ccfg["return_sequences"] = cfg.get("return_sequences", False)
     ccfg["go_backwards"] = cfg.get("go_backwards", False)
     return KerasLayerMapper.MAPPERS[layer](ccfg, weights)
+
+
+@KerasLayerMapper.register("EinsumDense")
+def _einsum_dense(cfg, weights):
+    """keras.layers.EinsumDense → nn.EinsumDenseLayer (the keras-nlp
+    transformer projection)."""
+    out_shape = cfg.get("output_shape")
+    out_shape = (tuple(out_shape) if isinstance(out_shape, (list, tuple))
+                 else (out_shape,))
+    # None entries are batch/sequence dims preserved by the equation —
+    # only concrete (weight-bearing) dims size the kernel
+    out_shape = tuple(s for s in out_shape if s is not None)
+    bias_axes = cfg.get("bias_axes")
+    lc = nn.EinsumDenseLayer(
+        equation=cfg["equation"], out_shape=tuple(int(s) for s in out_shape),
+        bias_shape=tuple(np.asarray(weights[1]).shape) if
+        (bias_axes and len(weights) > 1) else (),
+        activation=_act(cfg), name=cfg.get("name"))
+    p = {"W": weights[0]}
+    if bias_axes and len(weights) > 1:
+        p["b"] = weights[1]
+    return lc, p
+
+
+@KerasLayerMapper.register("RandomCrop")
+def _random_crop(cfg, weights):
+    # keras-3 inference semantics: RandomCrop is a PASSTHROUGH (it only
+    # crops in training; keras 2 did an aspect-crop+resize — models that
+    # relied on that must resize explicitly). Passthrough keeps parity
+    # with the installed keras and fails shapes loudly downstream exactly
+    # where keras itself would.
+    return nn.ActivationLayer(activation="identity",
+                              name=cfg.get("name")), {}
+
+
+def _keras_reject(name, why):
+    def mapper(cfg, weights):
+        raise NotImplementedError(
+            f"Keras layer '{name}': {why}. Apply this preprocessing outside "
+            f"the imported graph (DataVec transforms cover the same role).")
+
+    return mapper
+
+
+for _nm, _why in [
+        ("StringLookup", "string-tensor vocabularies are unsupported"),
+        ("Hashing", "string hashing is unsupported"),
+        ("TextVectorization", "string tokenization inside the graph is "
+                              "unsupported (use nlp.wordpiece)")]:
+    KerasLayerMapper.MAPPERS[_nm] = _keras_reject(_nm, _why)

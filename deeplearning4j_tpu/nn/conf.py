@@ -1304,6 +1304,35 @@ class RescaleLayer(LayerConf):
 
 
 @dataclasses.dataclass(frozen=True)
+class EinsumDenseLayer(LayerConf):
+    """Keras EinsumDense surface: out = einsum(equation, x, W) (+ bias on
+    ``bias_axes``). The workhorse projection of keras-nlp transformer
+    blocks; equation uses '...' for batch dims (e.g. '...d,de->...e')."""
+
+    equation: str = ""
+    out_shape: Tuple[int, ...] = ()      # W/output dims (no batch dims)
+    bias_shape: Tuple[int, ...] = ()     # () = no bias
+
+    def output_type(self, itype):
+        import math
+
+        eq = self.equation.replace(" ", "")
+        out_spec = eq.split("->")[1]
+        if itype.kind == "recurrent":
+            # '...' preserves the (batch, time) prefix; explicit specs keep
+            # recurrent shape only when the output is still rank-3
+            if "..." in out_spec or len(out_spec) >= 3:
+                return InputType.recurrent(int(self.out_shape[-1]),
+                                           itype.timesteps)
+            return InputType.feed_forward(int(self.out_shape[-1]))
+        return InputType.feed_forward(int(math.prod(self.out_shape))
+                                      if self.out_shape else itype.flat_size())
+
+    def has_params(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
 class UnitNormLayer(LayerConf):
     """L2-normalize along the trailing axis (Keras UnitNormalization)."""
 
@@ -1476,6 +1505,7 @@ class CenterCropLayer(LayerConf):
 LAYER_TYPES = {
     c.__name__: c
     for c in [
+        EinsumDenseLayer,
         DuelingQLayer,
         MoELayer,
         FusedBottleneck,

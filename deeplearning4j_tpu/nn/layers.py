@@ -323,6 +323,57 @@ class GlobalPoolingLayerImpl(Layer):
         return getattr(self.lc, "pnorm", 2)
 
 
+class EinsumDenseLayerImpl(Layer):
+    """conf.EinsumDenseLayer runtime (Keras EinsumDense parity): the
+    weight shape is the equation's rhs operand dims; bias broadcasts on
+    the declared bias shape."""
+
+    def init(self, key):
+        lc = self.lc
+        # rhs operand dims come from the equation's second input spec sized
+        # by (input feature dims, out_shape); Keras stores the built kernel
+        # shape — we derive it the same way from equation + out_shape
+        eq = lc.equation.replace(" ", "")
+        ins_, out = eq.split("->")
+        a_spec, b_spec = ins_.split(",")
+        sizes = {}
+        for ax, n in zip(reversed(out.replace("...", "")),
+                         reversed(lc.out_shape)):
+            sizes[ax] = int(n)
+        # input labels size from the ACTUAL input dims, right-aligned:
+        # recurrent → (timesteps, size), feedforward → (flat,); without
+        # '...' the leading a_spec label is the batch axis
+        if self.itype.kind == "recurrent":
+            in_dims = (self.itype.timesteps, self.itype.size)
+        else:
+            in_dims = (self.itype.flat_size(),)
+        labels_in = a_spec.replace("...", "")
+        if "..." not in a_spec:
+            labels_in = labels_in[1:]  # drop the explicit batch label
+        for ax, n in zip(reversed(labels_in), reversed(in_dims)):
+            sizes.setdefault(ax, int(n))
+        missing = [ax for ax in b_spec.replace("...", "") if ax not in sizes]
+        if missing:
+            raise ValueError(
+                f"EinsumDenseLayer: cannot size kernel labels {missing} "
+                f"from equation '{lc.equation}', out_shape {lc.out_shape} "
+                f"and input {self.itype} — give a fully-specified "
+                f"out_shape (every kernel-only label must appear in the "
+                f"output spec)")
+        w_shape = tuple(sizes[ax] for ax in b_spec.replace("...", ""))
+        p = {"W": init_weights(key, w_shape, self.winit, dtype=self.dtype)}
+        if lc.bias_shape:
+            p["b"] = jnp.zeros(tuple(lc.bias_shape), self.dtype)
+        return p
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train=train, rng=rng)
+        y = jnp.einsum(self.lc.equation, x, params["W"])
+        if "b" in params:
+            y = y + params["b"]
+        return self.activation(y), state, mask
+
+
 class DuelingQLayerImpl(Layer):
     """conf.DuelingQLayer runtime: Q = V + A − mean(A) (Wang et al.
     aggregation, the RL4J dueling head)."""
@@ -1700,6 +1751,7 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.GlobalPoolingLayer: GlobalPoolingLayerImpl,
     C.BatchNormalization: BatchNormalizationImpl,
     C.DuelingQLayer: DuelingQLayerImpl,
+    C.EinsumDenseLayer: EinsumDenseLayerImpl,
     C.LocalResponseNormalization: LocalResponseNormalizationImpl,
     C.ActivationLayer: ActivationLayerImpl,
     C.DropoutLayer: DropoutLayerImpl,

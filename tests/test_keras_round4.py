@@ -531,3 +531,43 @@ class TestLegacyRecurrentForms:
         net = import_keras(path)
         x = r.randn(2, 5, 3).astype(np.float32)
         assert net.output(x).shape == (2, 5, 4)
+
+
+class TestEinsumDense:
+    def test_matches_keras(self):
+        keras = tf.keras
+        try:
+            EinsumDense = keras.layers.EinsumDense
+        except AttributeError:
+            pytest.skip("no EinsumDense in this keras")
+        model = keras.Sequential([
+            keras.layers.Input((6,)),
+            EinsumDense("ab,bc->ac", output_shape=8, bias_axes="c",
+                        activation="relu"),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        net = import_keras_model(model)
+        assert_outputs_match(model, net, x)
+
+    def test_sequence_equation(self):
+        keras = tf.keras
+        try:
+            EinsumDense = keras.layers.EinsumDense
+        except AttributeError:
+            pytest.skip("no EinsumDense in this keras")
+        model = keras.Sequential([
+            keras.layers.Input((5, 6)),
+            EinsumDense("abc,cd->abd", output_shape=(None, 8),
+                        bias_axes="d"),
+        ])
+        x = np.random.RandomState(1).randn(2, 5, 6).astype(np.float32)
+        net = import_keras_model(model)
+        assert_outputs_match(model, net, x)
+
+    def test_einsum_dense_conf_roundtrip(self):
+        from deeplearning4j_tpu import nn
+        from deeplearning4j_tpu.nn import conf as C
+        lc = nn.EinsumDenseLayer(equation="ab,bc->ac", out_shape=(8,),
+                                 bias_shape=(8,))
+        assert C.LayerConf.from_dict(lc.to_dict()) == lc
