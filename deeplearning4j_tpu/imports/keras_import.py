@@ -1470,3 +1470,29 @@ for _nm, _why in [
         ("TextVectorization", "string tokenization inside the graph is "
                               "unsupported (use nlp.wordpiece)")]:
     KerasLayerMapper.MAPPERS[_nm] = _keras_reject(_nm, _why)
+
+
+@KerasLayerMapper.register("Discretization")
+def _discretization(cfg, weights):
+    bounds = cfg.get("bin_boundaries") or []
+    if not bounds:
+        raise NotImplementedError(
+            "Discretization without explicit bin_boundaries (adapt()-ed "
+            "state) — re-export with the learned boundaries in the config")
+    if list(bounds) != sorted(float(b) for b in bounds):
+        raise ValueError(
+            f"Discretization: bin_boundaries must be ascending, got "
+            f"{bounds} (searchsorted semantics require sorted bounds)")
+    return nn.DiscretizationLayer(
+        bin_boundaries=tuple(float(b) for b in bounds),
+        name=cfg.get("name")), {}
+
+
+@KerasLayerMapper.register("CategoryEncoding")
+def _category_encoding(cfg, weights):
+    mode = cfg.get("output_mode", "multi_hot")
+    if mode not in ("one_hot", "multi_hot", "count"):
+        raise NotImplementedError(f"CategoryEncoding output_mode={mode}")
+    return nn.CategoryEncodingLayer(
+        num_tokens=int(cfg["num_tokens"]), output_mode=mode,
+        name=cfg.get("name")), {}

@@ -1304,6 +1304,29 @@ class RescaleLayer(LayerConf):
 
 
 @dataclasses.dataclass(frozen=True)
+class DiscretizationLayer(LayerConf):
+    """Keras Discretization surface: values → bin indices (int32) by the
+    given boundaries; pairs with CategoryEncodingLayer for tabular nets."""
+
+    bin_boundaries: Tuple[float, ...] = ()
+
+    def output_type(self, itype):
+        return itype
+
+
+@dataclasses.dataclass(frozen=True)
+class CategoryEncodingLayer(LayerConf):
+    """Keras CategoryEncoding surface: int ids → one_hot / multi_hot /
+    count vectors of width num_tokens."""
+
+    num_tokens: int = 0
+    output_mode: str = "multi_hot"
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.num_tokens)
+
+
+@dataclasses.dataclass(frozen=True)
 class EinsumDenseLayer(LayerConf):
     """Keras EinsumDense surface: out = einsum(equation, x, W) (+ bias on
     ``bias_axes``). The workhorse projection of keras-nlp transformer
@@ -1505,6 +1528,8 @@ class CenterCropLayer(LayerConf):
 LAYER_TYPES = {
     c.__name__: c
     for c in [
+        CategoryEncodingLayer,
+        DiscretizationLayer,
         EinsumDenseLayer,
         DuelingQLayer,
         MoELayer,

@@ -323,6 +323,35 @@ class GlobalPoolingLayerImpl(Layer):
         return getattr(self.lc, "pnorm", 2)
 
 
+class DiscretizationLayerImpl(Layer):
+    """conf.DiscretizationLayer runtime: bucketize by static boundaries
+    (keras semantics: index = number of boundaries <= x)."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        bounds = jnp.asarray(self.lc.bin_boundaries, jnp.float32)
+        idx = jnp.searchsorted(bounds, x.astype(jnp.float32), side="right")
+        return idx.astype(jnp.int32), state, mask
+
+
+class CategoryEncodingLayerImpl(Layer):
+    """conf.CategoryEncodingLayer runtime: one_hot / multi_hot / count."""
+
+    def apply(self, params, x, state, *, train, rng, mask=None):
+        lc = self.lc
+        oh = jax.nn.one_hot(x.astype(jnp.int32), lc.num_tokens,
+                            dtype=jnp.float32)
+        if lc.output_mode == "one_hot":
+            # keras requires a trailing size-1 feature axis for one_hot and
+            # squeezes it: (N, 1) -> (N, num_tokens)
+            if oh.ndim >= 3 and oh.shape[-2] == 1:
+                oh = oh.squeeze(-2)
+            return oh, state, mask
+        agg = jnp.sum(oh, axis=-2) if oh.ndim >= 2 else oh
+        if lc.output_mode == "count":
+            return agg, state, mask
+        return jnp.minimum(agg, 1.0), state, mask  # multi_hot
+
+
 class EinsumDenseLayerImpl(Layer):
     """conf.EinsumDenseLayer runtime (Keras EinsumDense parity): the
     weight shape is the equation's rhs operand dims; bias broadcasts on
@@ -1752,6 +1781,8 @@ LAYER_IMPLS: Dict[Type[C.LayerConf], Type[Layer]] = {
     C.BatchNormalization: BatchNormalizationImpl,
     C.DuelingQLayer: DuelingQLayerImpl,
     C.EinsumDenseLayer: EinsumDenseLayerImpl,
+    C.DiscretizationLayer: DiscretizationLayerImpl,
+    C.CategoryEncodingLayer: CategoryEncodingLayerImpl,
     C.LocalResponseNormalization: LocalResponseNormalizationImpl,
     C.ActivationLayer: ActivationLayerImpl,
     C.DropoutLayer: DropoutLayerImpl,

@@ -571,3 +571,41 @@ class TestEinsumDense:
         lc = nn.EinsumDenseLayer(equation="ab,bc->ac", out_shape=(8,),
                                  bias_shape=(8,))
         assert C.LayerConf.from_dict(lc.to_dict()) == lc
+
+
+class TestTabularPreprocessing:
+    def test_discretization_category_encoding_chain(self):
+        keras = tf.keras
+        bounds = [0.0, 1.0, 2.0]
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Discretization(bin_boundaries=bounds),
+            keras.layers.CategoryEncoding(num_tokens=4,
+                                          output_mode="multi_hot"),
+        ])
+        x = np.asarray([[-1.0, 0.5, 1.5, 3.0],
+                        [0.0, 0.0, 2.5, 2.5]], np.float32)
+        net = import_keras_model(model)
+        golden = model(x).numpy()
+        np.testing.assert_allclose(net.output(x), golden, atol=1e-6)
+
+    def test_count_mode(self):
+        keras = tf.keras
+        model = keras.Sequential([
+            keras.layers.Input((5,)),
+            keras.layers.CategoryEncoding(num_tokens=3, output_mode="count"),
+        ])
+        x = np.asarray([[0, 0, 1, 2, 2], [1, 1, 1, 0, 2]], np.float32)
+        net = import_keras_model(model)
+        np.testing.assert_allclose(net.output(x), model(x).numpy(), atol=1e-6)
+
+    def test_one_hot_mode_squeezes(self):
+        keras = tf.keras
+        model = keras.Sequential([
+            keras.layers.Input((1,)),
+            keras.layers.CategoryEncoding(num_tokens=4,
+                                          output_mode="one_hot"),
+        ])
+        x = np.asarray([[0], [2], [3]], np.float32)
+        net = import_keras_model(model)
+        np.testing.assert_allclose(net.output(x), model(x).numpy(), atol=1e-6)
