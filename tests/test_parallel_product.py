@@ -236,3 +236,33 @@ class TestTransformerPipeline:
             tr.stacked_params, tr.head_params, tr.opt_state,
             jnp.asarray(0, jnp.int32), x, y).compile().as_text()
         assert "collective-permute" in hlo
+
+
+class TestMoEInComputationGraph:
+    def test_graph_aux_loss_and_convergence(self):
+        """MoELayer inside a ComputationGraph: the aux loss must flow
+        through the graph train step's loss closure (graph.py wiring is
+        separate from the MLN path) and training must converge."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, graph_builder
+        b = (graph_builder().seed(2).updater(nn.Adam(learning_rate=5e-3))
+             .add_inputs("in")
+             .set_input_types(**{"in": nn.InputType.feed_forward(8)}))
+        b.add_layer("d", nn.DenseLayer(n_out=8, activation="relu"), "in")
+        b.add_layer("moe", nn.MoELayer(d_hidden=16, n_experts=4, top_k=2,
+                                       activation="relu"), "d")
+        b.add_layer("out", nn.OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "moe")
+        b.set_outputs("out")
+        net = ComputationGraph(b.build()).init()
+        r = _rng(9)
+        x = r.randn(32, 8).astype(np.float32)
+        y = np.eye(3)[r.randint(0, 3, 32)].astype(np.float32)
+        first = None
+        for i in range(60):
+            net.fit(x, y)
+            if first is None:
+                first = net.score()
+        assert net.score() < first * 0.7, (first, net.score())
+        st = net.net_state["moe"]
+        assert float(st["_aux_loss"]) > 0.0
+        assert 0.0 <= float(st["_dropped_frac"]) <= 1.0
