@@ -2107,3 +2107,98 @@ def _tf_softmax_xent(sd, ins, attrs, node):
 @register_tf_op("SparseSoftmaxCrossEntropyWithLogits")
 def _tf_sparse_softmax_xent(sd, ins, attrs, node):
     return sd._record("tf_sparse_softmax_xent", ins[:2], n_out=2)
+
+
+# -- image-adjustment / resize / dynamic-partition tail ---------------------
+
+@register_tf_op("RGBToHSV")
+def _tf_rgb_to_hsv(sd, ins, attrs, node):
+    return sd._record("rgb_to_hsv", ins)
+
+
+@register_tf_op("HSVToRGB")
+def _tf_hsv_to_rgb(sd, ins, attrs, node):
+    return sd._record("hsv_to_rgb", ins)
+
+
+def _mk_scalar_image_op(ours, what):
+    def rule(sd, ins, attrs, node, const_values=None):
+        v = float(np.asarray(_require_const(const_values, node, 1, what)))
+        return sd._record(ours, [ins[0]], {what: v})
+
+    return rule
+
+
+TF_OP_MAPPERS["AdjustContrastv2"] = _mk_scalar_image_op("adjust_contrast",
+                                                        "factor")
+TF_OP_MAPPERS["AdjustHue"] = _mk_scalar_image_op("adjust_hue", "delta")
+TF_OP_MAPPERS["AdjustSaturation"] = _mk_scalar_image_op("adjust_saturation",
+                                                        "factor")
+for _r in ("AdjustContrastv2", "AdjustHue", "AdjustSaturation"):
+    _NEEDS_CONSTS.add(_r)
+
+
+@register_tf_op("ResizeBicubic")
+def _tf_resize_bicubic(sd, ins, attrs, node, const_values=None):
+    if not bool(attrs.get("half_pixel_centers", False)) \
+            or bool(attrs.get("align_corners", False)):
+        raise NotImplementedError(
+            "legacy ResizeBicubic (half_pixel_centers=false or "
+            "align_corners=true) import — re-export with tf.image.resize "
+            "(TF2 semantics)")
+    size = np.asarray(_require_const(const_values, node, 1, "size")).reshape(-1)
+    return sd._record("resize_bicubic", [ins[0]],
+                      {"size": (int(size[0]), int(size[1]))})
+
+
+_NEEDS_CONSTS.add("ResizeBicubic")
+
+
+@register_tf_op("DynamicPartition")
+def _tf_dynamic_partition(sd, ins, attrs, node):
+    raise NotImplementedError(
+        f"DynamicPartition {node.name}: per-partition output sizes are "
+        f"data-dependent, which XLA's static shapes cannot express. The "
+        f"catalog op 'dynamic_partition' offers the padded+mask form for "
+        f"hand-built graphs; restructure the imported model (boolean "
+        f"masking or segment ops usually substitute).")
+
+
+if "stitch_pair" not in _GRAPH_OPS:
+    def _stitch_pair_impl(*args):
+        from deeplearning4j_tpu.ops import exec_op
+
+        half = len(args) // 2
+        return exec_op("dynamic_stitch", list(args[:half]),
+                       list(args[half:]))
+
+    _GRAPH_OPS["stitch_pair"] = _stitch_pair_impl
+
+
+@register_tf_op("DynamicStitch")
+@register_tf_op("ParallelDynamicStitch")
+def _tf_dynamic_stitch(sd, ins, attrs, node, const_values=None):
+    n = int(attrs.get("N", len(ins) // 2))
+    # the catalog op sizes the output by TOTAL index count; that matches TF
+    # only when the indices form a dense 0..n-1 permutation — validate when
+    # the index operands are constants (the frozen-graph norm), reject
+    # otherwise rather than silently mis-shape
+    idx_vals = [(const_values or {}).get(node.input[i].split(":")[0])
+                for i in range(n)]
+    if all(v is not None for v in idx_vals):
+        flat = np.concatenate([np.asarray(v).reshape(-1) for v in idx_vals]) \
+            if idx_vals else np.zeros(0, np.int64)
+        if sorted(flat.tolist()) != list(range(len(flat))):
+            raise NotImplementedError(
+                f"DynamicStitch {node.name}: indices {sorted(flat.tolist())} "
+                f"are not a dense permutation — duplicate/sparse index "
+                f"semantics (later-wins, implicit zero rows) are unsupported")
+    else:
+        raise NotImplementedError(
+            f"DynamicStitch {node.name}: non-constant index operands — "
+            f"cannot validate the dense-permutation requirement at import")
+    return sd._record("stitch_pair", list(ins[:n]) + list(ins[n:2 * n]))
+
+
+_NEEDS_CONSTS.add("DynamicStitch")
+_NEEDS_CONSTS.add("ParallelDynamicStitch")

@@ -233,3 +233,40 @@ class TestSoftmaxXent:
         labels = np.eye(4)[[0, 2, 1]].astype(np.float32)
         check(lambda a: tf.raw_ops.SoftmaxCrossEntropyWithLogits(
             features=a, labels=tf.constant(labels))[1], SPEC34, [X34])
+
+
+class TestImageAndDynamicOps:
+    def test_adjust_and_hsv_chain(self):
+        x = R.rand(1, 4, 4, 3).astype(np.float32)
+        check(lambda a: tf.image.hsv_to_rgb(tf.image.rgb_to_hsv(
+            tf.image.adjust_saturation(tf.image.adjust_contrast(a, 1.3),
+                                       0.8))),
+            tf.TensorSpec([1, 4, 4, 3], tf.float32), [x])
+
+    def test_resize_bicubic(self):
+        x = R.rand(1, 4, 4, 2).astype(np.float32)
+        check(lambda a: tf.image.resize(a, [8, 8], method="bicubic"),
+              tf.TensorSpec([1, 4, 4, 2], tf.float32), [x])
+
+    def test_sparse_stitch_rejected(self):
+        def model(a):
+            return tf.dynamic_stitch(
+                [tf.constant([0, 3])], [a[:2]])  # sparse: skips 1, 2
+        gd, ins, outs = freeze(model, SPEC34)
+        with pytest.raises(NotImplementedError, match="dense permutation"):
+            TensorflowImporter().run_import(gd)
+
+    def test_dynamic_stitch(self):
+        # interleave two row sets by explicit index lists (the static-shape
+        # form; DynamicPartition itself is a documented reject)
+        def model(a):
+            return tf.dynamic_stitch(
+                [tf.constant([0, 2]), tf.constant([1])], [a[:2], a[2:]])
+        check(model, SPEC34, [X34])
+
+    def test_dynamic_partition_rejected(self):
+        def model(a):
+            return tf.dynamic_partition(a, tf.constant([0, 1, 0]), 2)[0]
+        gd, ins, outs = freeze(model, SPEC34)
+        with pytest.raises(NotImplementedError, match="DynamicPartition"):
+            TensorflowImporter().run_import(gd)
