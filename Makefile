@@ -1,6 +1,16 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench native native-test
+.PHONY: test gate gate-fast bench native native-test lint lint-baseline
+
+# graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
+# findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
+# the registry-consistency rules can never hang on an unreachable TPU.
+lint:
+	JAX_PLATFORMS=cpu python tools/graftlint.py
+
+# regenerate the baseline (after FIXING findings — the baseline only shrinks)
+lint-baseline:
+	JAX_PLATFORMS=cpu python tools/graftlint.py --write-baseline
 
 # DL4J_TPU_REQUIRE_NATIVE=1: a missing native lib FAILS the ctypes tests
 # instead of silently exercising the numpy fallback (SURVEY §5.3)

@@ -1350,9 +1350,13 @@ def _cudnn_lstm(cfg, weights):
     w = list(weights)
     if len(w) > 2:
         b = np.asarray(w[2])
+        units = int(cfg.get("units", 0))
         if b.ndim == 2:                      # (2, 4H)
             b = b[0] + b[1]
-        elif b.size % 8 == 0 and b.ndim == 1:  # (8H,)
+        elif b.ndim == 1 and units and b.size == 8 * units:  # (8H,)
+            # only an exact 8H stack is the CuDNN input+recurrent pair; a
+            # fused (4H,) bias with even H is also divisible by 8 and must
+            # pass through unchanged (round-5 advice)
             half = b.size // 2
             b = b[:half] + b[half:]
         w[2] = b

@@ -1465,13 +1465,23 @@ def _onnx_scatter_nd(sd, ins, attrs, node):
 
 
 @_graph_op("onnx_nms")
-def _onnx_nms_impl(boxes, scores, *, max_out, iou_threshold, score_threshold):
+def _onnx_nms_impl(boxes, scores, *, max_out, iou_threshold, score_threshold,
+                   center_point_box=0):
     """ONNX NonMaxSuppression with STATIC output: (B*C*max_out, 3) index
     triples [batch, class, box], padded with -1 (the reference emits a
-    dynamic-length list; XLA cannot — the pad rows carry the same info)."""
+    dynamic-length list; XLA cannot — the pad rows carry the same info).
+
+    center_point_box=1 (the torchvision export form) supplies boxes as
+    [x_center, y_center, width, height]; the kernel consumes corner
+    coordinates, so convert up front."""
     from deeplearning4j_tpu.ops.image_ops import non_max_suppression as nms
 
     nms_fn = getattr(nms, "fn", nms)
+    if center_point_box:
+        xc, yc, w, h = (boxes[..., 0], boxes[..., 1],
+                        boxes[..., 2], boxes[..., 3])
+        boxes = _jnp.stack([yc - h / 2, xc - w / 2,
+                            yc + h / 2, xc + w / 2], axis=-1)
     b, n, _ = boxes.shape
     c = scores.shape[1]
     rows = []
@@ -1499,9 +1509,14 @@ def _onnx_nms(sd, ins, attrs, node, const_values=None):
         raise NotImplementedError(
             f"NonMaxSuppression {node.name}: max_output_boxes_per_class must "
             f"be a positive constant (static shapes)")
+    cpb = int(attrs.get("center_point_box", 0))
+    if cpb not in (0, 1):
+        raise NotImplementedError(
+            f"NonMaxSuppression {node.name}: center_point_box={cpb} "
+            f"(spec allows only 0 or 1)")
     return sd._record("onnx_nms", list(ins[:2]),
                       {"max_out": mo, "iou_threshold": iou,
-                       "score_threshold": sc})
+                       "score_threshold": sc, "center_point_box": cpb})
 
 
 _NEEDS_CONSTS.add("NonMaxSuppression")

@@ -1,0 +1,23 @@
+"""graftlint — JAX-footgun static analysis wired into the gate.
+
+The suite-time analysis the round-5 verdict asked for: tracer leaks,
+import-time backend probes (the driver-hang class), side effects under jit,
+PRNG reuse, registry shadowing, and README surface-count drift — reported
+with rule IDs and diffed against a committed, shrink-only baseline.
+
+Rule catalog and workflow: docs/LINT.md.  CLI: ``python -m
+deeplearning4j_tpu.lint`` or ``make lint``.
+
+Importing this package (and running the AST rules) needs no jax; only the
+consistency rules in ``rules_consistency`` load the live registries.
+"""
+
+from deeplearning4j_tpu.lint.core import (  # noqa: F401
+    AST_RULES, Finding, diff_baseline, iter_py_files, lint_paths,
+    lint_source, load_baseline, write_baseline)
+
+# register the AST rules on import
+from deeplearning4j_tpu.lint import rules_ast  # noqa: F401
+
+__all__ = ["AST_RULES", "Finding", "diff_baseline", "iter_py_files",
+           "lint_paths", "lint_source", "load_baseline", "write_baseline"]

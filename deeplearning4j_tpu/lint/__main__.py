@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.lint.cli import main
+
+main()

@@ -1,0 +1,152 @@
+"""graftlint CLI.
+
+    python -m deeplearning4j_tpu.lint [paths...] [options]
+    python tools/graftlint.py          # identical thin wrapper
+
+Options:
+    --baseline PATH    baseline file (default: <repo>/lint_baseline.json)
+    --write-baseline   regenerate the baseline from the current findings
+                       (shrink-only: findings not already grandfathered are
+                       REFUSED and exit 1 — see --allow-growth)
+    --allow-growth     allow --write-baseline to add new keys/counts (only
+                       for onboarding a brand-new rule)
+    --json             emit exactly ONE machine-readable JSON summary line
+                       (the driver-artifact contract tools/gate.py relies on)
+    --no-consistency   AST rules only (skip registry-loading rules — for
+                       environments without jax)
+    --list-rules       print the rule catalog and exit
+
+Exit code 0 iff there are no findings beyond the grandfathered baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from deeplearning4j_tpu.lint.core import (
+    AST_RULES, Finding, diff_baseline, lint_paths, load_baseline,
+    write_baseline)
+
+DEFAULT_ROOTS = ("deeplearning4j_tpu", "tools", "examples")
+
+
+def find_repo_root(start: Optional[str] = None) -> str:
+    """Walk up from this file to the directory holding the package — the
+    lint paths and baseline are repo-relative."""
+    here = start or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return here
+
+
+def run(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--baseline", default=None)
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--allow-growth", action="store_true",
+                    help="let --write-baseline add NEW keys/counts (only "
+                         "for onboarding a brand-new rule; the default "
+                         "refuses growth so regenerating can never "
+                         "grandfather a regression)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--no-consistency", action="store_true")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    subset = bool(args.paths)
+    if subset and args.write_baseline and not args.baseline:
+        # a subset scan misses every baselined finding outside the subset;
+        # writing it over the repo-wide baseline would make the next full
+        # run report all of those as NEW
+        ap.error("--write-baseline with explicit paths would overwrite the "
+                 "repo-wide baseline with a subset scan; pass --baseline "
+                 "to write elsewhere or drop the path arguments")
+
+    if args.list_rules:
+        from deeplearning4j_tpu.lint.rules_consistency import CONSISTENCY_RULES
+        for rid, (_fn, desc) in sorted({**AST_RULES, **CONSISTENCY_RULES}.items()):
+            print(f"{rid}  {desc}")
+        return 0
+
+    repo_root = find_repo_root()
+    roots = list(args.paths) if args.paths else list(DEFAULT_ROOTS)
+    baseline_path = args.baseline or os.path.join(repo_root,
+                                                  "lint_baseline.json")
+
+    findings: List[Finding] = lint_paths(roots, repo_root)
+    if not args.no_consistency:
+        # the consistency rules load the live registries (and thus jax);
+        # pin the CPU backend so lint can NEVER hang on an unreachable TPU
+        # (the ambient sitecustomize pins the platform at startup, so the
+        # env var alone is not enough — conftest.py has the same dance)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except ImportError:
+            pass
+        from deeplearning4j_tpu.lint.rules_consistency import run_consistency
+        findings.extend(run_consistency(repo_root))
+    findings.sort()
+
+    if args.write_baseline:
+        refused = write_baseline(baseline_path, findings,
+                                 allow_growth=args.allow_growth)
+        kept = len(findings) - sum(refused.values())
+        if args.json:   # keep the one-JSON-line contract in every mode
+            print(json.dumps({"tool": "graftlint", "wrote_baseline": True,
+                              "total": kept,
+                              "refused_growth": sum(refused.values()),
+                              "baseline_path": baseline_path}, sort_keys=True))
+        else:
+            print(f"graftlint: wrote {kept} grandfathered findings "
+                  f"to {baseline_path}")
+            for key, n in sorted(refused.items()):
+                print(f"graftlint: REFUSED to grandfather new finding "
+                      f"(x{n}): {key}")
+            if refused:
+                print("graftlint: fix the refused findings (or, only when "
+                      "onboarding a new rule, re-run with --allow-growth)")
+        return 1 if refused else 0
+
+    baseline = load_baseline(baseline_path)
+    new, fixed = diff_baseline(findings, baseline)
+    if subset:
+        # baseline entries outside the scanned paths are "missing", not
+        # fixed — report none in either output mode
+        fixed = []
+
+    if args.json:
+        # ONE parsable line — the gate/driver artifact contract
+        print(json.dumps({
+            "tool": "graftlint",
+            "total": len(findings),
+            "baselined": len(findings) - len(new),
+            "new": len(new),
+            "fixed_baseline_keys": len(fixed),
+            "findings": [f.as_dict() for f in new[:50]],
+        }, sort_keys=True))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if fixed:
+        print(f"graftlint: {len(fixed)} baseline entr"
+              f"{'y is' if len(fixed) == 1 else 'ies are'} fixed — run "
+              f"--write-baseline to shrink the baseline")
+    print(f"graftlint: {len(findings)} findings "
+          f"({len(findings) - len(new)} grandfathered, {len(new)} new)")
+    if new:
+        print("graftlint: FAIL — fix the new findings above or (only with "
+              "a written justification) add a 'graftlint: disable=<RULE>' "
+              "comment")
+        return 1
+    return 0
+
+
+def main() -> None:
+    sys.exit(run())

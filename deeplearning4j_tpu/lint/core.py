@@ -1,0 +1,207 @@
+"""graftlint core: finding model, file walker, suppression, baseline diff.
+
+The analysis itself lives in ``rules_ast`` (pure-AST rules, no jax import)
+and ``rules_consistency`` (rules that load the live registries). This module
+is deliberately dependency-free so fixture-level unit tests can lint source
+snippets without touching a backend.
+
+Baseline contract (the "grandfather" mechanism — VERDICT round 5, items 4/8):
+``lint_baseline.json`` maps a *stable key* (rule|path|message — no line
+numbers, so unrelated edits don't invalidate entries) to the number of
+grandfathered occurrences. The suite fails only on findings **above** the
+baselined count; entries whose count has dropped are reported as *fixed* so
+the baseline can shrink (``--write-baseline`` regenerates it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("error", "warning")
+
+# directories never walked (build trees, caches, VCS)
+_SKIP_DIRS = {"__pycache__", ".git", "build", ".pytest_cache", "node_modules",
+              ".claude"}
+
+_DISABLE_RE = re.compile(r"graftlint:\s*disable(?:=([A-Z0-9, ]+))?")
+_SKIP_FILE_RE = re.compile(r"graftlint:\s*skip-file")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint hit. ``key`` excludes the line number on purpose: baseline
+    entries must survive unrelated edits above the flagged line."""
+
+    path: str          # repo-relative, forward slashes
+    line: int
+    rule: str          # e.g. "GL001"
+    severity: str      # "error" | "warning"
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.severity}: {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "severity": self.severity, "message": self.message}
+
+
+# rule id -> (callable(tree, lines, path) -> findings, one-line description)
+AST_RULES: Dict[str, Tuple[Callable[..., List[Finding]], str]] = {}
+
+
+def ast_rule(rule_id: str, description: str):
+    """Decorator registering a pure-AST rule."""
+
+    def wrap(fn):
+        AST_RULES[rule_id] = (fn, description)
+        fn.rule_id = rule_id
+        fn.description = description
+        return fn
+
+    return wrap
+
+
+def _suppressed_lines(lines: Sequence[str]) -> Dict[int, Optional[set]]:
+    """Map 1-based line -> set of suppressed rule ids (None = all rules)."""
+    out: Dict[int, Optional[set]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        if m.group(1):
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        else:
+            out[i] = None
+    return out
+
+
+def lint_source(src: str, path: str = "<fixture>",
+                rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the AST rules over one source string. The fixture-test entry
+    point; also the per-file worker for :func:`lint_paths`."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        return [Finding(path=path, line=exc.lineno or 1, rule="GL000",
+                        severity="error",
+                        message=f"syntax error: {exc.msg}")]
+    lines = src.splitlines()
+    head = "\n".join(lines[:5])
+    if _SKIP_FILE_RE.search(head):
+        return []
+    suppressed = _suppressed_lines(lines)
+    wanted = set(rules) if rules is not None else None
+    findings: List[Finding] = []
+    for rule_id, (fn, _desc) in sorted(AST_RULES.items()):
+        if wanted is not None and rule_id not in wanted:
+            continue
+        for f in fn(tree, lines, path):
+            sup = suppressed.get(f.line, ())
+            if sup is None or (sup and f.rule in sup):
+                continue
+            findings.append(f)
+    return sorted(findings)
+
+
+def iter_py_files(roots: Sequence[str], repo_root: str) -> List[str]:
+    """Repo-relative paths of every .py file under ``roots`` (files or
+    directories), deterministic order."""
+    out: List[str] = []
+    for root in roots:
+        absroot = os.path.join(repo_root, root)
+        if os.path.isfile(absroot) and root.endswith(".py"):
+            # normalize like the directory branch: Finding.path must be
+            # repo-relative or baseline keys never match
+            out.append(os.path.relpath(absroot, repo_root).replace(os.sep, "/"))
+            continue
+        for dirpath, dirnames, filenames in os.walk(absroot):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), repo_root)
+                    out.append(rel.replace(os.sep, "/"))
+    return sorted(set(out))
+
+
+def lint_paths(roots: Sequence[str], repo_root: str,
+               rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in iter_py_files(roots, repo_root):
+        with open(os.path.join(repo_root, rel), "r", encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(lint_source(src, path=rel, rules=rules))
+    return sorted(findings)
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   allow_growth: bool = False) -> Dict[str, int]:
+    """Write the baseline; shrink-only by default. Findings whose key is
+    absent from (or whose count exceeds) the EXISTING baseline are refused
+    — returned to the caller instead of written — so regenerating the
+    baseline can never silently grandfather a regression. ``allow_growth``
+    is the explicit escape hatch for onboarding a brand-new rule."""
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    refused: Dict[str, int] = {}
+    if not allow_growth and os.path.exists(path):
+        old = load_baseline(path)
+        for key in sorted(counts):
+            allowed = old.get(key, 0)
+            if counts[key] > allowed:
+                refused[key] = counts[key] - allowed
+                if allowed:
+                    counts[key] = allowed
+                else:
+                    del counts[key]
+    payload = {
+        "comment": "graftlint grandfathered findings — every entry is debt; "
+                   "shrink, never grow. Regenerate: make lint-baseline",
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return refused
+
+
+def diff_baseline(findings: Sequence[Finding], baseline: Dict[str, int]
+                  ) -> Tuple[List[Finding], List[str]]:
+    """Return (new findings beyond the grandfathered counts, baseline keys
+    now fully or partially fixed)."""
+    by_key: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.key, []).append(f)
+    new: List[Finding] = []
+    for key, fs in by_key.items():
+        allowed = baseline.get(key, 0)
+        if len(fs) > allowed:
+            # report the excess occurrences (latest lines first is arbitrary;
+            # keep source order for readability)
+            new.extend(sorted(fs)[allowed:])
+    fixed = sorted(k for k, n in baseline.items()
+                   if len(by_key.get(k, ())) < n)
+    return sorted(new), fixed

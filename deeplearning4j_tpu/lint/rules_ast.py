@@ -1,0 +1,439 @@
+"""graftlint AST rules — the JAX footguns this codebase actually hits.
+
+GL001  host-sync / tracer-leak calls inside jit-traced functions
+GL002  unguarded backend probes (jax.devices & co) — the round-5 driver hang
+GL003  Python side effects under jit (print, global/nonlocal mutation)
+GL004  PRNG key reuse without split
+GL005  mutable default arguments in public APIs
+GL007  bare except / swallowed exceptions
+
+(GL006 and GL008 live in rules_consistency — they need the live registries.)
+
+Every rule is deliberately conservative: a static pass that cries wolf gets
+deleted from the gate within two rounds. Heuristics and their blind spots
+are documented per-rule in docs/LINT.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.lint.core import Finding, ast_rule
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.experimental.pjit.pjit' for nested Attribute/Name, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for expressions denoting jax.jit/pjit (bare, dotted, or wrapped
+    in functools.partial(jax.jit, ...))."""
+    d = _dotted(node)
+    if d is not None and d.split(".")[-1] in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fd = _dotted(node.func)
+        if fd is not None and fd.split(".")[-1] in _JIT_NAMES:
+            return True  # jax.jit(static_argnums=...) used as decorator
+        if fd is not None and fd.split(".")[-1] == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def _jit_functions(tree: ast.Module) -> List[ast.FunctionDef]:
+    """Functions traced by jit: decorated with jit/pjit (possibly via
+    partial), or a local def later wrapped as ``g = jax.jit(f)`` /
+    passed directly to a jit call."""
+    defs: Dict[str, ast.FunctionDef] = {}
+    jitted: List[ast.FunctionDef] = []
+    seen: Set[int] = set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+            if any(_is_jit_expr(dec) for dec in node.decorator_list):
+                if id(node) not in seen:
+                    seen.add(id(node))
+                    jitted.append(node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    fn = defs[arg.id]
+                    if id(fn) not in seen:
+                        seen.add(id(fn))
+                        jitted.append(fn)
+    return jitted
+
+
+_NUMPY_ALIASES = {"np", "numpy", "onp", "_np", "_numpy"}
+
+
+# ---------------------------------------------------------------------------
+# GL001 — host sync under jit
+# ---------------------------------------------------------------------------
+
+
+@ast_rule("GL001", "host-sync/tracer-leak call inside a jit-traced function")
+def rule_host_sync(tree, lines, path) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in _jit_functions(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                base = _dotted(f.value)
+                if f.attr in ("asarray", "array") and base in _NUMPY_ALIASES:
+                    findings.append(Finding(
+                        path=path, line=node.lineno, rule="GL001",
+                        severity="error",
+                        message=f"{base}.{f.attr}() inside jit-traced "
+                                f"'{fn.name}' forces a host sync / tracer "
+                                f"leak; use jnp.{f.attr} or hoist out of "
+                                f"the traced path"))
+                elif f.attr in ("item", "tolist") and not node.args:
+                    findings.append(Finding(
+                        path=path, line=node.lineno, rule="GL001",
+                        severity="error",
+                        message=f".{f.attr}() inside jit-traced '{fn.name}' "
+                                f"blocks on device and fails under trace"))
+            elif (isinstance(f, ast.Name) and f.id in ("float", "int")
+                  and len(node.args) == 1
+                  and not isinstance(node.args[0], ast.Constant)):
+                findings.append(Finding(
+                    path=path, line=node.lineno, rule="GL001",
+                    severity="warning",
+                    message=f"{f.id}() on a traced value inside jit-traced "
+                            f"'{fn.name}' concretizes the tracer"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL002 — unguarded backend probes
+# ---------------------------------------------------------------------------
+
+_PROBES = {"devices", "local_devices", "device_count", "local_device_count"}
+
+
+def _mentions_subprocess_or_timeout(fn: ast.AST) -> bool:
+    """Guard heuristic: the enclosing function routes the probe through a
+    subprocess or bounds it with a timeout (the gate.py has_tpu pattern)."""
+    for node in ast.walk(fn):
+        d = _dotted(node) if isinstance(node, (ast.Name, ast.Attribute)) else None
+        if d and ("subprocess" in d.split(".") or "Popen" in d.split(".")):
+            return True
+        if isinstance(node, ast.keyword) and node.arg == "timeout":
+            return True
+        if isinstance(node, ast.Call):
+            fd = _dotted(node.func)
+            if fd and fd.split(".")[-1] in ("wait_for", "alarm"):
+                return True
+    return False
+
+
+@ast_rule("GL002", "unguarded backend probe (jax.devices & co)")
+def rule_backend_probe(tree, lines, path) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # enclosing-function map: node id -> innermost FunctionDef
+    enclosing: Dict[int, Optional[ast.AST]] = {}
+
+    def visit(node: ast.AST, fn: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            enclosing[id(child)] = fn
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                visit(child, child)
+            else:
+                visit(child, fn)
+
+    visit(tree, None)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d is None:
+            continue
+        parts = d.split(".")
+        if not (len(parts) >= 2 and parts[0] == "jax" and parts[-1] in _PROBES):
+            continue
+        fn = enclosing.get(id(node))
+        if fn is None:
+            findings.append(Finding(
+                path=path, line=node.lineno, rule="GL002", severity="error",
+                message=f"jax.{parts[-1]}() at import time initializes the "
+                        f"backend and can hang on an unreachable TPU; move "
+                        f"into a function behind a subprocess/timeout guard"))
+        elif not _mentions_subprocess_or_timeout(fn):
+            name = getattr(fn, "name", "<lambda>")
+            findings.append(Finding(
+                path=path, line=node.lineno, rule="GL002", severity="warning",
+                message=f"jax.{parts[-1]}() in '{name}' has no "
+                        f"subprocess/timeout guard; an unreachable backend "
+                        f"hangs the caller (round-5 driver hang)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL003 — Python side effects under jit
+# ---------------------------------------------------------------------------
+
+
+@ast_rule("GL003", "Python side effect inside a jit-traced function")
+def rule_side_effects(tree, lines, path) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in _jit_functions(tree):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id == "print":
+                findings.append(Finding(
+                    path=path, line=node.lineno, rule="GL003",
+                    severity="warning",
+                    message=f"print() inside jit-traced '{fn.name}' runs at "
+                            f"trace time only; use jax.debug.print"))
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+                findings.append(Finding(
+                    path=path, line=node.lineno, rule="GL003",
+                    severity="error",
+                    message=f"{kind} mutation inside jit-traced '{fn.name}' "
+                            f"is a trace-time side effect (stale after the "
+                            f"first compile)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL004 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+# jax.random functions that CONSUME a key (same key twice => identical or
+# correlated draws). Non-consuming: split/fold_in/key construction/inspection.
+_NON_CONSUMING = {"split", "fold_in", "PRNGKey", "key", "wrap_key_data",
+                  "clone", "key_data", "key_impl"}
+
+
+def _jax_random_aliases(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(dotted prefixes bound to jax.random, bare function names imported
+    from it) — so stdlib ``random`` never triggers the rule."""
+    prefixes: Set[str] = set()
+    bare: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    prefixes.add((a.asname or "jax") + ".random")
+                elif a.name == "jax.random":
+                    prefixes.add(a.asname or "jax.random")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        prefixes.add(a.asname or "random")
+            elif node.module == "jax.random":
+                for a in node.names:
+                    bare.add(a.asname or a.name)
+    return prefixes, bare
+
+
+def _rebound_names(stmt: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.withitem) and stmt.optional_vars is not None:
+        targets = [stmt.optional_vars]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+    return out
+
+
+class _KeyReuseScanner:
+    """Branch-aware scan: mutually exclusive If/Try arms get independent
+    copies of the consumed-key state (the weight-init dispatch pattern —
+    twenty `if scheme == ...: return jax.random.normal(key, ...)` arms —
+    is one consumption per call, not twenty). Uses inside a branch do not
+    propagate out: precision over recall — a gate rule that cries wolf
+    gets deleted."""
+
+    def __init__(self, prefixes: Set[str], bare: Set[str], fn_name: str,
+                 path: str):
+        self.prefixes, self.bare = prefixes, bare
+        self.fn_name, self.path = fn_name, path
+        self.findings: List[Finding] = []
+
+    def _leaf(self, call: ast.Call) -> Optional[str]:
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        if d in self.bare:
+            return d
+        head, _, tail = d.rpartition(".")
+        return tail if head in self.prefixes else None
+
+    def _expr(self, node: Optional[ast.AST], consumed: Dict[str, int]) -> None:
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue  # different scope (walk still descends; acceptable)
+            if not isinstance(sub, ast.Call):
+                continue
+            leaf = self._leaf(sub)
+            if leaf is None or not sub.args:
+                continue
+            arg = sub.args[0]           # key is arg 0 by convention
+            if not isinstance(arg, ast.Name):
+                continue
+            if leaf in _NON_CONSUMING:
+                consumed.pop(arg.id, None)
+            elif arg.id in consumed:
+                # message stays line-number-free: it is part of the
+                # baseline key, which must survive unrelated edits
+                self.findings.append(Finding(
+                    path=self.path, line=arg.lineno, rule="GL004",
+                    severity="error",
+                    message=f"PRNG key '{arg.id}' in '{self.fn_name}' "
+                            f"consumed again without jax.random.split — "
+                            f"draws are identical/correlated"))
+            else:
+                consumed[arg.id] = arg.lineno
+
+    def block(self, stmts: Sequence[ast.stmt], consumed: Dict[str, int]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                self._expr(stmt.test, consumed)
+                self.block(stmt.body, dict(consumed))
+                self.block(stmt.orelse, dict(consumed))
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr(stmt.iter, consumed)
+                body_state = dict(consumed)
+                for name in _rebound_names(stmt):
+                    body_state.pop(name, None)
+                self.block(stmt.body, body_state)
+                self.block(stmt.orelse, dict(consumed))
+            elif isinstance(stmt, ast.While):
+                self._expr(stmt.test, consumed)
+                self.block(stmt.body, dict(consumed))
+                self.block(stmt.orelse, dict(consumed))
+            elif isinstance(stmt, ast.Try):
+                self.block(stmt.body, dict(consumed))
+                for h in stmt.handlers:
+                    self.block(h.body, dict(consumed))
+                self.block(stmt.orelse, dict(consumed))
+                self.block(stmt.finalbody, dict(consumed))
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._expr(item.context_expr, consumed)
+                    if item.optional_vars is not None:
+                        for name in _rebound_names(item):
+                            consumed.pop(name, None)
+                self.block(stmt.body, consumed)   # runs exactly once
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue  # nested scope: scanned by its own pass
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.expr):
+                        self._expr(child, consumed)
+                for name in _rebound_names(stmt):
+                    consumed.pop(name, None)
+
+
+@ast_rule("GL004", "PRNG key consumed twice without split")
+def rule_key_reuse(tree, lines, path) -> List[Finding]:
+    findings: List[Finding] = []
+    prefixes, bare = _jax_random_aliases(tree)
+    if not prefixes and not bare:
+        return findings
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scanner = _KeyReuseScanner(prefixes, bare, fn.name, path)
+        scanner.block(fn.body, {})
+        findings.extend(scanner.findings)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL005 — mutable default arguments in public APIs
+# ---------------------------------------------------------------------------
+
+
+@ast_rule("GL005", "mutable default argument in a public API")
+def rule_mutable_defaults(tree, lines, path) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name.startswith("_"):
+            continue
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None]
+        for d in defaults:
+            bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set"))
+            if bad:
+                findings.append(Finding(
+                    path=path, line=d.lineno, rule="GL005",
+                    severity="warning",
+                    message=f"mutable default argument in public "
+                            f"'{fn.name}' is shared across calls; default "
+                            f"to None and build inside"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL007 — bare / swallowed exceptions
+# ---------------------------------------------------------------------------
+
+_BROAD = {"Exception", "BaseException"}
+
+
+@ast_rule("GL007", "bare except / swallowed exception")
+def rule_bare_except(tree, lines, path) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            findings.append(Finding(
+                path=path, line=node.lineno, rule="GL007", severity="error",
+                message="bare 'except:' catches KeyboardInterrupt/SystemExit;"
+                        " name the exception"))
+            continue
+        type_name = _dotted(node.type)
+        broad = type_name is not None and type_name.split(".")[-1] in _BROAD
+        body_is_pass = all(isinstance(s, ast.Pass) for s in node.body)
+        if broad and body_is_pass:
+            findings.append(Finding(
+                path=path, line=node.lineno, rule="GL007", severity="warning",
+                message=f"'except {type_name}: pass' swallows every error "
+                        f"silently; log or narrow it"))
+    return findings

@@ -1,0 +1,133 @@
+"""graftlint consistency rules — checks against the LIVE registries.
+
+GL006  GRAPH_OPS-vs-declarable-registry shadowing (VERDICT round 5, item 4)
+GL008  README surface-count drift (VERDICT round 5, items 5/8)
+
+Unlike the AST rules these import the package (and therefore jax), so they
+only run in repo mode — ``lint_source`` fixtures never touch them. Callers
+must pin JAX_PLATFORMS=cpu (the Makefile/gate do) so importing the package
+can never block on an unreachable TPU — exactly the footgun GL002 polices.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Callable, Dict, List, Tuple
+
+from deeplearning4j_tpu.lint.core import Finding
+
+CONSISTENCY_RULES: Dict[str, Tuple[Callable[[str], List[Finding]], str]] = {}
+
+
+def consistency_rule(rule_id: str, description: str):
+    def wrap(fn):
+        CONSISTENCY_RULES[rule_id] = (fn, description)
+        fn.rule_id = rule_id
+        fn.description = description
+        return fn
+
+    return wrap
+
+
+def _grep_line(path: str, needle: str) -> int:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for i, text in enumerate(fh, start=1):
+                if needle in text:
+                    return i
+    except OSError:
+        pass
+    return 1
+
+
+@consistency_rule("GL006", "GRAPH_OPS silently shadows a declarable-registry op")
+def rule_registry_shadowing(repo_root: str) -> List[Finding]:
+    """Every GRAPH_OPS key that duplicates a registry op must sit on the
+    explicit REGISTRY_SHADOW_WHITELIST — and the whitelist must carry no
+    stale entries, so it only ever shrinks with the debt."""
+    # importers mutate GRAPH_OPS at import time (identity, tf_* helpers);
+    # settle the full surface before comparing
+    import deeplearning4j_tpu.imports.keras_import   # noqa: F401
+    import deeplearning4j_tpu.imports.onnx_import    # noqa: F401
+    import deeplearning4j_tpu.imports.tf_import      # noqa: F401
+    from deeplearning4j_tpu.autodiff.samediff import (
+        GRAPH_OPS, REGISTRY_SHADOW_WHITELIST)
+    from deeplearning4j_tpu.ops.registry import registry
+
+    sd_path = "deeplearning4j_tpu/autodiff/samediff.py"
+    abs_sd = os.path.join(repo_root, sd_path)
+    shadowed = set(GRAPH_OPS) & set(registry().names())
+    findings: List[Finding] = []
+    for name in sorted(shadowed - REGISTRY_SHADOW_WHITELIST):
+        findings.append(Finding(
+            path=sd_path, line=_grep_line(abs_sd, "GRAPH_OPS: Dict"),
+            rule="GL006", severity="error",
+            message=f"GRAPH_OPS['{name}'] silently shadows registry op "
+                    f"'{name}' (resolution: local -> GRAPH_OPS -> registry);"
+                    f" add to REGISTRY_SHADOW_WHITELIST with a justification"
+                    f" or delete the duplicate"))
+    for name in sorted(REGISTRY_SHADOW_WHITELIST - shadowed):
+        findings.append(Finding(
+            path=sd_path, line=_grep_line(abs_sd, "REGISTRY_SHADOW_WHITELIST"),
+            rule="GL006", severity="error",
+            message=f"stale whitelist entry '{name}': no longer shadowed — "
+                    f"remove it so the whitelist only shrinks"))
+    return findings
+
+
+# (claim regex, live-surface key, human label) — add a pattern here whenever
+# README grows a new numeric surface claim
+_CLAIM_PATTERNS = [
+    (re.compile(r"(\d+)-entry named declarable-op registry"), "registry",
+     "declarable-op registry"),
+    (re.compile(r"any of the (\d+) catalog ops"), "registry",
+     "SameDiff op catalog"),
+    (re.compile(r"TF frozen graphs \((\d+) ops"), "tf", "TF op mappers"),
+    (re.compile(r"ONNX \((\d+) ops"), "onnx", "ONNX op mappers"),
+    (re.compile(r"Keras \((\d+) layer classes"), "keras",
+     "Keras layer mappers"),
+]
+
+
+def live_surface_counts() -> Dict[str, int]:
+    """The four public surfaces README makes numeric claims about."""
+    from deeplearning4j_tpu.imports.keras_import import KerasLayerMapper
+    from deeplearning4j_tpu.imports.onnx_import import ONNX_OP_MAPPERS
+    from deeplearning4j_tpu.imports.tf_import import TF_OP_MAPPERS
+    from deeplearning4j_tpu.ops.registry import registry
+
+    return {"tf": len(TF_OP_MAPPERS),
+            "onnx": len(ONNX_OP_MAPPERS),
+            "keras": len(KerasLayerMapper.MAPPERS),
+            "registry": len(registry().names())}
+
+
+@consistency_rule("GL008", "README surface count drifted from the live registry")
+def rule_readme_counts(repo_root: str) -> List[Finding]:
+    readme = os.path.join(repo_root, "README.md")
+    if not os.path.exists(readme):
+        return []
+    live = live_surface_counts()
+    findings: List[Finding] = []
+    with open(readme, "r", encoding="utf-8") as fh:
+        for lineno, text in enumerate(fh, start=1):
+            for pat, key, label in _CLAIM_PATTERNS:
+                for m in pat.finditer(text):
+                    claimed = int(m.group(1))
+                    if claimed != live[key]:
+                        findings.append(Finding(
+                            path="README.md", line=lineno, rule="GL008",
+                            severity="error",
+                            message=f"README claims {claimed} for {label} "
+                                    f"but the live registry has {live[key]};"
+                                    f" update the claim (counts are part of "
+                                    f"the public surface)"))
+    return findings
+
+
+def run_consistency(repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for rule_id, (fn, _desc) in sorted(CONSISTENCY_RULES.items()):
+        findings.extend(fn(repo_root))
+    return sorted(findings)

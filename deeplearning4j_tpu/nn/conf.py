@@ -390,7 +390,9 @@ class MoELayer(LayerConf):
     the dispatch/combine einsums are written dense so GSPMD partitions the
     expert axis and inserts the all-to-alls (no hand shard_map).
 
-    top_k=1 is Switch routing, top_k=2 the GShard default. The load-balance
+    top_k=1 is Switch routing, top_k=2 the GShard default. Assignments past
+    capacity C = ceil(cf·S·k/E) are dropped; a token whose EVERY assignment
+    is dropped passes through as identity (never zeros). The load-balance
     aux loss rides the layer STATE under ``_aux_loss`` (summed into the
     training loss by the step functions); ``_dropped_frac`` reports the
     fraction of token→expert assignments dropped at capacity — surfaced to

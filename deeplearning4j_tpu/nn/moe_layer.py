@@ -7,9 +7,11 @@ expert axis and inserts the all-to-all collectives itself — the TPU-native
 expert-parallel recipe (scaling-book; no hand-written shard_map).
 
 Routing: top-k (k=1 Switch, k=2 GShard default) with capacity
-C = ceil(cf·S·k/E); assignments beyond capacity are dropped (their tokens
-pass through the residual path unscaled — combine weights renormalize over
-the surviving assignments). Two scalars ride the layer state:
+C = ceil(cf·S·k/E); assignments beyond capacity are dropped. A token whose
+every assignment is dropped passes through as IDENTITY (the layer adds
+``(1 - min(1, Σ dispatch)) · x``), never as zeros; combine weights
+renormalize over the surviving assignments. Two scalars ride the layer
+state:
 
 * ``_aux_loss``   — Switch load-balance loss E·Σ f_e·P_e times aux_weight;
   the network step functions add every state ``_aux_loss`` to the training
@@ -104,6 +106,15 @@ class MoELayerImpl(Layer):
         out_e = jnp.einsum("ech,ehd->ecd", hdn, params["We2"])
         out_e = out_e + params["be2"][:, None, :]
         y = jnp.einsum("sec,ecd->sd", combine.astype(cd), out_e)   # (S, d)
+
+        # identity passthrough for fully-dropped tokens: a token whose every
+        # top-k assignment fell past capacity has an all-zero dispatch row;
+        # without this it would emit zeros and silently kill activations
+        # under load (round-5 advice). kept_tok ∈ {0..k}; the clip makes the
+        # passthrough exactly 1 for dropped tokens and 0 once any
+        # assignment survived.
+        kept_tok = jnp.sum(dispatch, axis=(1, 2))                  # (S,)
+        y = y + jnp.clip(1.0 - kept_tok, 0.0, 1.0).astype(cd)[:, None] * xt
 
         # ---- aux loss + routing health ---------------------------------
         f_e = jnp.mean(chosen_masks[0], axis=0)        # top-1 token fraction

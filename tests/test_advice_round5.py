@@ -1,0 +1,139 @@
+"""Round-5 advisor findings, regression-tested.
+
+* ONNX NonMaxSuppression honors center_point_box=1 (torchvision export
+  form: boxes as [x_center, y_center, w, h]).
+* Keras CuDNNLSTM bias heuristic: a fused (4H,) bias passes through
+  unchanged even when 4H is divisible by 8 (even H); only an exact (8H,)
+  stack splits.
+* nn.MoELayer: a token whose every top-k assignment is dropped at capacity
+  passes through as identity, never as zeros.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import nn
+from deeplearning4j_tpu.nn import conf as C
+
+
+class TestOnnxNmsCenterPointBox:
+    def _nms(self):
+        import deeplearning4j_tpu.imports.onnx_import  # registers onnx_nms
+        from deeplearning4j_tpu.autodiff.samediff import resolve_graph_op
+        return resolve_graph_op("onnx_nms")
+
+    def test_center_format_matches_corner_format(self):
+        nms = self._nms()
+        # three boxes: two heavily overlapping, one far away
+        corners = np.array([[[0., 0., 2., 2.],
+                             [0., 0.5, 2., 2.5],
+                             [3., 3., 5., 5.]]], np.float32)  # [y1,x1,y2,x2]
+        centers = np.array([[[1., 1., 2., 2.],
+                             [1.5, 1., 2., 2.],
+                             [4., 4., 2., 2.]]], np.float32)  # [xc,yc,w,h]
+        scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+        kw = dict(max_out=3, iou_threshold=0.5, score_threshold=0.0)
+        r_corner = np.asarray(nms(jnp.asarray(corners), jnp.asarray(scores),
+                                  **kw))
+        r_center = np.asarray(nms(jnp.asarray(centers), jnp.asarray(scores),
+                                  center_point_box=1, **kw))
+        np.testing.assert_array_equal(r_corner, r_center)
+        # and the suppression is real: box 1 suppressed, boxes 0/2 kept
+        kept = {int(r) for r in r_corner[:, 2] if r >= 0}
+        assert kept == {0, 2}
+
+    def test_mapper_rejects_unknown_center_point_box(self):
+        from deeplearning4j_tpu.imports.onnx_import import ONNX_OP_MAPPERS
+
+        class _Node:
+            name = "nms"
+            inputs = ["b", "s", "mo"]
+
+        try:
+            ONNX_OP_MAPPERS["NonMaxSuppression"](
+                None, ["b", "s"], {"center_point_box": 2}, _Node(),
+                const_values={"mo": np.asarray(5)})
+        except NotImplementedError as e:
+            assert "center_point_box" in str(e)
+        else:
+            raise AssertionError("center_point_box=2 must be rejected")
+
+
+class TestCuDNNLSTMBiasHeuristic:
+    def _weights(self, i, h, r):
+        k = (r.randn(i, 4 * h) * 0.2).astype(np.float32)
+        rec = (r.randn(h, 4 * h) * 0.2).astype(np.float32)
+        b = (r.randn(4 * h) * 0.1).astype(np.float32)
+        return k, rec, b
+
+    def test_fused_bias_even_units_passes_through(self):
+        """H=4 -> 4H=16 is divisible by 8: the old size%8 heuristic split
+        and summed it into a wrong (2H,) bias."""
+        from deeplearning4j_tpu.imports.keras_import import _assemble_sequential
+        r = np.random.RandomState(0)
+        i, h = 3, 4
+        k, rec, b = self._weights(i, h, r)
+        cfg = {"units": h, "name": "l", "return_sequences": True}
+        net_lstm = _assemble_sequential(
+            [("LSTM", dict(cfg, activation="tanh",
+                           recurrent_activation="sigmoid"), [k, rec, b])],
+            nn.InputType.recurrent(i))
+        net_cudnn = _assemble_sequential(
+            [("CuDNNLSTM", dict(cfg), [k, rec, b])],
+            nn.InputType.recurrent(i))
+        x = r.randn(2, 5, i).astype(np.float32)
+        np.testing.assert_allclose(net_cudnn.output(x), net_lstm.output(x),
+                                   atol=1e-5)
+
+    def test_stacked_8h_bias_still_splits(self):
+        from deeplearning4j_tpu.imports.keras_import import _assemble_sequential
+        r = np.random.RandomState(1)
+        i, h = 3, 4
+        k, rec, b = self._weights(i, h, r)
+        b_cudnn = np.concatenate([b * 0.25, b * 0.75])  # (8H,) input+recurrent
+        cfg = {"units": h, "name": "l", "return_sequences": True}
+        net_lstm = _assemble_sequential(
+            [("LSTM", dict(cfg, activation="tanh",
+                           recurrent_activation="sigmoid"), [k, rec, b])],
+            nn.InputType.recurrent(i))
+        net_cudnn = _assemble_sequential(
+            [("CuDNNLSTM", dict(cfg), [k, rec, b_cudnn])],
+            nn.InputType.recurrent(i))
+        x = r.randn(2, 5, i).astype(np.float32)
+        np.testing.assert_allclose(net_cudnn.output(x), net_lstm.output(x),
+                                   atol=1e-5)
+
+
+class TestMoEDroppedTokenPassthrough:
+    def _moe_layer(self, **kw):
+        b = nn.builder().seed(0).list()
+        b.layer(C.MoELayer(n_in=8, d_hidden=16, n_experts=2,
+                           activation="relu", **kw))
+        b.layer(nn.OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        conf = b.set_input_type(nn.InputType.feed_forward(8)).build()
+        net = nn.MultiLayerNetwork(conf).init()
+        return net.layers[0], net.params[0]
+
+    def test_fully_dropped_tokens_are_identity_not_zero(self):
+        layer, params = self._moe_layer(top_k=1, capacity_factor=1e-9)
+        x = np.random.RandomState(0).randn(32, 8).astype(np.float32)
+        y, state, _ = layer.apply(params, jnp.asarray(x), layer.init_state(),
+                                  train=False, rng=jax.random.key(0))
+        y = np.asarray(y)
+        assert float(state["_dropped_frac"]) > 0.9   # capacity 1/expert
+        # dropped tokens: identity; NO all-zero output rows anywhere
+        identical = np.isclose(y, x, atol=1e-6).all(axis=1)
+        assert identical.sum() >= 30        # all but <=1 token per expert
+        assert not (np.abs(y) < 1e-12).all(axis=1).any()
+
+    def test_surviving_tokens_unaffected_by_passthrough(self):
+        """With capacity for everyone, nothing is dropped and the expert
+        output must NOT have the input added onto it."""
+        layer, params = self._moe_layer(top_k=1, capacity_factor=64.0)
+        x = np.random.RandomState(1).randn(16, 8).astype(np.float32)
+        y, state, _ = layer.apply(params, jnp.asarray(x), layer.init_state(),
+                                  train=False, rng=jax.random.key(0))
+        assert float(state["_dropped_frac"]) == 0.0
+        # relu expert FFN of a random projection almost surely != x
+        assert not np.allclose(np.asarray(y), x, atol=1e-4)

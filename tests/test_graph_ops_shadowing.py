@@ -1,0 +1,88 @@
+"""GRAPH_OPS vs declarable-registry resolution order (round-5 verdict
+item 4), pinned by regression tests.
+
+The documented order is local -> GRAPH_OPS -> registry. Two collisions bit
+the build historically:
+
+* ``where``  — GRAPH_OPS jnp.where(cond, x, y) must win over the registry's
+  legacy signature;
+* ``shape_of``/``stack`` — must be ABSENT from GRAPH_OPS so their registry
+  impls win, because those deliberately stay in NUMPY for un-traced shape
+  chains (tf.shape -> Pack -> Reshape imports).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import (
+    GRAPH_OPS, REGISTRY_SHADOW_WHITELIST, resolve_graph_op)
+from deeplearning4j_tpu.ops.registry import registry
+
+
+class TestResolutionOrder:
+    def test_local_ops_beat_graph_ops(self):
+        sentinel = object()
+        assert resolve_graph_op("where", {"where": sentinel}) is sentinel
+
+    def test_where_resolves_to_graph_ops_jnp_where(self):
+        """`where` IS a whitelisted shadow: jnp.where wins over the
+        registry impl, with 3-arg broadcast semantics."""
+        assert "where" in GRAPH_OPS and "where" in registry()
+        assert "where" in REGISTRY_SHADOW_WHITELIST
+        fn = resolve_graph_op("where")
+        assert fn is GRAPH_OPS["where"]
+        out = fn(jnp.asarray([True, False]), jnp.asarray([1.0, 2.0]),
+                 jnp.asarray([9.0, 9.0]))
+        np.testing.assert_array_equal(np.asarray(out), [1.0, 9.0])
+
+    def test_shape_of_resolves_to_registry_numpy_impl(self):
+        """`shape_of` must NOT be in GRAPH_OPS: the registry impl returns
+        numpy so shape arithmetic stays trace-time concrete."""
+        assert "shape_of" not in GRAPH_OPS
+        fn = resolve_graph_op("shape_of")
+        assert fn is registry().get("shape_of").fn
+        out = fn(jnp.ones((2, 3)))
+        assert isinstance(out, np.ndarray)
+        np.testing.assert_array_equal(out, [2, 3])
+
+    def test_stack_resolves_to_registry_numpy_preserving_impl(self):
+        """`stack` must NOT be in GRAPH_OPS: the registry impl keeps host
+        scalars in numpy for un-traced shape chains."""
+        assert "stack" not in GRAPH_OPS
+        fn = resolve_graph_op("stack")
+        assert fn is registry().get("stack").fn
+        out = fn(np.int32(2), np.int32(3), axis=0)
+        assert isinstance(out, np.ndarray)
+        np.testing.assert_array_equal(out, [2, 3])
+
+    def test_unknown_op_raises_keyerror(self):
+        try:
+            resolve_graph_op("definitely_not_an_op")
+        except KeyError as e:
+            assert "definitely_not_an_op" in str(e)
+        else:
+            raise AssertionError("expected KeyError")
+
+
+class TestWhitelistIsExact:
+    """The graftlint GL006 invariant, also pinned here so a whitelist
+    regression fails even when only this file runs."""
+
+    def _shadowed(self):
+        # importers mutate GRAPH_OPS at import time; settle the surface
+        import deeplearning4j_tpu.imports.keras_import  # noqa: F401
+        import deeplearning4j_tpu.imports.onnx_import   # noqa: F401
+        import deeplearning4j_tpu.imports.tf_import     # noqa: F401
+        return set(GRAPH_OPS) & set(registry().names())
+
+    def test_every_shadow_is_whitelisted(self):
+        unlisted = self._shadowed() - REGISTRY_SHADOW_WHITELIST
+        assert unlisted == set(), (
+            f"GRAPH_OPS keys silently shadowing registry ops: "
+            f"{sorted(unlisted)} — whitelist with a justification or "
+            f"delete the duplicate")
+
+    def test_whitelist_has_no_stale_entries(self):
+        stale = REGISTRY_SHADOW_WHITELIST - self._shadowed()
+        assert stale == set(), (
+            f"stale whitelist entries (no longer shadowed): {sorted(stale)}")

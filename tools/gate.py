@@ -108,9 +108,41 @@ def native_stage() -> bool:
     return True
 
 
+def lint_stage() -> bool:
+    """graftlint over the whole repo (docs/LINT.md). Emits the linter's one
+    JSON summary line into the gate log so driver artifacts stay
+    diagnosable; fails on any finding not grandfathered in
+    lint_baseline.json."""
+    print("== gate: graftlint (static analysis) ==", flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "tools/graftlint.py", "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (graftlint timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (graftlint exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    print(f"   ok (graftlint: {rec['total']} findings, "
+          f"{rec['baselined']} grandfathered, {rec['new']} new)")
+    return True
+
+
 def main() -> int:
     fast = "--fast" in sys.argv
     results = {}
+
+    # static analysis runs in BOTH modes: it is the cheapest stage and the
+    # one that catches the hang class before anything can hang
+    results["lint"] = lint_stage()
 
     if not fast:  # --fast stays "pytest only" (pre-commit speed)
         results["native"] = native_stage()

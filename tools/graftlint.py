@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Thin wrapper: ``python tools/graftlint.py`` == ``python -m
+deeplearning4j_tpu.lint``. Exists so the gate and Makefile have a stable
+entry point that works from the repo root without -m plumbing."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the consistency rules import the package; never let that probe a TPU
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from deeplearning4j_tpu.lint.cli import run  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run())
