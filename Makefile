@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench native native-test lint lint-baseline
+.PHONY: test gate gate-fast bench bench-compile native native-test lint lint-baseline
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -30,6 +30,12 @@ gate-fast:
 
 bench:
 	python bench.py
+
+# graph-compile metric (docs/OPTIMIZER.md): trace+XLA-compile speedup from
+# the pre-trace SameDiff optimizer, CPU-pinned (pure compile-time
+# measurement — no device loop), one gate-friendly JSON line on stdout.
+bench-compile:
+	JAX_PLATFORMS=cpu BENCH_MODEL=graph_compile BENCH_RECORD=0 python bench.py
 
 native:
 	cmake -S native -B native/build && cmake --build native/build -j

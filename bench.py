@@ -20,9 +20,33 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def _ensure_backend() -> str:
+    """Probe the default JAX backend in a SUBPROCESS (an unreachable TPU can
+    hang or crash the initializer — BENCH_r05 recorded rc=1 crashes); on
+    failure pin this process to CPU so the run still produces data.
+
+    Returns "default", "pinned" (caller set JAX_PLATFORMS) or
+    "cpu-fallback"."""
+    if os.environ.get("JAX_PLATFORMS"):
+        return "pinned"
+    probe = "import jax; jax.devices()"
+    try:
+        proc = subprocess.run([sys.executable, "-c", probe],
+                              capture_output=True, timeout=240)
+        ok = proc.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        ok = False
+    if ok:
+        return "default"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return "cpu-fallback"
 
 
 def _bench_resnet50(batch: int, iters: int, image: int, dtype: str):
@@ -158,6 +182,59 @@ def _bench_attention(iters: int):
     return t_gen / t_flash, "flash_attention_t8192_speedup_vs_generic"
 
 
+def _bench_graph_compile(layers: int, width: int):
+    """Graph-compile metric (docs/OPTIMIZER.md, `make bench-compile`): a
+    redundant SameDiff graph — per-layer duplicated subexpressions, foldable
+    constant chains, identity/transpose no-ops, dead branches, i.e. the
+    shapes importers actually emit — is traced+compiled twice, optimizer off
+    vs on. Value = wall speedup of trace+XLA-compile; the JSON line also
+    carries the node counts so the win is a number, not a claim. CPU-safe
+    (pure compile-time measurement, no training loop)."""
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    batch = 4
+
+    def build(optimize: bool) -> SameDiff:
+        r = np.random.RandomState(0)
+        sd = SameDiff(optimize=optimize)
+        h = sd.placeholder("x", (batch, width))
+        for i in range(layers):
+            w = sd.var(f"w{i}", r.randn(width, width).astype(np.float32) * 0.05)
+            b = sd.var(f"b{i}", np.zeros(width, np.float32))
+            c = sd.constant(f"c{i}", np.float32(width))
+            scale = sd.math.sqrt(c)                   # foldable const chain
+            pre = (h @ w + b) / scale
+            t1 = sd.math.tanh(pre)
+            t2 = sd.math.tanh(pre)                    # CSE duplicate
+            g = sd.nn.sigmoid(t1 + t2)
+            # no-op chain: the identity node and transpose pair are
+            # stripped; the *1+0 arithmetic survives (placeholder-rooted,
+            # so its dtype is unprovable — see docs/OPTIMIZER.md) exactly
+            # as it would in an imported graph
+            g = sd.op("identity", g) * 1.0 + 0.0
+            g = g.transpose(1, 0).transpose(1, 0)
+            _dead = sd.math.exp(pre) @ w              # dead branch
+            h = g
+        h.sum().rename("out")
+        return sd
+
+    feeds = {"x": np.random.RandomState(1).randn(batch, width)
+             .astype(np.float32)}
+    wall, outs, stats = {}, {}, {}
+    for mode in (False, True):
+        sd = build(mode)
+        t0 = time.perf_counter()
+        outs[mode] = sd.output(feeds, ["out"])["out"]
+        wall[mode] = time.perf_counter() - t0
+        stats[mode] = sd.last_compile_stats
+    np.testing.assert_allclose(outs[False], outs[True], rtol=1e-5, atol=1e-5)
+    extra = {"nodes_before": stats[True].nodes_before,
+             "nodes_after": stats[True].nodes_after,
+             "compile_s_unoptimized": round(wall[False], 3),
+             "compile_s_optimized": round(wall[True], 3)}
+    return wall[False] / wall[True], "graph_compile_optimizer_speedup", extra
+
+
 # bf16 peak matmul TFLOP/s by device kind substring (public spec sheets);
 # MFU = achieved model FLOP/s over this peak — the honest utilization
 # number the reference's img/s headline hides (round-3 verdict weak #1)
@@ -197,35 +274,76 @@ def _mfu(metric: str, value: float, image: int):
     return round(value * per_unit / (peak * 1e12), 4)
 
 
+# unit by metric — module-level so the failure path can still label the line
+_UNITS = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
+          "lenet5_mnist_train_images_per_sec": "images/sec/chip",
+          "bert_base_mlm_train_tokens_per_sec": "tokens/sec/chip",
+          "flash_attention_t8192_speedup_vs_generic": "x vs XLA generic",
+          "graph_compile_optimizer_speedup": "x trace+compile speedup"}
+
+_MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
+                 "lenet": "lenet5_mnist_train_images_per_sec",
+                 "bert": "bert_base_mlm_train_tokens_per_sec",
+                 "attention": "flash_attention_t8192_speedup_vs_generic",
+                 "graph_compile": "graph_compile_optimizer_speedup"}
+
+
 def main() -> None:
-    iters = int(os.environ.get("BENCH_ITERS", "60"))
-    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    backend = _ensure_backend()
     model = os.environ.get("BENCH_MODEL", "resnet50")
     dtype = os.environ.get("BENCH_DTYPE", "mixed")
+    smoke = backend == "cpu-fallback"
+    # On cpu-fallback, headline workloads at device sizes would run for
+    # hours on the host — shrink to smoke sizes (explicit env still wins)
+    # so the run exits 0 with a labeled, parsable line instead of rc=1.
+    iters = int(os.environ.get("BENCH_ITERS", "2" if smoke else "60"))
+    image = int(os.environ.get("BENCH_IMAGE", "64" if smoke else "224"))
 
     # Per-model default batch: the timed window must dwarf the ~100ms tunnel
     # dispatch or the number measures jitter, not the device (LeNet at
     # batch 128 × 60 steps is ~80ms of device work — pure noise). 4096 puts
     # LeNet's window at ~2.5s; ResNet's 128×60 is already ~2.8s.
     default_batch = {"lenet": 4096}.get(model, 128)
+    if smoke:
+        default_batch = {"lenet": 256}.get(model, 8)
     batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
 
-    if model == "lenet":
-        value, metric = _bench_lenet(batch, iters)
-        method = f"b{batch}i{iters}"
-    elif model == "attention":
-        value, metric = _bench_attention(iters)
-        method = f"i{iters}"
-    elif model == "bert":
-        bb = int(os.environ.get("BENCH_BERT_BATCH", "16"))
-        seq = int(os.environ.get("BENCH_SEQ", "512"))
-        value, metric = _bench_bert(bb, iters, dtype, seq)
-        method = f"b{bb}s{seq}i{iters}{'' if dtype == 'mixed' else dtype}"
-    else:
-        value, metric = _bench_resnet50(batch, iters, image, dtype)
-        method = f"b{batch}x{image}i{iters}{'' if dtype == 'mixed' else dtype}"
+    extra = {}
+    try:
+        if model == "lenet":
+            value, metric = _bench_lenet(batch, iters)
+            method = f"b{batch}i{iters}"
+        elif model == "attention":
+            value, metric = _bench_attention(iters)
+            method = f"i{iters}"
+        elif model == "bert":
+            bb = int(os.environ.get("BENCH_BERT_BATCH", "2" if smoke else "16"))
+            seq = int(os.environ.get("BENCH_SEQ", "128" if smoke else "512"))
+            value, metric = _bench_bert(bb, iters, dtype, seq)
+            method = f"b{bb}s{seq}i{iters}{'' if dtype == 'mixed' else dtype}"
+        elif model == "graph_compile":
+            layers = int(os.environ.get("BENCH_GRAPH_LAYERS", "6"))
+            width = int(os.environ.get("BENCH_GRAPH_WIDTH", "192"))
+            value, metric, extra = _bench_graph_compile(layers, width)
+            method = f"L{layers}w{width}"
+        else:
+            value, metric = _bench_resnet50(batch, iters, image, dtype)
+            method = f"b{batch}x{image}i{iters}{'' if dtype == 'mixed' else dtype}"
+    except Exception as e:  # noqa: BLE001 — the one-JSON-line contract:
+        # an individual benchmark failure must still emit the final
+        # machine-parsable line (every BENCH round so far recorded
+        # `parsed: null` because the crash pre-empted it)
+        metric = _MODEL_METRIC.get(model, model)
+        line = {"metric": metric, "value": None,
+                "unit": _UNITS.get(metric, ""), "vs_baseline": None,
+                "backend": backend,
+                "error": f"{type(e).__name__}: {e}"[:500]}
+        print(json.dumps(line))
+        raise SystemExit(2)
 
-    record = os.environ.get("BENCH_RECORD", "1") != "0"
+    # a cpu-fallback smoke number must never ratchet (or reset the method
+    # of) the real device series in BENCH_HISTORY.json
+    record = (os.environ.get("BENCH_RECORD", "1") != "0") and not smoke
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.json")
     hist = {}
     if os.path.exists(hist_path):
@@ -261,16 +379,17 @@ def main() -> None:
         except Exception:
             pass
 
-    unit = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
-            "lenet5_mnist_train_images_per_sec": "images/sec/chip",
-            "bert_base_mlm_train_tokens_per_sec": "tokens/sec/chip",
-            "flash_attention_t8192_speedup_vs_generic": "x vs XLA generic"}[metric]
     line = {
         "metric": metric,
         "value": round(value, 3 if value < 100 else 1),
-        "unit": unit,
+        "unit": _UNITS[metric],
         "vs_baseline": round(vs_baseline, 3),
     }
+    if backend != "default":
+        line["backend"] = backend
+    if smoke:
+        line["smoke"] = True
+    line.update(extra)
     mfu = _mfu(metric, value, image)
     if mfu is not None:
         line["mfu"] = mfu

@@ -1,6 +1,11 @@
 """Autodiff graph engine — the SameDiff role (SURVEY §3.2, §4.3)."""
 
 from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable, TrainingConfig
+from deeplearning4j_tpu.autodiff.optimize import (
+    GraphPlan,
+    OptimizeStats,
+    optimize_graph,
+)
 from deeplearning4j_tpu.autodiff.gradcheck import (
     check_gradients,
     check_gradients_fn,

@@ -1,0 +1,511 @@
+"""SameDiff graph optimizer — pre-trace pass pipeline (docs/OPTIMIZER.md).
+
+The paper's core bet is whole-graph compilation: one ``jax.jit`` trace per
+requested output set instead of the reference's per-op interpreter. But the
+importers (imports/ir.py) emit every source node verbatim, so BERT-scale
+ONNX/TF graphs carry dead branches, per-layer duplicated subexpressions
+(attention-mask expansion chains), foldable constant chains, and no-op
+Identity/Dropout/Reshape nodes straight into the trace — inflating both
+trace time and XLA compile time. This module is the standard fix (XLA and
+TVM/Relay both lead with the same trio): shrink the node graph BEFORE
+tracing.
+
+Passes (each independently sound; pipeline loops to a fixpoint):
+
+``dce``        dead-code elimination backwards from the requested outputs.
+``fold``       constant folding: a node whose inputs are all CONSTANT-derived
+               (never VARIABLE — training updates must not invalidate folds)
+               is evaluated eagerly once and its outputs become plan-local
+               constants. Respects the const-invalidation contract: plans are
+               cached in ``SameDiff._jit_cache``, which ``set_arr`` on a
+               CONSTANT and every graph mutation already clear.
+``cse``        common-subexpression elimination keyed on
+               (op, input ids, canonical kwargs); later duplicates alias the
+               first occurrence's outputs.
+``algebraic``  identity cleanup: identity nodes, transpose∘transpose
+               (cancelled or composed), reshape∘reshape fusion,
+               reshape-to-same-shape, and x*1 / x+0 / x-0 / x/1 / x**1 strips
+               (only when the surviving operand's dtype provably absorbs the
+               promotion — see ``_infer_dtypes``).
+
+The result is a :class:`GraphPlan` — an optimized node list, extra folded
+constants, and an alias map — which ``SameDiff._interpret`` executes instead
+of the raw recording. The graph itself (``sd._nodes``) is NEVER mutated:
+serde, ``summary()``, and later mutation all see the full recording.
+
+Instrumentation: :class:`OptimizeStats` carries per-pass node counts and, on
+the ``output()`` execution path (via :class:`CompiledGraph`), the measured
+trace seconds and XLA compile seconds — surfaced as
+``SameDiff.last_compile_stats`` and by ``bench.py`` (BENCH_MODEL=
+graph_compile / ``make bench-compile``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+PASS_ORDER: Tuple[str, ...] = ("dce", "fold", "cse", "algebraic")
+
+# folded outputs larger than this (elements) stay in the graph: XLA would
+# bake them anyway, but materializing giants at plan time trades trace
+# savings for host memory with no wall-clock win
+FOLD_SIZE_LIMIT = 1 << 24
+
+_MAX_ITERS = 10  # fixpoint safety cap; real graphs settle in 2-3
+
+
+@dataclasses.dataclass
+class OptimizeStats:
+    """Per-compile instrumentation (SameDiff.last_compile_stats)."""
+
+    nodes_before: int = 0
+    nodes_after: int = 0
+    # pass name -> {"before": n at first application, "after": n at last,
+    #               "removed": cumulative node delta across iterations}
+    passes: Dict[str, Dict[str, int]] = dataclasses.field(default_factory=dict)
+    optimize_seconds: float = 0.0
+    # populated by CompiledGraph on the output() path (AOT lower/compile)
+    trace_seconds: Optional[float] = None
+    compile_seconds: Optional[float] = None
+
+    def record_pass(self, name: str, before: int, after: int) -> None:
+        entry = self.passes.setdefault(
+            name, {"before": before, "after": after, "removed": 0})
+        entry["after"] = after
+        entry["removed"] += before - after
+
+    @property
+    def removed(self) -> int:
+        return self.nodes_before - self.nodes_after
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"nodes_before": self.nodes_before,
+                "nodes_after": self.nodes_after,
+                "removed": self.removed,
+                "passes": {k: dict(v) for k, v in self.passes.items()},
+                "optimize_seconds": round(self.optimize_seconds, 4),
+                "trace_seconds": self.trace_seconds,
+                "compile_seconds": self.compile_seconds}
+
+
+class GraphPlan:
+    """Optimized execution plan for one requested-output set."""
+
+    __slots__ = ("nodes", "extra_consts", "alias", "outputs", "stats")
+
+    def __init__(self, nodes, extra_consts, alias, outputs, stats):
+        self.nodes = nodes
+        self.extra_consts = extra_consts  # folded values, merged into env
+        self.alias = alias                # removed-output name -> survivor
+        self.outputs = outputs
+        self.stats = stats
+
+    def resolve(self, name: str) -> str:
+        return _resolve(self.alias, name)
+
+
+def _resolve(alias: Dict[str, str], name: str) -> str:
+    seen = []
+    while name in alias:
+        seen.append(name)
+        name = alias[name]
+    for s in seen:  # path compression keeps chains O(1) amortized
+        alias[s] = name
+    return name
+
+
+def _copy_node(n):
+    return type(n)(n.op, list(n.inputs), dict(n.kwargs), list(n.outputs))
+
+
+def _rewrite_inputs(nodes, alias: Dict[str, str]) -> bool:
+    changed = False
+    for n in nodes:
+        for i, name in enumerate(n.inputs):
+            r = _resolve(alias, name)
+            if r != name:
+                n.inputs[i] = r
+                changed = True
+    return changed
+
+
+# ---------------------------------------------------------------------------
+# dce
+# ---------------------------------------------------------------------------
+
+
+def _dce(nodes, outputs: Sequence[str], alias: Dict[str, str]):
+    needed = {_resolve(alias, o) for o in outputs}
+    keep = []
+    for n in reversed(nodes):
+        if any(o in needed for o in n.outputs):
+            keep.append(n)
+            needed.update(n.inputs)
+    keep.reverse()
+    return keep, len(keep) != len(nodes)
+
+
+# ---------------------------------------------------------------------------
+# fold
+# ---------------------------------------------------------------------------
+
+
+def _fold(nodes, const_vals: Dict[str, Any], resolve_op, local_ops,
+          size_limit: int, precision_policy: str):
+    from deeplearning4j_tpu.nn import dtype as DT
+
+    out_nodes, changed = [], False
+    with DT.precision_scope(precision_policy):
+        for n in nodes:
+            if n.op in local_ops or any(i not in const_vals for i in n.inputs):
+                out_nodes.append(n)
+                continue
+            try:
+                fn = resolve_op(n.op)
+                res = fn(*[const_vals[i] for i in n.inputs], **n.kwargs)
+            except Exception:
+                # not statically evaluable (shape mismatch under fold,
+                # helper needing a device feature, ...) — leave it traced
+                out_nodes.append(n)
+                continue
+            vals = [res] if len(n.outputs) == 1 else list(res)
+            if (len(vals) != len(n.outputs)
+                    or any(np.size(v) > size_limit for v in vals)):
+                out_nodes.append(n)
+                continue
+            for name, val in zip(n.outputs, vals):
+                const_vals[name] = val
+            changed = True
+    return out_nodes, changed
+
+
+# ---------------------------------------------------------------------------
+# cse
+# ---------------------------------------------------------------------------
+
+
+def _canon_kwargs(kwargs: Dict[str, Any]):
+    def c(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(c(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((k, c(x)) for k, x in v.items()))
+        if isinstance(v, np.ndarray):
+            return ("__nd", v.shape, str(v.dtype), v.tobytes())
+        return v
+
+    try:
+        key = tuple(sorted((k, c(v)) for k, v in kwargs.items()))
+        hash(key)
+    except TypeError:
+        return None  # unhashable attr (e.g. a callable) — not CSE-able
+    return key
+
+
+def _cse(nodes, alias: Dict[str, str], local_ops):
+    seen: Dict[Any, Any] = {}
+    out_nodes, changed = [], False
+    for n in nodes:
+        if n.op in local_ops:  # opaque control-flow closures: never merge
+            out_nodes.append(n)
+            continue
+        ck = _canon_kwargs(n.kwargs)
+        if ck is None:
+            out_nodes.append(n)
+            continue
+        key = (n.op, tuple(n.inputs), ck)
+        prev = seen.get(key)
+        if prev is None:
+            seen[key] = n
+            out_nodes.append(n)
+        else:
+            for o, po in zip(n.outputs, prev.outputs):
+                alias[o] = po
+            changed = True
+    return out_nodes, changed
+
+
+# ---------------------------------------------------------------------------
+# algebraic
+# ---------------------------------------------------------------------------
+
+# unary ops whose output dtype equals a floating input's dtype
+_DTYPE_PRESERVING_UNARY = frozenset([
+    "identity", "neg", "abs", "exp", "log", "log1p", "sqrt", "rsqrt",
+    "square", "sign", "floor", "ceil", "round", "sin", "cos", "tan",
+    "tanh", "sinh", "cosh", "erf", "relu", "relu6", "elu", "selu", "gelu",
+    "sigmoid", "softplus", "softsign", "swish", "mish", "leakyrelu",
+    "softmax", "log_softmax", "reshape", "transpose", "permute",
+    "expand_dims", "squeeze", "tile", "reduce_sum", "reduce_mean",
+    "reduce_max", "reduce_min", "zeros_like", "ones_like",
+])
+_DTYPE_PROMOTING_BINARY = frozenset(
+    ["add", "sub", "mul", "div", "pow", "maximum", "minimum", "mmul"])
+
+
+def _infer_dtypes(nodes, const_vals, seed_dtypes):
+    """Best-effort forward dtype propagation (floating dtypes only). A name
+    absent from the result means "unknown" — identity strips then bail."""
+    import jax.numpy as jnp
+
+    dt: Dict[str, Any] = dict(seed_dtypes)
+    for name, v in const_vals.items():
+        vd = getattr(v, "dtype", None)
+        if vd is not None:
+            dt[name] = np.dtype(vd)
+    for n in nodes:
+        ins = [dt.get(i) for i in n.inputs]
+        if n.op == "cast":
+            try:
+                dt[n.outputs[0]] = np.dtype(n.kwargs.get("dtype"))
+            except TypeError:
+                pass
+        elif (n.op in _DTYPE_PRESERVING_UNARY and ins and ins[0] is not None
+                and np.issubdtype(ins[0], np.inexact)):
+            dt[n.outputs[0]] = ins[0]
+        elif (n.op in _DTYPE_PROMOTING_BINARY and len(ins) >= 2
+                and all(d is not None and np.issubdtype(d, np.inexact)
+                        for d in ins[:2])):
+            dt[n.outputs[0]] = np.dtype(jnp.promote_types(ins[0], ins[1]))
+    return dt
+
+
+def _scalar_const(const_vals, name):
+    """0-d (or absent) → (value, dtype) for identity matching; None if the
+    constant is non-scalar (a broadcast would change the result shape)."""
+    v = const_vals.get(name)
+    if v is None:
+        return None
+    arr = np.asarray(v)
+    if arr.ndim != 0:
+        return None
+    try:
+        return float(arr), arr.dtype
+    except (TypeError, ValueError):
+        return None
+
+
+# op -> (identity value, which operand positions may carry it)
+_BINARY_IDENTITIES = {"mul": (1.0, (0, 1)), "add": (0.0, (0, 1)),
+                      "sub": (0.0, (1,)), "div": (1.0, (1,)),
+                      "pow": (1.0, (1,))}
+
+
+def _algebraic(nodes, const_vals, var_shapes, seed_dtypes,
+               alias: Dict[str, str], local_ops):
+    import jax.numpy as jnp
+
+    dtypes = _infer_dtypes(nodes, const_vals, seed_dtypes)
+    producer = {o: n for n in nodes for o in n.outputs}
+    out_nodes, changed = [], False
+
+    def known_shape(name):
+        s = var_shapes.get(name)
+        if s is not None:
+            return s
+        v = const_vals.get(name)
+        return tuple(np.shape(v)) if v is not None else None
+
+    def perm_of(axes, rank):
+        return (tuple(reversed(range(rank))) if axes is None
+                else tuple(int(a) for a in axes))
+
+    for n in nodes:
+        if n.op in local_ops:
+            out_nodes.append(n)
+            continue
+
+        if n.op == "identity" and len(n.outputs) == 1:
+            alias[n.outputs[0]] = n.inputs[0]
+            changed = True
+            continue
+
+        if n.op == "transpose" and len(n.inputs) == 1:
+            inner = producer.get(n.inputs[0])
+            if inner is not None and inner.op == "transpose":
+                a_out = n.kwargs.get("axes")
+                a_in = inner.kwargs.get("axes")
+                rank = (len(a_out) if a_out is not None
+                        else len(a_in) if a_in is not None else None)
+                if a_out is None and a_in is None:
+                    # reverse twice = identity at any rank
+                    alias[n.outputs[0]] = inner.inputs[0]
+                    changed = True
+                    continue
+                if rank is not None:
+                    p_in = perm_of(a_in, rank)
+                    p_out = perm_of(a_out, rank)
+                    combined = tuple(p_in[k] for k in p_out)
+                    if combined == tuple(range(rank)):
+                        alias[n.outputs[0]] = inner.inputs[0]
+                        changed = True
+                        continue
+                    if n.inputs[0] != inner.inputs[0] or \
+                            n.kwargs.get("axes") != combined:
+                        n.inputs[0] = inner.inputs[0]
+                        n.kwargs["axes"] = combined
+                        changed = True
+            out_nodes.append(n)
+            continue
+
+        if n.op == "reshape" and len(n.inputs) == 1:
+            target = n.kwargs.get("shape")
+            inner = producer.get(n.inputs[0])
+            if inner is not None and inner.op == "reshape":
+                # reshape∘reshape ≡ the outer reshape (row-major order)
+                n.inputs[0] = inner.inputs[0]
+                changed = True
+            src = known_shape(n.inputs[0])
+            if (target is not None and src is not None
+                    and all(int(d) >= 0 for d in target)
+                    and tuple(int(d) for d in target) == tuple(src)):
+                alias[n.outputs[0]] = n.inputs[0]
+                changed = True
+                continue
+            out_nodes.append(n)
+            continue
+
+        ident = _BINARY_IDENTITIES.get(n.op)
+        if ident is not None and len(n.inputs) == 2:
+            value, positions = ident
+            stripped = False
+            for pos in positions:
+                sc = _scalar_const(const_vals, n.inputs[pos])
+                if sc is None or sc[0] != value:
+                    continue
+                other = n.inputs[1 - pos]
+                dt_other = dtypes.get(other)
+                # only strip when the surviving operand's dtype provably
+                # absorbs the promotion — else x(bf16)+0.0(f32) would
+                # silently change the result dtype/precision
+                if dt_other is None or not np.issubdtype(dt_other, np.inexact):
+                    continue
+                if np.dtype(jnp.promote_types(dt_other, sc[1])) != dt_other:
+                    continue
+                alias[n.outputs[0]] = other
+                changed = True
+                stripped = True
+                break
+            if stripped:
+                continue
+
+        out_nodes.append(n)
+    return out_nodes, changed
+
+
+# ---------------------------------------------------------------------------
+# pipeline driver
+# ---------------------------------------------------------------------------
+
+
+def optimize_graph(nodes, outputs: Sequence[str], *,
+                   const_env: Dict[str, Any],
+                   seed_dtypes: Optional[Dict[str, Any]] = None,
+                   var_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+                   local_ops: Optional[Dict[str, Callable]] = None,
+                   resolve_op: Optional[Callable[[str], Callable]] = None,
+                   passes: Optional[Sequence[str]] = None,
+                   fold_size_limit: int = FOLD_SIZE_LIMIT,
+                   precision_policy: str = "float32",
+                   max_iters: int = _MAX_ITERS) -> GraphPlan:
+    """Run the enabled passes over ``nodes`` until a fixpoint.
+
+    Pure with respect to the inputs: ``nodes`` entries are copied, and
+    ``const_env`` is never mutated (folded values land in
+    ``GraphPlan.extra_consts``). ``passes=None`` enables all of
+    :data:`PASS_ORDER`; pass a subset for per-pass opt-out.
+    """
+    t0 = time.perf_counter()
+    local_ops = local_ops or {}
+    if resolve_op is None:
+        from deeplearning4j_tpu.autodiff import samediff as _sd
+
+        def resolve_op(name, _lo=local_ops):
+            return _sd.resolve_graph_op(name, _lo)
+    enabled = tuple(passes) if passes is not None else PASS_ORDER
+    unknown = [p for p in enabled if p not in PASS_ORDER]
+    if unknown:
+        raise ValueError(f"unknown optimizer pass(es) {unknown}; "
+                         f"valid: {list(PASS_ORDER)}")
+
+    alias: Dict[str, str] = {}
+    const_vals = dict(const_env)
+    work = [_copy_node(n) for n in nodes]
+    stats = OptimizeStats(nodes_before=len(work))
+
+    for _ in range(max_iters):
+        changed = False
+        for p in PASS_ORDER:
+            if p not in enabled:
+                continue
+            before = len(work)
+            if p == "dce":
+                work, ch = _dce(work, outputs, alias)
+            elif p == "fold":
+                work, ch = _fold(work, const_vals, resolve_op, local_ops,
+                                 fold_size_limit, precision_policy)
+            elif p == "cse":
+                work, ch = _cse(work, alias, local_ops)
+            else:
+                work, ch = _algebraic(work, const_vals, var_shapes or {},
+                                      seed_dtypes or {}, alias, local_ops)
+            ch |= _rewrite_inputs(work, alias)
+            stats.record_pass(p, before, len(work))
+            changed |= ch
+        if not changed:
+            break
+
+    referenced = {i for n in work for i in n.inputs}
+    referenced.update(_resolve(alias, o) for o in outputs)
+    extra = {k: v for k, v in const_vals.items()
+             if k not in const_env and k in referenced}
+    stats.nodes_after = len(work)
+    stats.optimize_seconds = time.perf_counter() - t0
+    return GraphPlan(nodes=work, extra_consts=extra, alias=alias,
+                     outputs=list(outputs), stats=stats)
+
+
+# ---------------------------------------------------------------------------
+# compile instrumentation (the trace/compile split of last_compile_stats)
+# ---------------------------------------------------------------------------
+
+
+class CompiledGraph:
+    """Wraps a jitted whole-graph function so trace seconds and XLA compile
+    seconds are measured separately (jax.jit hides both inside the first
+    call). Only the FIRST call goes through AOT ``lower()``/``.compile()``
+    (exact timings, result from the AOT executable); every later call
+    dispatches through plain ``jax.jit`` — its C++ fast path beats the AOT
+    executable's Python argument handling, and per-call Python signature
+    hashing would tax every inference step to instrument one compile."""
+
+    def __init__(self, jit_fn, stats: Optional[OptimizeStats] = None):
+        self._jit = jit_fn
+        self.stats = stats if stats is not None else OptimizeStats()
+        self._timed = False
+
+    def lower(self, *args, **kwargs):  # as_stablehlo parity surface
+        return self._jit.lower(*args, **kwargs)
+
+    def __call__(self, var_arrays, feeds):
+        if not self._timed:
+            self._timed = True
+            t0 = time.perf_counter()
+            lowered = self._jit.lower(var_arrays, feeds)
+            t1 = time.perf_counter()
+            ex = lowered.compile()
+            t2 = time.perf_counter()
+            self.stats.trace_seconds = round(t1 - t0, 4)
+            self.stats.compile_seconds = round(t2 - t1, 4)
+            try:
+                return ex(var_arrays, feeds)
+            except TypeError:
+                # aval mismatch (e.g. weak-typed scalar feeds) — plain jit
+                # handles it below; genuine runtime failures (XLA OOM etc.)
+                # propagate unmasked
+                pass
+        return self._jit(var_arrays, feeds)

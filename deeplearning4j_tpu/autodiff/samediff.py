@@ -709,9 +709,18 @@ class TrainingConfig:
 
 
 class SameDiff:
-    """The graph container + execution facade."""
+    """The graph container + execution facade.
 
-    def __init__(self) -> None:
+    ``optimize``: run the pre-trace graph optimizer (autodiff/optimize.py —
+    DCE, constant folding, CSE, algebraic identity cleanup) before every
+    compilation. ``optimize_passes``: subset of
+    ``optimize.PASS_ORDER`` to enable (None = all; per-pass opt-out).
+    ``last_compile_stats``: OptimizeStats for the most recent compilation
+    (per-pass node deltas, trace seconds, XLA compile seconds).
+    """
+
+    def __init__(self, optimize: bool = True,
+                 optimize_passes: Optional[Sequence[str]] = None) -> None:
         self._vars: Dict[str, SDVariable] = {}
         self._arrays: Dict[str, jnp.ndarray] = {}  # VARIABLE + CONSTANT values
         self._nodes: List[_Node] = []
@@ -736,11 +745,17 @@ class SameDiff:
         # graph IO signature, populated by the import layer (imports/ir.py)
         self.graph_inputs: List[str] = []
         self.graph_outputs: List[str] = []
+        # pre-trace optimizer wiring (autodiff/optimize.py)
+        self.optimize = optimize
+        self.optimize_passes = (tuple(optimize_passes)
+                                if optimize_passes is not None else None)
+        self.last_compile_stats = None
 
     # ------------------------------------------------------------- factories
     @staticmethod
-    def create() -> "SameDiff":
-        return SameDiff()
+    def create(optimize: bool = True,
+               optimize_passes: Optional[Sequence[str]] = None) -> "SameDiff":
+        return SameDiff(optimize=optimize, optimize_passes=optimize_passes)
 
     def _fresh(self, prefix: str) -> str:
         self._name_counter += 1
@@ -816,6 +831,9 @@ class SameDiff:
         for n in self._nodes:
             n.inputs = [new if i == old else i for i in n.inputs]
             n.outputs = [new if o == old else o for o in n.outputs]
+        # renaming is a graph mutation: cached optimizer plans hold frozen
+        # node-name snapshots and compiled traces key envs by name
+        self._jit_cache.clear()
 
     # -------------------------------------------------------------- recording
     def _record(self, op: str, inputs: List[SDVariable],
@@ -855,12 +873,56 @@ class SameDiff:
                 return "bfloat16"
         return "float32"
 
-    def _interpret(self, env: Dict[str, Any], wanted: Sequence[str]) -> Dict[str, Any]:
-        """Run the needed subgraph in order (pure; called under trace/jit)."""
+    def _graph_plan(self, out_names: Tuple[str, ...]):
+        """Optimized execution plan for the given outputs, or None when the
+        optimizer is off. Cached in ``_jit_cache`` so the exact paths that
+        invalidate compiled traces (graph mutation in ``_record``, constant
+        rebind in ``set_arr``) also invalidate stale fold/CSE results."""
+        if not self.optimize:
+            return None
+        from deeplearning4j_tpu.autodiff import optimize as _opt
+
+        cache_key = ("plan", out_names, self.optimize_passes)
+        plan = self._jit_cache.get(cache_key)
+        if plan is None:
+            policy = self._precision_policy()
+            # shape/dtype evidence for algebraic strips comes ONLY from
+            # actual bound arrays (VARIABLE + CONSTANT): declared
+            # PLACEHOLDER metadata is not enforced at feed time — feeds are
+            # shape/dtype-polymorphic under jit — so trusting it would bake
+            # a strip that is wrong for a differently-shaped/typed feed
+            seed_dtypes = {n: np.dtype(a.dtype) for n, a in self._arrays.items()}
+            var_shapes = {n: tuple(np.shape(a))
+                          for n, a in self._arrays.items()}
+            # seed with the reachable subgraph — the exact node set the
+            # unoptimized trace executes — so plan execution can never run
+            # (or fold) a dead node the plain path would have skipped, even
+            # with the 'dce' pass opted out; pipeline 'dce' then prunes
+            # nodes orphaned by folding/aliasing
+            plan = _opt.optimize_graph(
+                self._needed_nodes(out_names), list(out_names),
+                const_env=self._const_env(),
+                seed_dtypes=seed_dtypes,
+                var_shapes=var_shapes,
+                local_ops=self._local_ops,
+                resolve_op=lambda name: resolve_graph_op(name, self._local_ops),
+                passes=self.optimize_passes,
+                precision_policy=policy)
+            self._jit_cache[cache_key] = plan
+        self.last_compile_stats = plan.stats
+        return plan
+
+    def _interpret(self, env: Dict[str, Any], wanted: Sequence[str],
+                   plan=None) -> Dict[str, Any]:
+        """Run the needed subgraph in order (pure; called under trace/jit).
+        With a ``plan`` (GraphPlan), the optimized node list executes instead
+        and wanted names resolve through the plan's alias map; the caller
+        must have merged ``plan.extra_consts`` into ``env``."""
         from deeplearning4j_tpu.nn import dtype as DT
 
+        nodes = plan.nodes if plan is not None else self._needed_nodes(wanted)
         with DT.precision_scope(self._precision_policy()):
-            for node in self._needed_nodes(wanted):
+            for node in nodes:
                 if not all(i in env for i in node.inputs):
                     missing = [i for i in node.inputs if i not in env]
                     raise KeyError(
@@ -873,31 +935,43 @@ class SameDiff:
                 else:
                     for o, r in zip(node.outputs, res):
                         env[o] = r
+        if plan is not None:
+            return {w: env[plan.resolve(w)] for w in wanted}
         return {w: env[w] for w in wanted}
 
     def _exec_fn(self, out_names: Tuple[str, ...]):
-        """Build + cache the jitted whole-graph function for given outputs.
+        """Build + cache the compiled whole-graph function for given outputs.
 
         CONSTANT-vtype arrays are closed over (baked into the trace as
         literals) rather than passed as jit arguments: a constant passed as
         an argument becomes a tracer, which breaks trace-time-concrete
         shape arithmetic (imported tf.shape→Pack→Reshape chains) and denies
         XLA constant folding. VARIABLEs stay arguments so training updates
-        never trigger recompiles."""
-        cache_key = ("exec", out_names)
+        never trigger recompiles. The optimizer plan's folded constants join
+        the baked set; the CompiledGraph wrapper measures trace vs compile
+        seconds into ``last_compile_stats``."""
+        from deeplearning4j_tpu.autodiff.optimize import CompiledGraph
+
+        cache_key = ("exec", out_names, bool(self.optimize),
+                     self.optimize_passes)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
+            plan = self._graph_plan(out_names)
             const_env = self._const_env()
+            if plan is not None:
+                const_env = {**const_env, **plan.extra_consts}
 
             def run(var_arrays, feeds):
                 env = dict(const_env)
                 env.update(var_arrays)
                 env.update(feeds)
-                return self._interpret(env, out_names)
+                return self._interpret(env, out_names, plan)
 
-            fn = jax.jit(run)
+            fn = CompiledGraph(jax.jit(run),
+                               plan.stats if plan is not None else None)
             fn._const_names = frozenset(const_env)
             self._jit_cache[cache_key] = fn
+        self.last_compile_stats = fn.stats
         return fn
 
     def _var_arrays(self, fn):
@@ -933,17 +1007,21 @@ class SameDiff:
         (sd.calculateGradients analog)."""
         wrt = list(wrt) if wrt is not None else [
             n for n, v in self._vars.items() if v.vtype == "VARIABLE"]
-        cache_key = ("grad", loss_name, tuple(wrt))
+        cache_key = ("grad", loss_name, tuple(wrt), bool(self.optimize),
+                     self.optimize_passes)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
+            plan = self._graph_plan((loss_name,))
             const_env = self._const_env()
+            if plan is not None:
+                const_env = {**const_env, **plan.extra_consts}
 
             def loss_of(train_vars, other_arrays, feeds_):
                 env = dict(const_env)  # baked: constants stay un-traced
                 env.update(other_arrays)
                 env.update(train_vars)
                 env.update(feeds_)
-                return self._interpret(env, [loss_name])[loss_name]
+                return self._interpret(env, [loss_name], plan)[loss_name]
 
             fn = jax.jit(jax.grad(loss_of))
             fn._const_names = frozenset(const_env)
@@ -961,7 +1039,10 @@ class SameDiff:
     def _train_step_fn(self, loss_name: str):
         tc = self.training_config
         upd = tc.updater
+        plan = self._graph_plan((loss_name,))
         const_env = self._const_env()
+        if plan is not None:
+            const_env = {**const_env, **plan.extra_consts}
 
         def step_fn(train_vars, upd_state, step, other_arrays, feeds):
             def loss_of(tv):
@@ -969,7 +1050,7 @@ class SameDiff:
                 env.update(other_arrays)
                 env.update(tv)
                 env.update(feeds)
-                return self._interpret(env, [loss_name])[loss_name]
+                return self._interpret(env, [loss_name], plan)[loss_name]
 
             loss, grads = jax.value_and_grad(loss_of)(train_vars)
             lr = upd.lr(step)
@@ -1003,7 +1084,8 @@ class SameDiff:
         trainable = [n for n, v in self._vars.items() if v.vtype == "VARIABLE"]
         if self._updater_state is None:
             self._updater_state = {n: tc.updater.init_state(self._arrays[n]) for n in trainable}
-        step_key = ("train", loss_name)
+        step_key = ("train", loss_name, bool(self.optimize),
+                    self.optimize_passes)
         step_fn = self._jit_cache.get(step_key)
         if step_fn is None:
             step_fn = self._train_step_fn(loss_name)
@@ -1241,10 +1323,22 @@ class SameDiff:
     def set_arr(self, name: str, value) -> None:
         if name not in self._vars:
             raise KeyError(name)
-        self._arrays[name] = jnp.asarray(value)
+        old = self._arrays.get(name)
+        arr = jnp.asarray(value)
+        self._arrays[name] = arr
+        # keep the variable's declared metadata in sync — optimizer plans
+        # and shape inference read it, and a stale declared shape would
+        # survive the cache clear below
+        self._vars[name].shape = tuple(arr.shape)
+        self._vars[name].dtype = arr.dtype
         if self._vars[name].vtype == "CONSTANT":
-            # constants are BAKED into cached traces (_exec_fn/_const_env);
-            # changing one must invalidate every cached computation
+            # constants are BAKED into cached traces (_exec_fn/_const_env)
+            # AND into optimizer plans (fold results); changing one must
+            # invalidate every cached computation and plan
+            self._jit_cache.clear()
+        elif old is None or old.dtype != arr.dtype or old.shape != arr.shape:
+            # a VARIABLE changing dtype/shape invalidates optimizer plans
+            # (dtype-guarded identity strips) and forces a retrace anyway
             self._jit_cache.clear()
 
     def summary(self) -> str:

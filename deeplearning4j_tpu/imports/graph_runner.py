@@ -42,16 +42,24 @@ class GraphRunner:
     imported SameDiff. ``framework``: 'tensorflow' | 'onnx' | None (sniffed
     from the extension or wire format). ``outputs``: default fetch names
     (falls back to the graph's recorded outputs/terminal nodes).
+    ``optimize``: run the pre-trace graph optimizer (docs/OPTIMIZER.md) on
+    the imported graph before compiling (None = importer default, i.e. on;
+    for an already-built SameDiff, None leaves its own flag untouched);
+    per-compile instrumentation is surfaced as :attr:`compile_stats`.
     """
 
     def __init__(self, graph: Union[str, bytes, Any], *,
                  framework: Optional[str] = None,
-                 outputs: Optional[Sequence[str]] = None):
+                 outputs: Optional[Sequence[str]] = None,
+                 optimize: Optional[bool] = None):
         from deeplearning4j_tpu.autodiff.samediff import SameDiff
 
         if isinstance(graph, SameDiff):
             self.sd = graph
+            if optimize is not None:
+                self.sd.optimize = optimize
         else:
+            optimize = True if optimize is None else optimize
             data = graph
             if isinstance(graph, str):
                 if framework is None:
@@ -66,10 +74,11 @@ class GraphRunner:
                 framework = _sniff_framework(bytes(data))
             if framework == "onnx":
                 from deeplearning4j_tpu.imports.onnx_import import import_onnx
-                self.sd = import_onnx(data)
+                self.sd = import_onnx(data, optimize=optimize)
             elif framework in ("tensorflow", "tf"):
-                from deeplearning4j_tpu.imports.tf_import import import_frozen_graph
-                self.sd = import_frozen_graph(data)
+                from deeplearning4j_tpu.imports.tf_import import TensorflowImporter
+                self.sd = TensorflowImporter().run_import(data,
+                                                          optimize=optimize)
             else:
                 raise ValueError(f"unknown framework {framework!r}")
         self.framework = framework
@@ -86,6 +95,12 @@ class GraphRunner:
     @property
     def output_names(self) -> List[str]:
         return list(self._outputs)
+
+    @property
+    def compile_stats(self):
+        """OptimizeStats of the most recent compilation (None before the
+        first run) — per-pass node deltas, trace and XLA compile seconds."""
+        return self.sd.last_compile_stats
 
     def run(self, feeds: Dict[str, Any],
             outputs: Optional[Sequence[str]] = None) -> Dict[str, np.ndarray]:

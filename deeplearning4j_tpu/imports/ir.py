@@ -66,7 +66,8 @@ class IRImporter:
     def __init__(self, rules: Dict[str, Callable[..., Any]],
                  needs_consts: Sequence[str] = (),
                  trainable_consts: bool = True,
-                 needs_scope: Sequence[str] = ()):
+                 needs_scope: Sequence[str] = (),
+                 optimize: bool = True):
         self.rules = dict(rules)
         self.needs_consts = set(needs_consts)
         self.trainable_consts = trainable_consts
@@ -74,12 +75,17 @@ class IRImporter:
         # so far) — ONNX Loop/If/Scan subgraphs capture outer-scope tensors
         # by name, unlike TF function-style control flow
         self.needs_scope = set(needs_scope)
+        # pre-trace graph optimizer (autodiff/optimize.py): imported graphs
+        # carry the most redundancy (verbatim source nodes, per-layer
+        # duplicated chains, no-op Identity/Dropout), so every frontend
+        # that lowers through this walker gets the optimizer by default
+        self.optimize = optimize
 
     def supported_ops(self) -> List[str]:
         return sorted(self.rules)
 
     def run_import(self, ir: IRGraph) -> SameDiff:
-        sd = SameDiff.create()
+        sd = SameDiff.create(optimize=self.optimize)
         produced: Dict[str, SDVariable] = {}
         const_values: Dict[str, np.ndarray] = dict(ir.initializers)
 

@@ -491,12 +491,13 @@ if "identity" not in _sdmod.GRAPH_OPS:
 class OnnxImporter(IRImporter):
     """OnnxFrameworkImporter analog."""
 
-    def __init__(self, extra_mappers: Optional[Dict[str, Callable]] = None):
+    def __init__(self, extra_mappers: Optional[Dict[str, Callable]] = None,
+                 optimize: bool = True):
         rules = dict(ONNX_OP_MAPPERS)
         if extra_mappers:
             rules.update(extra_mappers)
         super().__init__(rules, needs_consts=_NEEDS_CONSTS,
-                         needs_scope=_NEEDS_SCOPE)
+                         needs_scope=_NEEDS_SCOPE, optimize=optimize)
 
     def run_import(self, model) -> SameDiff:  # type: ignore[override]
         if isinstance(model, str):
@@ -507,9 +508,10 @@ class OnnxImporter(IRImporter):
         return super().run_import(model)
 
 
-def import_onnx(path_or_bytes) -> SameDiff:
-    """One-call facade (KerasModelImport-style)."""
-    return OnnxImporter().run_import(path_or_bytes)
+def import_onnx(path_or_bytes, optimize: bool = True) -> SameDiff:
+    """One-call facade (KerasModelImport-style). ``optimize=False`` disables
+    the pre-trace graph optimizer (docs/OPTIMIZER.md)."""
+    return OnnxImporter(optimize=optimize).run_import(path_or_bytes)
 
 
 # ---------------------------------------------------------------------------

@@ -532,13 +532,15 @@ class TensorflowImporter:
         return sorted(self.mappers)
 
     def run_import(self, graph_def, *, trainable_consts: bool = True,
-                   variable_values=None, outputs=None) -> SameDiff:
+                   variable_values=None, outputs=None,
+                   optimize: bool = True) -> SameDiff:
         """GraphDef (or serialized bytes / .pb path) → SameDiff.
 
         ``variable_values``: name → ndarray table for VarHandleOp /
         VariableV2 nodes (the TFGraphMapper checkpoint-restore path,
         SURVEY §4.3 step 1) — restored values become VARIABLE-role
-        SDVariables, so fine-tuning starts from the trained weights."""
+        SDVariables, so fine-tuning starts from the trained weights.
+        ``optimize=False`` disables the pre-trace graph optimizer."""
         from deeplearning4j_tpu.imports.ir import IRImporter
 
         graph_def = _coerce_graph_def(graph_def)
@@ -548,7 +550,8 @@ class TensorflowImporter:
         ir = _inline_function_calls(ir, variable_values)
         ir = _collapse_tf1_control_flow(ir)
         walker = IRImporter(self.mappers, needs_consts=_NEEDS_CONSTS,
-                            trainable_consts=trainable_consts)
+                            trainable_consts=trainable_consts,
+                            optimize=optimize)
         return walker.run_import(ir)
 
 

@@ -1,0 +1,324 @@
+"""SameDiff pre-trace graph optimizer (autodiff/optimize.py).
+
+Per-pass equivalence (optimized vs unoptimized outputs AND grads on a mixed
+graph), pipeline idempotence, the stale-cache invalidation contract
+(constant rebind + graph mutation), per-pass opt-out, and the
+last_compile_stats instrumentation surface.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.optimize import (
+    PASS_ORDER, OptimizeStats, optimize_graph)
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+
+def _mixed_graph(optimize=True, optimize_passes=None):
+    """A graph exercising every pass: dead branch (dce), constant chain
+    (fold), duplicated subexpression (cse), identity/transpose/no-op
+    arithmetic (algebraic) — on top of placeholder + VARIABLE inputs."""
+    r = np.random.RandomState(0)
+    sd = SameDiff(optimize=optimize, optimize_passes=optimize_passes)
+    x = sd.placeholder("x", (4, 8))
+    w = sd.var("w", r.randn(8, 8).astype(np.float32) * 0.3)
+    b = sd.var("b", r.randn(8).astype(np.float32) * 0.1)
+    c = sd.constant("c", np.float32(64.0))
+    scale = sd.math.sqrt(c * c) / c          # foldable chain -> 1 node gone
+    pre = (x @ w + b) / scale
+    t1 = sd.math.tanh(pre)
+    t2 = sd.math.tanh(pre)                   # CSE duplicate
+    g = sd.nn.sigmoid(t1 + t2)
+    g = sd.op("identity", g)                 # identity chain
+    g = g * 1.0                              # mul-by-one
+    g = g + 0.0                              # add-zero
+    g = g.transpose(1, 0).transpose(1, 0)    # cancelling transposes
+    g = g.reshape(4, 8)                      # reshape-to-same shape
+    _dead = sd.math.exp(pre) @ w             # dead branch
+    loss = (g * g).mean()
+    loss.rename("loss")
+    feeds = {"x": r.randn(4, 8).astype(np.float32)}
+    return sd, feeds
+
+
+def _reference():
+    sd, feeds = _mixed_graph(optimize=False)
+    out = sd.output(feeds, ["loss"])["loss"]
+    grads = sd.calculate_gradients(feeds, "loss")
+    return out, grads, feeds
+
+
+class TestPassEquivalence:
+    @pytest.mark.parametrize("passes", [None] + [(p,) for p in PASS_ORDER])
+    def test_outputs_and_grads_match(self, passes):
+        ref_out, ref_grads, feeds = _reference()
+        sd, _ = _mixed_graph(optimize=True, optimize_passes=passes)
+        out = sd.output(feeds, ["loss"])["loss"]
+        np.testing.assert_allclose(out, ref_out, rtol=1e-6, atol=1e-6)
+        grads = sd.calculate_gradients(feeds, "loss")
+        assert set(grads) == set(ref_grads)
+        for k in ref_grads:
+            np.testing.assert_allclose(grads[k], ref_grads[k],
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_each_pass_fires_on_mixed_graph(self):
+        sd, feeds = _mixed_graph()
+        sd.output(feeds, ["loss"])
+        st = sd.last_compile_stats
+        for p in PASS_ORDER:
+            assert st.passes[p]["removed"] > 0, f"pass '{p}' removed nothing"
+
+    def test_output_aliased_to_placeholder(self):
+        # ir.py records identity nodes to alias graph outputs; the optimizer
+        # must keep the requested name fetchable after removing them
+        sd = SameDiff()
+        x = sd.placeholder("x", (3,))
+        sd._record("identity", [x]).rename("y")
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_array_equal(sd.output({"x": v}, ["y"])["y"], v)
+
+    def test_fully_folded_output(self):
+        sd = SameDiff()
+        c = sd.constant("c", np.float32(3.0))
+        (c * c + c).rename("out")
+        assert float(sd.output({}, ["out"])["out"]) == pytest.approx(12.0)
+        assert sd.last_compile_stats.nodes_after == 0
+
+    def test_cse_multi_output(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", (6,))
+        v1, i1 = sd.op("top_k", x, k=2, n_out=2)
+        v2, i2 = sd.op("top_k", x, k=2, n_out=2)
+        out = v1 + v2
+        out.rename("out")
+        (i1.sum() + i2.sum()).rename("idx")
+        feeds = {"x": np.asarray([3, 1, 4, 1, 5, 9], np.float32)}
+        res = sd.output(feeds, ["out", "idx"])
+        np.testing.assert_allclose(res["out"], [18.0, 10.0])
+        assert float(res["idx"]) == 18.0  # top-2 indices 5 and 4, twice
+        assert sd.last_compile_stats.passes["cse"]["removed"] >= 1
+
+    def test_dce_opt_out_never_executes_dead_nodes(self):
+        # plan seeding uses the reachable subgraph — the node set the
+        # unoptimized trace executes — so opting 'dce' out must not run
+        # (or fold) dead nodes, even ones that would error
+        sd = SameDiff(optimize_passes=("fold", "cse", "algebraic"))
+        x = sd.placeholder("x", (2, 2))
+        (x * 2.0).sum().rename("out")
+        x.reshape(999)  # dead AND impossible: must never execute
+        r = sd.output({"x": np.ones((2, 2), np.float32)}, ["out"])["out"]
+        assert float(r) == 8.0
+
+    def test_variable_rooted_strip_fires(self):
+        # dtype evidence from an actual bound array licenses the x*1/x+0
+        # strips (placeholder-rooted chains stay un-stripped: declared
+        # placeholder metadata is not enforced at feed time)
+        sd = SameDiff()
+        w = sd.var("w", np.asarray([1.0, 2.0], np.float32))
+        (w * 1.0 + 0.0).sum().rename("out")
+        np.testing.assert_allclose(sd.output({}, ["out"])["out"], 3.0)
+        assert sd.last_compile_stats.passes["algebraic"]["removed"] >= 2
+
+    def test_placeholder_reshape_not_stripped_for_polymorphic_feed(self):
+        # feeds are shape-polymorphic under jit: a reshape matching the
+        # DECLARED placeholder shape must survive, so a same-size feed of a
+        # different shape still gets reshaped (review-round regression)
+        sd = SameDiff()
+        x = sd.placeholder("x", (4, 3))
+        x.reshape(4, 3).rename("y")
+        out = sd.output({"x": np.ones((3, 4), np.float32)}, ["y"])["y"]
+        assert out.shape == (4, 3)
+
+    def test_var_reshape_after_shape_changing_set_arr(self):
+        # set_arr with a new shape refreshes the declared metadata AND
+        # clears plans, so a previously-stripped reshape re-materializes
+        # (review-round regression)
+        sd = SameDiff()
+        w = sd.var("w", np.ones((4, 3), np.float32))
+        w.reshape(4, 3).rename("y")
+        assert sd.output({}, ["y"])["y"].shape == (4, 3)
+        sd.set_arr("w", np.ones((3, 4), np.float32))
+        assert sd.output({}, ["y"])["y"].shape == (4, 3)
+
+    def test_bf16_add_zero_not_stripped(self):
+        # x(bf16) + 0.0(f32) promotes to f32; stripping would change the
+        # result dtype/precision — the dtype guard must keep the node
+        import jax.numpy as jnp
+
+        sd = SameDiff()
+        w = sd.var("w", jnp.asarray([1.0, 2.0], jnp.bfloat16))
+        (w + np.float32(0.0)).rename("out")
+        sd.output({}, ["out"])
+        # graph is bf16-policy; the add-zero survives (only fold may claim
+        # it — as a constant expression — never the algebraic strip)
+        assert sd.last_compile_stats.passes["algebraic"]["removed"] == 0
+
+
+class TestIdempotence:
+    def test_pipeline_twice_changes_nothing(self):
+        sd, _ = _mixed_graph()
+        seed_dtypes = {n: np.dtype(a.dtype) for n, a in sd._arrays.items()}
+        kw = dict(seed_dtypes=seed_dtypes, local_ops=sd._local_ops)
+        p1 = optimize_graph(sd._nodes, ["loss"],
+                            const_env=sd._const_env(), **kw)
+        assert p1.stats.nodes_after < p1.stats.nodes_before
+        p2 = optimize_graph(p1.nodes, [p1.resolve("loss")],
+                            const_env={**sd._const_env(), **p1.extra_consts},
+                            **kw)
+        assert len(p2.nodes) == len(p1.nodes)
+        assert [n.op for n in p2.nodes] == [n.op for n in p1.nodes]
+        assert [n.inputs for n in p2.nodes] == [n.inputs for n in p1.nodes]
+        assert not p2.alias
+        assert not p2.extra_consts
+
+    def test_unknown_pass_rejected(self):
+        sd, _ = _mixed_graph()
+        with pytest.raises(ValueError, match="unknown optimizer pass"):
+            optimize_graph(sd._nodes, ["loss"], const_env=sd._const_env(),
+                           passes=("dce", "nope"))
+
+
+class TestStaleCacheInvalidation:
+    def test_constant_rebind_after_optimized_compile(self):
+        # fold bakes c*c into the plan; set_arr on the constant goes through
+        # the same _jit_cache.clear() that invalidates compiled traces, so
+        # the next output() must re-fold against the new value
+        sd = SameDiff()
+        x = sd.placeholder("x", (3,))
+        c = sd.constant("c", np.float32(2.0))
+        (x + c * c).rename("out")
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(sd.output({"x": v}, ["out"])["out"], v + 4)
+        assert sd.last_compile_stats.passes["fold"]["removed"] >= 1
+        sd.set_arr("c", np.float32(3.0))
+        np.testing.assert_allclose(sd.output({"x": v}, ["out"])["out"], v + 9)
+
+    def test_graph_mutation_after_optimized_compile(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", (3,))
+        c = sd.constant("c", np.float32(2.0))
+        y = x * (c + c)
+        y.rename("out")
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(sd.output({"x": v}, ["out"])["out"], v * 4)
+        (y + c).rename("out2")  # mutation clears _jit_cache incl. plans
+        res = sd.output({"x": v}, ["out", "out2"])
+        np.testing.assert_allclose(res["out"], v * 4)
+        np.testing.assert_allclose(res["out2"], v * 4 + 2)
+
+    def test_rename_after_optimized_compile(self):
+        # rename rewrites node names in place; cached plans hold frozen
+        # name snapshots, so _rename must invalidate like any mutation
+        # (review-round regression)
+        sd = SameDiff()
+        x = sd.placeholder("x", (2,))
+        (x * 2.0).rename("y")
+        v = np.asarray([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(sd.output({"x": v}, ["y"])["y"], v * 2)
+        x.rename("inp")
+        np.testing.assert_allclose(sd.output({"inp": v}, ["y"])["y"], v * 2)
+
+    def test_variable_update_never_stale(self):
+        # VARIABLEs are jit arguments, never folded — updating one must be
+        # picked up WITHOUT a recompile-triggering invalidation
+        sd = SameDiff()
+        x = sd.placeholder("x", (3,))
+        w = sd.var("w", np.asarray([1.0, 1.0, 1.0], np.float32))
+        (x * w).rename("out")
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(sd.output({"x": v}, ["out"])["out"], v)
+        before = len(sd._jit_cache)
+        sd.set_arr("w", np.asarray([2.0, 2.0, 2.0], np.float32))
+        assert len(sd._jit_cache) == before  # same dtype/shape: no clear
+        np.testing.assert_allclose(sd.output({"x": v}, ["out"])["out"], v * 2)
+
+
+class TestTrainingPath:
+    def test_fit_matches_unoptimized(self):
+        from deeplearning4j_tpu import nn
+        from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+        from deeplearning4j_tpu.datasets.dataset import (
+            DataSet, ListDataSetIterator)
+
+        r = np.random.RandomState(3)
+        feats = r.randn(8, 4).astype(np.float32)
+        labs = r.randn(8, 2).astype(np.float32)
+
+        def run(optimize):
+            sd = SameDiff(optimize=optimize)
+            x = sd.placeholder("x", (None, 4))
+            y = sd.placeholder("y", (None, 2))
+            w = sd.var("w", r2.randn(4, 2).astype(np.float32))
+            c = sd.constant("c", np.float32(4.0))
+            pred = (x @ w) / sd.math.sqrt(c * c / c)  # foldable scale chain
+            pred = sd.op("identity", pred) * 1.0
+            sd.loss.mean_squared_error(pred, y).rename("l")
+            sd.set_training_config(TrainingConfig(
+                updater=nn.Sgd(learning_rate=0.1),
+                data_set_feature_mapping=["x"], data_set_label_mapping=["y"],
+                loss_variables=["l"]))
+            hist = sd.fit(ListDataSetIterator(DataSet(feats, labs),
+                                              batch_size=8), epochs=3)
+            return hist, sd.get_arr("w")
+
+        r2 = np.random.RandomState(7)
+        h0, w0 = run(False)
+        r2 = np.random.RandomState(7)
+        h1, w1 = run(True)
+        np.testing.assert_allclose(h0, h1, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-6)
+
+
+class TestStatsSurface:
+    def test_last_compile_stats_fields(self):
+        sd, feeds = _mixed_graph()
+        assert sd.last_compile_stats is None
+        sd.output(feeds, ["loss"])
+        st = sd.last_compile_stats
+        assert isinstance(st, OptimizeStats)
+        assert st.nodes_before > st.nodes_after > 0
+        assert st.removed == st.nodes_before - st.nodes_after
+        assert st.trace_seconds is not None and st.trace_seconds >= 0
+        assert st.compile_seconds is not None and st.compile_seconds >= 0
+        assert st.optimize_seconds > 0
+        d = st.to_dict()
+        assert set(d["passes"]) <= set(PASS_ORDER)
+        for entry in d["passes"].values():
+            assert {"before", "after", "removed"} <= set(entry)
+
+    def test_opt_out_runs_only_selected_passes(self):
+        sd, feeds = _mixed_graph(optimize_passes=("dce", "cse"))
+        sd.output(feeds, ["loss"])
+        st = sd.last_compile_stats
+        assert set(st.passes) == {"dce", "cse"}
+
+    def test_optimize_off_still_reports_compile_times(self):
+        sd, feeds = _mixed_graph(optimize=False)
+        sd.output(feeds, ["loss"])
+        st = sd.last_compile_stats
+        assert st.passes == {}
+        assert st.trace_seconds is not None
+        assert st.compile_seconds is not None
+
+    def test_graph_runner_exposes_stats(self):
+        from deeplearning4j_tpu.imports.graph_runner import GraphRunner
+
+        sd, feeds = _mixed_graph()
+        sd.graph_inputs, sd.graph_outputs = ["x"], ["loss"]
+        gr = GraphRunner(sd)
+        assert gr.compile_stats is None
+        gr.run(feeds)
+        assert gr.compile_stats.nodes_after < gr.compile_stats.nodes_before
+
+    def test_graph_runner_optimize_flag_on_samediff_instance(self):
+        # optimize= must also apply when wrapping an already-built SameDiff
+        # (the debug path: compare optimized vs unoptimized execution)
+        from deeplearning4j_tpu.imports.graph_runner import GraphRunner
+
+        sd, feeds = _mixed_graph()
+        sd.graph_inputs, sd.graph_outputs = ["x"], ["loss"]
+        gr = GraphRunner(sd, optimize=False)
+        assert sd.optimize is False
+        gr.run(feeds)
+        assert gr.compile_stats.passes == {}
+        assert GraphRunner(sd).sd.optimize is False  # None leaves it alone
