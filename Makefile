@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile native native-test lint lint-baseline
+.PHONY: test gate gate-fast bench bench-compile native native-test lint lint-baseline check check-baseline
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -11,6 +11,16 @@ lint:
 # regenerate the baseline (after FIXING findings — the baseline only shrinks)
 lint-baseline:
 	JAX_PLATFORMS=cpu python tools/graftlint.py --write-baseline
+
+# graftcheck: abstract shape/dtype verification of the SameDiff fixture
+# zoo (docs/ANALYSIS.md). Build-only — no jit, no device. Fails only on
+# findings NOT grandfathered in check_baseline.json (committed empty:
+# the fixtures must stay clean).
+check:
+	JAX_PLATFORMS=cpu python tools/graftcheck.py
+
+check-baseline:
+	JAX_PLATFORMS=cpu python tools/graftcheck.py --write-baseline
 
 # DL4J_TPU_REQUIRE_NATIVE=1: a missing native lib FAILS the ctypes tests
 # instead of silently exercising the numpy fallback (SURVEY §5.3)

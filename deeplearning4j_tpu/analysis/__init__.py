@@ -1,0 +1,34 @@
+"""graftcheck — abstract shape/dtype interpreter over SameDiff graphs.
+
+Verifies whole graphs BEFORE the ``jax.jit`` trace: symbolic shapes
+(concrete ints + named batch dims) and dtypes propagate through per-op
+inference rules, so a bad import rule or optimizer pass surfaces as a
+GC-coded finding with node provenance at graph-build time instead of an
+opaque XLA tracer error hundreds of nodes away (docs/ANALYSIS.md).
+
+Entry points:
+
+* ``SameDiff.check()`` / ``SameDiff(validate=True)`` — user surface
+* ``check_samediff(sd)`` / ``check_network(net)`` — direct calls
+* every importer (ONNX / TF / IR / Keras) runs the check automatically
+* ``autodiff/optimize.py`` asserts pass-pipeline shape/dtype invariance
+  through the same interpreter
+* ``python -m deeplearning4j_tpu.analysis`` — the gate's ``check`` stage
+  over the fixture zoo, baselined in ``check_baseline.json``
+"""
+
+from deeplearning4j_tpu.analysis.report import (
+    CheckReport, GC_CODES, GraphCheckError, PassInvariantError)
+from deeplearning4j_tpu.analysis.interpreter import (
+    check_samediff, infer_nodes, seed_avals)
+from deeplearning4j_tpu.analysis.network import check_network
+from deeplearning4j_tpu.analysis.values import AVal, Dim
+
+# the one-call spelling used by importers and docs
+check = check_samediff
+
+__all__ = [
+    "AVal", "CheckReport", "Dim", "GC_CODES", "GraphCheckError",
+    "PassInvariantError", "check", "check_network", "check_samediff",
+    "infer_nodes", "seed_avals",
+]

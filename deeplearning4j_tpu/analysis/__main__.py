@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.analysis.cli import main
+
+main()

@@ -1,0 +1,108 @@
+"""graftcheck finding model — GC error codes, reports, raise policy.
+
+Findings reuse :class:`deeplearning4j_tpu.lint.core.Finding` (path, line,
+rule, severity, message) so the graftlint baseline machinery
+(``load_baseline``/``write_baseline``/``diff_baseline``) works unchanged
+against ``check_baseline.json``. For a graph finding:
+
+* ``path``  — the logical graph name (``onnx:bert_base``, ``zoo/mlp`` …),
+  stable across runs so baseline keys survive;
+* ``line``  — the 1-based node position in the recording (provenance for
+  "which node", not a source line);
+* ``message`` — leads with the node provenance: op name + the node's
+  output name, which for imported graphs IS the source-graph node name
+  (importers rename outputs to source names — imports/ir.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.lint.core import Finding
+
+# code -> (severity, one-line title). Severity contract: errors are
+# PROVABLE miscompiles/misimports (a trace would fail or silently compute
+# the wrong thing); warnings are opacity/precision hazards.
+GC_CODES: Dict[str, Tuple[str, str]] = {
+    "GC001": ("error", "rank mismatch / invalid axis"),
+    "GC002": ("error", "broadcast or contraction failure"),
+    "GC003": ("warning", "dtype promotion surprise"),
+    "GC004": ("error", "unbound placeholder / dangling input"),
+    "GC005": ("error", "reshape element-count mismatch"),
+    "GC006": ("warning", "unknown-op opacity"),
+}
+
+
+class GraphCheckError(ValueError):
+    """Raised when a checked graph carries error-severity findings."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = list(findings)
+        lines = [f.render() for f in self.findings[:20]]
+        extra = len(self.findings) - len(lines)
+        if extra > 0:
+            lines.append(f"... and {extra} more")
+        super().__init__(
+            "graftcheck: graph failed static shape/dtype verification "
+            f"({len(self.findings)} error finding"
+            f"{'s' if len(self.findings) != 1 else ''}):\n"
+            + "\n".join(lines))
+
+
+class PassInvariantError(RuntimeError):
+    """An optimizer pass changed an interface shape/dtype it must preserve
+    (autodiff/optimize.py runs the interpreter between passes)."""
+
+    def __init__(self, pass_name: str, output: str, kind: str,
+                 before, after):
+        self.pass_name = pass_name
+        self.output = output
+        super().__init__(
+            f"optimizer pass '{pass_name}' changed the {kind} of graph "
+            f"output '{output}': {before} -> {after} — the pass pipeline "
+            f"must be shape/dtype-preserving; disable it via "
+            f"SameDiff(optimize_passes=...) and report the miscompile")
+
+
+class CheckReport:
+    """Result of one graph check: findings + the inferred abstract values
+    (name -> AVal) for introspection/tests."""
+
+    def __init__(self, graph_name: str, findings: List[Finding],
+                 avals: Optional[Dict[str, object]] = None):
+        self.graph_name = graph_name
+        self.findings = sorted(findings)
+        self.avals = avals or {}
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warning"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def raise_on_errors(self) -> "CheckReport":
+        if self.errors:
+            raise GraphCheckError(self.errors)
+        return self
+
+    def render(self) -> str:
+        if not self.findings:
+            return f"graftcheck: {self.graph_name}: clean"
+        return "\n".join(f.render() for f in self.findings)
+
+    def __repr__(self) -> str:
+        return (f"CheckReport({self.graph_name!r}, "
+                f"{len(self.errors)} errors, {len(self.warnings)} warnings)")
+
+
+def make_finding(graph_name: str, node_index: int, code: str,
+                 message: str) -> Finding:
+    severity, _title = GC_CODES[code]
+    return Finding(path=graph_name, line=node_index + 1, rule=code,
+                   severity=severity, message=message)

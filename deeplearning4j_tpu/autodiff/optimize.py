@@ -71,6 +71,9 @@ class OptimizeStats:
     # populated by CompiledGraph on the output() path (AOT lower/compile)
     trace_seconds: Optional[float] = None
     compile_seconds: Optional[float] = None
+    # graftcheck pass-invariance runs (docs/ANALYSIS.md): how many times
+    # the interface shapes/dtypes were re-verified between passes
+    invariant_checks: int = 0
 
     def record_pass(self, name: str, before: int, after: int) -> None:
         entry = self.passes.setdefault(
@@ -89,7 +92,8 @@ class OptimizeStats:
                 "passes": {k: dict(v) for k, v in self.passes.items()},
                 "optimize_seconds": round(self.optimize_seconds, 4),
                 "trace_seconds": self.trace_seconds,
-                "compile_seconds": self.compile_seconds}
+                "compile_seconds": self.compile_seconds,
+                "invariant_checks": self.invariant_checks}
 
 
 class GraphPlan:
@@ -193,16 +197,25 @@ def _canon_kwargs(kwargs: Dict[str, Any]):
         if isinstance(v, (list, tuple)):
             return tuple(c(x) for x in v)
         if isinstance(v, dict):
-            return tuple(sorted((k, c(x)) for k, x in v.items()))
+            # repr-sort the keys: mixed-type keys (int vs str) are
+            # unorderable and would abort the whole pass pipeline
+            return tuple(sorted(((k, c(x)) for k, x in v.items()),
+                                key=lambda kv: repr(kv[0])))
         if isinstance(v, np.ndarray):
             return ("__nd", v.shape, str(v.dtype), v.tobytes())
         return v
 
+    # Exclude-from-CSE fallback must cover EVERYTHING canonicalization can
+    # throw, not just TypeError: ndarray-like values with ambiguous
+    # truthiness raise ValueError inside sorted(), device arrays can raise
+    # their own errors from repr/compare, self-referential containers hit
+    # RecursionError. Any failure means "this node is not CSE-able",
+    # never "the optimizer pipeline dies".
     try:
         key = tuple(sorted((k, c(v)) for k, v in kwargs.items()))
         hash(key)
-    except TypeError:
-        return None  # unhashable attr (e.g. a callable) — not CSE-able
+    except Exception:
+        return None  # not canonicalizable/hashable — not CSE-able
     return key
 
 
@@ -398,6 +411,82 @@ def _algebraic(nodes, const_vals, var_shapes, seed_dtypes,
 
 
 # ---------------------------------------------------------------------------
+# pass-invariance checking (graftcheck — docs/ANALYSIS.md)
+# ---------------------------------------------------------------------------
+
+
+class _InvariantChecker:
+    """Abstract-interpret the working node list and compare the interface
+    (requested-output) shapes/dtypes against the pre-pipeline snapshot.
+
+    Every pass must be shape/dtype-preserving; a provable change (both the
+    snapshot and the current value concrete, and different) raises
+    :class:`~deeplearning4j_tpu.analysis.PassInvariantError` naming the
+    pass that introduced the miscompile. Symbolic/unknown entries are
+    skipped — soundness over coverage."""
+
+    def __init__(self, outputs, input_avals, var_shapes, seed_dtypes,
+                 local_ops, stats):
+        from deeplearning4j_tpu import analysis as _an
+
+        self._an = _an
+        self.outputs = list(outputs)
+        self.local_ops = local_ops
+        self.stats = stats
+        self.baseline: Dict[str, Any] = {}
+        # the non-const seed never changes across passes — build it once
+        self._static_seed: Dict[str, Any] = {}
+        for n, s in (var_shapes or {}).items():
+            self._static_seed[n] = _an.AVal(
+                shape=tuple(s), dtype=(seed_dtypes or {}).get(n))
+        for n, dt in (seed_dtypes or {}).items():
+            if n not in self._static_seed:
+                self._static_seed[n] = _an.AVal(dtype=dt)
+        for n, a in (input_avals or {}).items():
+            self._static_seed.setdefault(n, a)
+        # const_vals only ever GROWS (fold adds, nothing removes): abstract
+        # each value once instead of re-copying every <=4096-element
+        # constant to host on every verify call
+        self._const_avals: Dict[str, Any] = {}
+
+    def _interface(self, work, const_vals, alias) -> Dict[str, Any]:
+        an = self._an
+        for n, v in const_vals.items():
+            if n not in self._const_avals:
+                self._const_avals[n] = an.AVal.of_array(
+                    v, keep_value=np.size(v) <= 4096)
+        avals: Dict[str, Any] = dict(self._static_seed)
+        avals.update(self._const_avals)
+        an.infer_nodes(list(enumerate(work)), avals, self.local_ops,
+                       graph_name="<optimizer>", findings=[])
+        return {o: avals.get(_resolve(alias, o), an.AVal.unknown())
+                for o in self.outputs}
+
+    def snapshot(self, work, const_vals, alias) -> None:
+        self.baseline = self._interface(work, const_vals, alias)
+
+    def verify(self, pass_name, work, const_vals, alias) -> None:
+        an = self._an
+        current = self._interface(work, const_vals, alias)
+        self.stats.invariant_checks += 1
+        for out, before in self.baseline.items():
+            after = current[out]
+            if before.dtype is not None and after.dtype is not None \
+                    and before.dtype != after.dtype:
+                raise an.PassInvariantError(pass_name, out, "dtype",
+                                            before.dtype, after.dtype)
+            if before.shape is None or after.shape is None:
+                continue
+            if len(before.shape) != len(after.shape):
+                raise an.PassInvariantError(pass_name, out, "rank",
+                                            before.shape, after.shape)
+            for db, da in zip(before.shape, after.shape):
+                if isinstance(db, int) and isinstance(da, int) and db != da:
+                    raise an.PassInvariantError(pass_name, out, "shape",
+                                                before.shape, after.shape)
+
+
+# ---------------------------------------------------------------------------
 # pipeline driver
 # ---------------------------------------------------------------------------
 
@@ -411,13 +500,25 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
                    passes: Optional[Sequence[str]] = None,
                    fold_size_limit: int = FOLD_SIZE_LIMIT,
                    precision_policy: str = "float32",
-                   max_iters: int = _MAX_ITERS) -> GraphPlan:
+                   max_iters: int = _MAX_ITERS,
+                   input_avals: Optional[Dict[str, Any]] = None,
+                   check_invariants: Optional[bool] = None) -> GraphPlan:
     """Run the enabled passes over ``nodes`` until a fixpoint.
 
     Pure with respect to the inputs: ``nodes`` entries are copied, and
     ``const_env`` is never mutated (folded values land in
     ``GraphPlan.extra_consts``). ``passes=None`` enables all of
     :data:`PASS_ORDER`; pass a subset for per-pass opt-out.
+
+    ``check_invariants`` (default on; env opt-out
+    ``DL4J_TPU_CHECK_PASSES=0``): after every pass application the
+    graftcheck interpreter re-derives the interface shapes/dtypes of the
+    requested outputs and compares them to the pre-pipeline snapshot —
+    a pass that provably changes one (a bad transpose composition, a
+    dtype-unsound strip) raises PassInvariantError AT THE PASS that
+    introduced it, instead of shipping a miscompiled plan.
+    ``input_avals``: symbolic placeholder avals (name -> analysis.AVal)
+    so named batch dims survive into the invariance check.
     """
     t0 = time.perf_counter()
     local_ops = local_ops or {}
@@ -437,6 +538,17 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
     work = [_copy_node(n) for n in nodes]
     stats = OptimizeStats(nodes_before=len(work))
 
+    if check_invariants is None:
+        import os
+
+        check_invariants = os.environ.get("DL4J_TPU_CHECK_PASSES",
+                                          "1") != "0"
+    checker = None
+    if check_invariants:
+        checker = _InvariantChecker(outputs, input_avals, var_shapes,
+                                    seed_dtypes, local_ops, stats)
+        checker.snapshot(work, const_vals, alias)
+
     for _ in range(max_iters):
         changed = False
         for p in PASS_ORDER:
@@ -455,6 +567,11 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
                                       seed_dtypes or {}, alias, local_ops)
             ch |= _rewrite_inputs(work, alias)
             stats.record_pass(p, before, len(work))
+            if ch and checker is not None:
+                # every pass must preserve the interface shapes/dtypes;
+                # verify against the pre-pipeline snapshot so the FIRST
+                # deviating pass is the one named in the error
+                checker.verify(p, work, const_vals, alias)
             changed |= ch
         if not changed:
             break

@@ -717,10 +717,16 @@ class SameDiff:
     ``optimize.PASS_ORDER`` to enable (None = all; per-pass opt-out).
     ``last_compile_stats``: OptimizeStats for the most recent compilation
     (per-pass node deltas, trace seconds, XLA compile seconds).
+    ``validate``: run graftcheck (analysis/ — the abstract shape/dtype
+    interpreter, docs/ANALYSIS.md) before every compilation and raise
+    :class:`~deeplearning4j_tpu.analysis.GraphCheckError` on provable
+    shape/dtype errors, with node provenance — instead of the XLA tracer
+    error deep inside the trace. ``check()`` runs it on demand either way.
     """
 
     def __init__(self, optimize: bool = True,
-                 optimize_passes: Optional[Sequence[str]] = None) -> None:
+                 optimize_passes: Optional[Sequence[str]] = None,
+                 validate: bool = False) -> None:
         self._vars: Dict[str, SDVariable] = {}
         self._arrays: Dict[str, jnp.ndarray] = {}  # VARIABLE + CONSTANT values
         self._nodes: List[_Node] = []
@@ -750,12 +756,17 @@ class SameDiff:
         self.optimize_passes = (tuple(optimize_passes)
                                 if optimize_passes is not None else None)
         self.last_compile_stats = None
+        # graftcheck wiring (analysis/ — docs/ANALYSIS.md)
+        self.validate = validate
+        self.last_check_report = None
 
     # ------------------------------------------------------------- factories
     @staticmethod
     def create(optimize: bool = True,
-               optimize_passes: Optional[Sequence[str]] = None) -> "SameDiff":
-        return SameDiff(optimize=optimize, optimize_passes=optimize_passes)
+               optimize_passes: Optional[Sequence[str]] = None,
+               validate: bool = False) -> "SameDiff":
+        return SameDiff(optimize=optimize, optimize_passes=optimize_passes,
+                        validate=validate)
 
     def _fresh(self, prefix: str) -> str:
         self._name_counter += 1
@@ -873,6 +884,41 @@ class SameDiff:
                 return "bfloat16"
         return "float32"
 
+    # ------------------------------------------------------------ graftcheck
+    def check(self, outputs: Optional[Sequence[str]] = None,
+              name: str = "<samediff>"):
+        """Statically verify the graph with the abstract shape/dtype
+        interpreter (analysis/ — docs/ANALYSIS.md). Returns a CheckReport
+        whose findings carry GC error codes and node provenance; also
+        stored as ``last_check_report``. Does not raise — callers that
+        want the hard failure use ``report.raise_on_errors()`` (what
+        ``validate=True`` and the importers do)."""
+        from deeplearning4j_tpu.analysis import check_samediff
+
+        report = check_samediff(self, outputs=outputs, graph_name=name)
+        self.last_check_report = report
+        return report
+
+    def _input_avals(self):
+        """Declared placeholder metadata as symbolic avals — the optimizer's
+        pass-invariance checker unifies named batch dims through them."""
+        from deeplearning4j_tpu.analysis import AVal
+
+        return {n: AVal.of_placeholder(n, v.shape, v.dtype)
+                for n, v in self._vars.items() if v.vtype == "PLACEHOLDER"}
+
+    def _maybe_validate(self, out_names: Tuple[str, ...]) -> None:
+        """validate=True: graftcheck the subgraph about to be traced; a
+        provable shape/dtype error raises GraphCheckError here — at graph
+        level, with node provenance — not inside the XLA trace."""
+        if not self.validate:
+            return
+        cache_key = ("checked", out_names)
+        if cache_key in self._jit_cache:  # cleared on every graph mutation
+            return
+        self.check(outputs=out_names).raise_on_errors()
+        self._jit_cache[cache_key] = True
+
     def _graph_plan(self, out_names: Tuple[str, ...]):
         """Optimized execution plan for the given outputs, or None when the
         optimizer is off. Cached in ``_jit_cache`` so the exact paths that
@@ -907,7 +953,8 @@ class SameDiff:
                 local_ops=self._local_ops,
                 resolve_op=lambda name: resolve_graph_op(name, self._local_ops),
                 passes=self.optimize_passes,
-                precision_policy=policy)
+                precision_policy=policy,
+                input_avals=self._input_avals())
             self._jit_cache[cache_key] = plan
         self.last_compile_stats = plan.stats
         return plan
@@ -956,6 +1003,7 @@ class SameDiff:
                      self.optimize_passes)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
+            self._maybe_validate(out_names)
             plan = self._graph_plan(out_names)
             const_env = self._const_env()
             if plan is not None:
@@ -1011,6 +1059,7 @@ class SameDiff:
                      self.optimize_passes)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
+            self._maybe_validate((loss_name,))
             plan = self._graph_plan((loss_name,))
             const_env = self._const_env()
             if plan is not None:
@@ -1039,6 +1088,7 @@ class SameDiff:
     def _train_step_fn(self, loss_name: str):
         tc = self.training_config
         upd = tc.updater
+        self._maybe_validate((loss_name,))
         plan = self._graph_plan((loss_name,))
         const_env = self._const_env()
         if plan is not None:

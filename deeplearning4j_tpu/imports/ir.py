@@ -67,7 +67,8 @@ class IRImporter:
                  needs_consts: Sequence[str] = (),
                  trainable_consts: bool = True,
                  needs_scope: Sequence[str] = (),
-                 optimize: bool = True):
+                 optimize: bool = True,
+                 validate: bool = True):
         self.rules = dict(rules)
         self.needs_consts = set(needs_consts)
         self.trainable_consts = trainable_consts
@@ -80,6 +81,12 @@ class IRImporter:
         # duplicated chains, no-op Identity/Dropout), so every frontend
         # that lowers through this walker gets the optimizer by default
         self.optimize = optimize
+        # graftcheck (analysis/ — docs/ANALYSIS.md): imported graphs are
+        # where shape/dtype bugs enter, so every frontend verifies the
+        # finished SameDiff statically; provable errors raise
+        # GraphCheckError with node provenance AT IMPORT, not as an XLA
+        # tracer error at first execution
+        self.validate = validate
 
     def supported_ops(self) -> List[str]:
         return sorted(self.rules)
@@ -154,4 +161,10 @@ class IRImporter:
                 sd._record("identity", [produced[oname]]).rename(oname)
         sd.graph_inputs = [n for n, _ in ir.inputs]
         sd.graph_outputs = outs
+        if self.validate:
+            from deeplearning4j_tpu.analysis import check_samediff
+
+            report = check_samediff(sd, graph_name=ir.name)
+            sd.last_check_report = report
+            report.raise_on_errors()
         return sd

@@ -166,7 +166,8 @@ def _lstm(cfg, weights):
     return lc, p
 
 
-def _assemble_sequential(specs, input_type) -> nn.MultiLayerNetwork:
+def _assemble_sequential(specs, input_type,
+                         validate: bool = True) -> nn.MultiLayerNetwork:
     """Shared Sequential assembly + weight grafting: specs are
     (class_name, layer_cfg, weights) triples from EITHER a live keras model
     or an own-parsed h5 config. Keras flattens conv activations HWC-major
@@ -229,10 +230,21 @@ def _assemble_sequential(specs, input_type) -> nn.MultiLayerNetwork:
             net.params[i][k] = jax.tree.map(jnp.asarray, w)
         for k, v in st.items():
             net.net_state[i][k] = jnp.asarray(v)
+    # graftcheck (docs/ANALYSIS.md): same verify-after-import contract as
+    # the ONNX/TF frontends — provable layer shape errors raise here with
+    # layer provenance, not at first forward (validate=False opts out,
+    # matching import_onnx/TensorflowImporter)
+    if validate:
+        from deeplearning4j_tpu.analysis import check_network
+
+        net.last_check_report = check_network(
+            net, graph_name="keras:sequential")
+        net.last_check_report.raise_on_errors()
     return net
 
 
-def import_keras_model(model, input_type: Optional[C.InputType] = None):
+def import_keras_model(model, input_type: Optional[C.InputType] = None,
+                       validate: bool = True):
     """In-memory tf.keras model → MultiLayerNetwork (Sequential) or
     ComputationGraph (functional) — the KerasModelImport.importKeras*
     dispatch for live models."""
@@ -240,7 +252,8 @@ def import_keras_model(model, input_type: Optional[C.InputType] = None):
         weights_map = {kl.name: [np.asarray(w) for w in kl.get_weights()]
                        for kl in model.layers}
         config = {"class_name": "Functional", "config": model.get_config()}
-        return import_keras_functional_config(config, weights_map)
+        return import_keras_functional_config(config, weights_map,
+                                              validate=validate)
     specs = []
     for kl in model.layers:
         cls = type(kl).__name__
@@ -250,16 +263,17 @@ def import_keras_model(model, input_type: Optional[C.InputType] = None):
                       [np.asarray(w) for w in kl.get_weights()]))
     if input_type is None:
         input_type = _infer_input_type_from_shape(model.input_shape)
-    return _assemble_sequential(specs, input_type)
+    return _assemble_sequential(specs, input_type, validate=validate)
 
 
-def import_keras_sequential_model_and_weights(h5_path: str) -> nn.MultiLayerNetwork:
+def import_keras_sequential_model_and_weights(
+        h5_path: str, validate: bool = True) -> nn.MultiLayerNetwork:
     """KerasModelImport entry: load a saved .h5/.keras file via in-env keras,
     then convert."""
     import tensorflow as tf
 
     model = tf.keras.models.load_model(h5_path, compile=False)
-    return import_keras_model(model)
+    return import_keras_model(model, validate=validate)
 
 
 # ---------------------------------------------------------------------------
@@ -463,7 +477,9 @@ def _infer_input_type_from_shape(shape):
     raise ValueError(f"cannot infer InputType from {shape}")
 
 
-def import_keras_sequential_config(config, weights_map) -> nn.MultiLayerNetwork:
+def import_keras_sequential_config(config, weights_map,
+                                   validate: bool = True
+                                   ) -> nn.MultiLayerNetwork:
     """Sequential model_config + weights dict → MultiLayerNetwork (the
     own-h5 path; shares _assemble_sequential with the live-model path)."""
     specs = []
@@ -476,7 +492,7 @@ def import_keras_sequential_config(config, weights_map) -> nn.MultiLayerNetwork:
             input_shape = cfg["batch_input_shape"]
         specs.append((cls, cfg, weights_map.get(name, [])))
     return _assemble_sequential(
-        specs, _infer_input_type_from_shape(input_shape))
+        specs, _infer_input_type_from_shape(input_shape), validate=validate)
 
 
 # ---------------------------------------------------------------------------
@@ -528,7 +544,8 @@ def _out_names(spec) -> List[str]:
     return [s[0] if isinstance(s, (list, tuple)) else s for s in (spec or [])]
 
 
-def import_keras_functional_config(config, weights_map):
+def import_keras_functional_config(config, weights_map,
+                                   validate: bool = True):
     """Functional model_config + weights → ComputationGraph."""
     from deeplearning4j_tpu.nn import graph as G
 
@@ -605,10 +622,18 @@ def import_keras_functional_config(config, weights_map):
                 if isinstance(w, dict) else jnp.asarray(w))
         for k, v in blob["state"].items():
             net.net_state[name][k] = jnp.asarray(v)
+    # graftcheck (docs/ANALYSIS.md): verify the imported DAG statically,
+    # matching the ONNX/TF importers' contract (validate=False opts out)
+    if validate:
+        from deeplearning4j_tpu.analysis import check_network
+
+        net.last_check_report = check_network(
+            net, graph_name="keras:functional")
+        net.last_check_report.raise_on_errors()
     return net
 
 
-def import_keras_model_and_weights(path: str):
+def import_keras_model_and_weights(path: str, validate: bool = True):
     """KerasModelImport.importKerasModelAndWeights analog: reads legacy .h5
     OR the Keras-3 .keras zip with own parsing (h5py + zipfile — no
     tf.keras deserialization), dispatches Sequential → MultiLayerNetwork /
@@ -620,8 +645,10 @@ def import_keras_model_and_weights(path: str):
     else:
         config, weights = read_keras_h5(path)
     if config.get("class_name") == "Sequential":
-        return import_keras_sequential_config(config, weights)
-    return import_keras_functional_config(config, weights)
+        return import_keras_sequential_config(config, weights,
+                                              validate=validate)
+    return import_keras_functional_config(config, weights,
+                                          validate=validate)
 
 
 # layer classes that legitimately save no weight group in a .keras zip

@@ -492,12 +492,13 @@ class OnnxImporter(IRImporter):
     """OnnxFrameworkImporter analog."""
 
     def __init__(self, extra_mappers: Optional[Dict[str, Callable]] = None,
-                 optimize: bool = True):
+                 optimize: bool = True, validate: bool = True):
         rules = dict(ONNX_OP_MAPPERS)
         if extra_mappers:
             rules.update(extra_mappers)
         super().__init__(rules, needs_consts=_NEEDS_CONSTS,
-                         needs_scope=_NEEDS_SCOPE, optimize=optimize)
+                         needs_scope=_NEEDS_SCOPE, optimize=optimize,
+                         validate=validate)
 
     def run_import(self, model) -> SameDiff:  # type: ignore[override]
         if isinstance(model, str):
@@ -508,10 +509,13 @@ class OnnxImporter(IRImporter):
         return super().run_import(model)
 
 
-def import_onnx(path_or_bytes, optimize: bool = True) -> SameDiff:
+def import_onnx(path_or_bytes, optimize: bool = True,
+                validate: bool = True) -> SameDiff:
     """One-call facade (KerasModelImport-style). ``optimize=False`` disables
-    the pre-trace graph optimizer (docs/OPTIMIZER.md)."""
-    return OnnxImporter(optimize=optimize).run_import(path_or_bytes)
+    the pre-trace graph optimizer (docs/OPTIMIZER.md); ``validate=False``
+    skips the post-import graftcheck (docs/ANALYSIS.md)."""
+    return OnnxImporter(optimize=optimize,
+                        validate=validate).run_import(path_or_bytes)
 
 
 # ---------------------------------------------------------------------------

@@ -533,14 +533,16 @@ class TensorflowImporter:
 
     def run_import(self, graph_def, *, trainable_consts: bool = True,
                    variable_values=None, outputs=None,
-                   optimize: bool = True) -> SameDiff:
+                   optimize: bool = True,
+                   validate: bool = True) -> SameDiff:
         """GraphDef (or serialized bytes / .pb path) → SameDiff.
 
         ``variable_values``: name → ndarray table for VarHandleOp /
         VariableV2 nodes (the TFGraphMapper checkpoint-restore path,
         SURVEY §4.3 step 1) — restored values become VARIABLE-role
         SDVariables, so fine-tuning starts from the trained weights.
-        ``optimize=False`` disables the pre-trace graph optimizer."""
+        ``optimize=False`` disables the pre-trace graph optimizer;
+        ``validate=False`` skips the post-import graftcheck."""
         from deeplearning4j_tpu.imports.ir import IRImporter
 
         graph_def = _coerce_graph_def(graph_def)
@@ -551,7 +553,7 @@ class TensorflowImporter:
         ir = _collapse_tf1_control_flow(ir)
         walker = IRImporter(self.mappers, needs_consts=_NEEDS_CONSTS,
                             trainable_consts=trainable_consts,
-                            optimize=optimize)
+                            optimize=optimize, validate=validate)
         return walker.run_import(ir)
 
 

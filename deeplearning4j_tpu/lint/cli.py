@@ -22,14 +22,12 @@ Exit code 0 iff there are no findings beyond the grandfathered baseline.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 from typing import List, Optional, Sequence
 
 from deeplearning4j_tpu.lint.core import (
-    AST_RULES, Finding, diff_baseline, lint_paths, load_baseline,
-    write_baseline)
+    AST_RULES, Finding, lint_paths, run_baselined_cli)
 
 DEFAULT_ROOTS = ("deeplearning4j_tpu", "tools", "examples")
 
@@ -93,59 +91,17 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         findings.extend(run_consistency(repo_root))
     findings.sort()
 
-    if args.write_baseline:
-        refused = write_baseline(baseline_path, findings,
-                                 allow_growth=args.allow_growth)
-        kept = len(findings) - sum(refused.values())
-        if args.json:   # keep the one-JSON-line contract in every mode
-            print(json.dumps({"tool": "graftlint", "wrote_baseline": True,
-                              "total": kept,
-                              "refused_growth": sum(refused.values()),
-                              "baseline_path": baseline_path}, sort_keys=True))
-        else:
-            print(f"graftlint: wrote {kept} grandfathered findings "
-                  f"to {baseline_path}")
-            for key, n in sorted(refused.items()):
-                print(f"graftlint: REFUSED to grandfather new finding "
-                      f"(x{n}): {key}")
-            if refused:
-                print("graftlint: fix the refused findings (or, only when "
-                      "onboarding a new rule, re-run with --allow-growth)")
-        return 1 if refused else 0
-
-    baseline = load_baseline(baseline_path)
-    new, fixed = diff_baseline(findings, baseline)
-    if subset:
-        # baseline entries outside the scanned paths are "missing", not
-        # fixed — report none in either output mode
-        fixed = []
-
-    if args.json:
-        # ONE parsable line — the gate/driver artifact contract
-        print(json.dumps({
-            "tool": "graftlint",
-            "total": len(findings),
-            "baselined": len(findings) - len(new),
-            "new": len(new),
-            "fixed_baseline_keys": len(fixed),
-            "findings": [f.as_dict() for f in new[:50]],
-        }, sort_keys=True))
-        return 1 if new else 0
-
-    for f in new:
-        print(f.render())
-    if fixed:
-        print(f"graftlint: {len(fixed)} baseline entr"
-              f"{'y is' if len(fixed) == 1 else 'ies are'} fixed — run "
-              f"--write-baseline to shrink the baseline")
-    print(f"graftlint: {len(findings)} findings "
-          f"({len(findings) - len(new)} grandfathered, {len(new)} new)")
-    if new:
-        print("graftlint: FAIL — fix the new findings above or (only with "
-              "a written justification) add a 'graftlint: disable=<RULE>' "
-              "comment")
-        return 1
-    return 0
+    # shared baseline-CLI tail (lint/core.py — also drives graftcheck):
+    # --write-baseline shrink-only flow, or diff + one-JSON-line contract
+    return run_baselined_cli(
+        "graftlint", findings, baseline_path,
+        write=args.write_baseline, allow_growth=args.allow_growth,
+        json_mode=args.json,
+        # a subset scan cannot tell "fixed" from "outside the paths"
+        suppress_fixed=subset,
+        fail_hint="fix the new findings above or (only with a written "
+                  "justification) add a 'graftlint: disable=<RULE>' "
+                  "comment")
 
 
 def main() -> None:

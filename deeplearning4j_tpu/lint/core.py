@@ -156,13 +156,22 @@ def load_baseline(path: str) -> Dict[str, int]:
     return {str(k): int(v) for k, v in data.get("findings", {}).items()}
 
 
+_DEFAULT_BASELINE_COMMENT = (
+    "graftlint grandfathered findings — every entry is debt; "
+    "shrink, never grow. Regenerate: make lint-baseline")
+
+
 def write_baseline(path: str, findings: Sequence[Finding],
-                   allow_growth: bool = False) -> Dict[str, int]:
+                   allow_growth: bool = False,
+                   comment: str = _DEFAULT_BASELINE_COMMENT
+                   ) -> Dict[str, int]:
     """Write the baseline; shrink-only by default. Findings whose key is
     absent from (or whose count exceeds) the EXISTING baseline are refused
     — returned to the caller instead of written — so regenerating the
     baseline can never silently grandfather a regression. ``allow_growth``
-    is the explicit escape hatch for onboarding a brand-new rule."""
+    is the explicit escape hatch for onboarding a brand-new rule.
+    ``comment``: the self-describing header (graftcheck passes its own —
+    this module is the shared Finding/baseline plumbing for both tools)."""
     counts: Dict[str, int] = {}
     for f in findings:
         counts[f.key] = counts.get(f.key, 0) + 1
@@ -178,14 +187,76 @@ def write_baseline(path: str, findings: Sequence[Finding],
                 else:
                     del counts[key]
     payload = {
-        "comment": "graftlint grandfathered findings — every entry is debt; "
-                   "shrink, never grow. Regenerate: make lint-baseline",
+        "comment": comment,
         "findings": {k: counts[k] for k in sorted(counts)},
     }
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=2, sort_keys=False)
         fh.write("\n")
     return refused
+
+
+def run_baselined_cli(tool: str, findings: Sequence[Finding],
+                      baseline_path: str, *, write: bool,
+                      allow_growth: bool, json_mode: bool,
+                      comment: str = _DEFAULT_BASELINE_COMMENT,
+                      suppress_fixed: bool = False,
+                      fail_hint: str = "") -> int:
+    """Shared CLI tail for the baselined analysis tools (graftlint /
+    graftcheck): --write-baseline (shrink-only, refusal reporting), or
+    diff-and-report with the one-JSON-line gate contract. Returns the
+    process exit code. ``suppress_fixed``: a subset scan cannot tell
+    "fixed" from "outside the scanned paths" — report none."""
+    if write:
+        refused = write_baseline(baseline_path, findings,
+                                 allow_growth=allow_growth, comment=comment)
+        kept = len(findings) - sum(refused.values())
+        if json_mode:   # keep the one-JSON-line contract in every mode
+            print(json.dumps({"tool": tool, "wrote_baseline": True,
+                              "total": kept,
+                              "refused_growth": sum(refused.values()),
+                              "baseline_path": baseline_path},
+                             sort_keys=True))
+        else:
+            print(f"{tool}: wrote {kept} grandfathered findings "
+                  f"to {baseline_path}")
+            for key, n in sorted(refused.items()):
+                print(f"{tool}: REFUSED to grandfather new finding "
+                      f"(x{n}): {key}")
+            if refused:
+                print(f"{tool}: fix the refused findings (or, only when "
+                      f"onboarding a new rule, re-run with --allow-growth)")
+        return 1 if refused else 0
+
+    baseline = load_baseline(baseline_path)
+    new, fixed = diff_baseline(findings, baseline)
+    if suppress_fixed:
+        fixed = []
+
+    if json_mode:
+        # ONE parsable line — the gate/driver artifact contract
+        print(json.dumps({
+            "tool": tool,
+            "total": len(findings),
+            "baselined": len(findings) - len(new),
+            "new": len(new),
+            "fixed_baseline_keys": len(fixed),
+            "findings": [f.as_dict() for f in new[:50]],
+        }, sort_keys=True))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if fixed:
+        print(f"{tool}: {len(fixed)} baseline entr"
+              f"{'y is' if len(fixed) == 1 else 'ies are'} fixed — run "
+              f"--write-baseline to shrink the baseline")
+    print(f"{tool}: {len(findings)} findings "
+          f"({len(findings) - len(new)} grandfathered, {len(new)} new)")
+    if new:
+        print(f"{tool}: FAIL — {fail_hint or 'fix the new findings above'}")
+        return 1
+    return 0
 
 
 def diff_baseline(findings: Sequence[Finding], baseline: Dict[str, int]

@@ -6,6 +6,8 @@ GL003  Python side effects under jit (print, global/nonlocal mutation)
 GL004  PRNG key reuse without split
 GL005  mutable default arguments in public APIs
 GL007  bare except / swallowed exceptions
+GL009  np.* inside a GRAPH_OPS / registry op impl off the numpy-static
+       whitelist — silent host fallback under jit, in op-impl form
 
 (GL006 and GL008 live in rules_consistency — they need the live registries.)
 
@@ -406,6 +408,122 @@ def rule_mutable_defaults(tree, lines, path) -> List[Finding]:
                     message=f"mutable default argument in public "
                             f"'{fn.name}' is shared across calls; default "
                             f"to None and build inside"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL009 — numpy inside graph-op implementations
+# ---------------------------------------------------------------------------
+
+# ops whose impls are DOCUMENTED numpy-static (docs/LINT.md, docs/
+# ANALYSIS.md): they deliberately stay on host so imported
+# tf.shape→Pack→Reshape chains keep trace-time-concrete ints. Everything
+# else reaching np.* under a jit trace is the round-5 hang class in
+# op-impl form: a silent device→host sync (or a tracer leak) every step.
+NUMPY_STATIC_OP_WHITELIST = frozenset(["shape_of", "stack", "unstack"])
+
+_OP_DECORATOR_NAMES = {"op", "_op"}
+_OP_REGISTER_METHODS = {"register"}
+
+
+def _graph_op_impls(tree: ast.Module):
+    """Yield (op_name, function-or-lambda node) for every statically
+    recognizable graph-op implementation:
+
+    * values of a dict literal assigned to ``GRAPH_OPS``;
+    * ``GRAPH_OPS["name"] = <lambda | local def>`` (any ``*GRAPH_OPS``
+      spelling — importers patch the table under aliases);
+    * functions decorated ``@op("name")`` / ``@_op("name")`` (the
+      declarable-op registry idiom);
+    * ``<reg>.register("name", fn)`` with a local ``def fn``.
+    """
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    for node in ast.walk(tree):
+        # GRAPH_OPS = { "name": <lambda>, ... } — plain OR annotated
+        # (the real table is `GRAPH_OPS: Dict[str, Callable] = {...}`)
+        dict_targets = []
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            dict_targets = list(node.targets)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.value, ast.Dict):
+            dict_targets = [node.target]
+        for tgt in dict_targets:
+            name = _dotted(tgt)
+            if name is None or not name.split(".")[-1].endswith("GRAPH_OPS"):
+                continue
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    impl = v if isinstance(v, ast.Lambda) else (
+                        defs.get(v.id) if isinstance(v, ast.Name) else None)
+                    if impl is not None:
+                        yield k.value, impl
+        # GRAPH_OPS["name"] = impl
+        if isinstance(node, ast.Assign) and node.targets and \
+                isinstance(node.targets[0], ast.Subscript):
+            sub = node.targets[0]
+            name = _dotted(sub.value)
+            if name is not None and name.split(".")[-1].endswith("GRAPH_OPS"):
+                key = sub.slice
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    impl = node.value if isinstance(node.value, ast.Lambda) \
+                        else (defs.get(node.value.id)
+                              if isinstance(node.value, ast.Name) else None)
+                    if impl is not None:
+                        yield key.value, impl
+        # @op("name") / @_op("name") def impl(...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and dec.args and \
+                        isinstance(dec.args[0], ast.Constant) and \
+                        isinstance(dec.args[0].value, str):
+                    d = _dotted(dec.func)
+                    if d is not None and d.split(".")[-1] in _OP_DECORATOR_NAMES:
+                        yield dec.args[0].value, node
+        # reg.register("name", fn)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _OP_REGISTER_METHODS \
+                and len(node.args) >= 2 \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            impl = node.args[1] if isinstance(node.args[1], ast.Lambda) else (
+                defs.get(node.args[1].id)
+                if isinstance(node.args[1], ast.Name) else None)
+            if impl is not None:
+                yield node.args[0].value, impl
+
+
+@ast_rule("GL009", "np.* inside a graph-op impl off the numpy-static whitelist")
+def rule_numpy_in_op_impl(tree, lines, path) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for op_name, impl in _graph_op_impls(tree):
+        if op_name in NUMPY_STATIC_OP_WHITELIST:
+            continue
+        for node in ast.walk(impl):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            base = _dotted(f.value)
+            if base not in _NUMPY_ALIASES:
+                continue
+            key = (op_name, node.lineno)
+            if key in seen:  # one op impl can be yielded via two idioms
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                path=path, line=node.lineno, rule="GL009",
+                severity="error",
+                message=f"{base}.{f.attr}() inside graph-op impl "
+                        f"'{op_name}' runs on host under jit (silent "
+                        f"fallback / tracer leak); use jnp, or add the op "
+                        f"to the documented numpy-static whitelist "
+                        f"(shape_of/stack/unstack) with justification"))
     return findings
 
 

@@ -112,7 +112,8 @@ def unique(x, *, size: int = None, fill_value=0):
     """unique values + inverse indices (generic/parity_ops/unique.cpp).
     XLA needs static shapes: pass size (defaults to len(x)); extras padded
     with fill_value."""
-    size = size if size is not None else int(np.prod(x.shape))
+    # np on x.shape only — static ints, never traced data
+    size = size if size is not None else int(np.prod(x.shape))  # graftlint: disable=GL009
     vals, inv = jnp.unique(x.ravel(), return_inverse=True, size=size,
                            fill_value=fill_value)
     return vals, inv.reshape(x.shape)

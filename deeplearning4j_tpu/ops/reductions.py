@@ -142,8 +142,9 @@ def _count_nonzero(x, *, axis=None, keepdims: bool = False):
 
 def _count_zero(x, *, axis=None, keepdims: bool = False):
     """count_zero (generic/reduce/countZero analog)."""
-    total = np.prod([x.shape[a] for a in (
-        range(x.ndim) if axis is None else np.atleast_1d(axis))], dtype=int)
+    # np over x.shape/axis only — static ints, never traced data
+    total = np.prod([x.shape[a] for a in (  # graftlint: disable=GL009
+        range(x.ndim) if axis is None else np.atleast_1d(axis))], dtype=int)  # graftlint: disable=GL009
     return total - jnp.count_nonzero(x, axis=axis, keepdims=keepdims)
 
 

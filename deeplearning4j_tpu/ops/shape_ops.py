@@ -101,7 +101,8 @@ def split(x, *, num_split: int, axis: int = 0):
 @_op("split_v")
 def split_v(x, *, sizes, axis: int = 0):
     """split by explicit sizes (generic/parity_ops/split_v.cpp)."""
-    idx = np.cumsum(sizes)[:-1]
+    # np over the static sizes kwarg — never traced data
+    idx = np.cumsum(sizes)[:-1]  # graftlint: disable=GL009
     return tuple(jnp.split(x, idx, axis=axis))
 
 
@@ -178,7 +179,8 @@ def shape_of(x):
 @_op("size")
 def size(x):
     """total element count (generic/shape/size.cpp)."""
-    return jnp.asarray(int(np.prod(x.shape)), jnp.int32)
+    # np on x.shape only — static ints, never traced data
+    return jnp.asarray(int(np.prod(x.shape)), jnp.int32)  # graftlint: disable=GL009
 
 
 @_op("zeros_like")
@@ -295,7 +297,8 @@ def space_to_batch(x, *, block_shape, paddings):
         perm.append(1 + 2 * i)
     perm += list(range(1 + 2 * len(block), x.ndim))
     x = x.transpose(perm)
-    return x.reshape((n * int(np.prod(block)),) +
+    # np over the static block_shape kwarg — never traced data
+    return x.reshape((n * int(np.prod(block)),) +  # graftlint: disable=GL009
                      tuple(s // b for s, b in zip(spatial, block)) + tuple(rest))
 
 
@@ -645,7 +648,10 @@ def reshape_dynamic(x, shape):
     import numpy as np
 
     try:
-        dims = tuple(int(s) for s in np.asarray(shape))
+        # deliberately numpy-static, same family as shape_of/stack: the
+        # shape operand must be trace-time concrete (tracers are refused
+        # loudly below), so np here is the contract, not a fallback
+        dims = tuple(int(s) for s in np.asarray(shape))  # graftlint: disable=GL009
     except Exception as e:  # a tracer leaked into the shape chain
         raise NotImplementedError(
             "reshape_dynamic: target shape is data-dependent (not derivable "

@@ -229,7 +229,8 @@ def _register_select():
         """reference Where (index form): returns indices of nonzero entries.
         Dynamic output size is not XLA-expressible; mirrors jnp.argwhere with
         the size= escape hatch (padded with fill_value=-1)."""
-        n = int(np.prod(cond.shape))
+        # np on cond.shape only — static ints, never traced data
+        n = int(np.prod(cond.shape))  # graftlint: disable=GL009
         return jnp.argwhere(cond, size=n, fill_value=-1)
 
     def select_v1(cond, x, y):

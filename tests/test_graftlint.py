@@ -305,6 +305,91 @@ class TestGL007BareExcept:
 # ---------------------------------------------------------------------------
 
 
+class TestGL009NumpyInOpImpl:
+    def test_true_positive_dict_literal(self):
+        findings = _lint("""
+            import numpy as np
+            GRAPH_OPS = {
+                "my_op": lambda a: np.asarray(a).sum(),
+            }
+        """)
+        assert "GL009" in _rules_hit(findings)
+
+    def test_true_positive_annotated_dict_literal(self):
+        # the REAL table is `GRAPH_OPS: Dict[...] = {...}` (AnnAssign) —
+        # the rule must scan it too (review regression)
+        findings = _lint("""
+            import numpy as np
+            GRAPH_OPS: Dict[str, Callable] = {
+                "bad_op": lambda a: np.asarray(a).sum(),
+            }
+        """)
+        assert "GL009" in _rules_hit(findings)
+
+    def test_true_positive_subscript_assign(self):
+        findings = _lint("""
+            import numpy as np
+            def _impl(a):
+                return np.stack([a, a])
+            _sdmod.GRAPH_OPS["patched_op"] = _impl
+        """)
+        assert "GL009" in _rules_hit(findings)
+
+    def test_true_positive_registry_decorator(self):
+        findings = _lint("""
+            import numpy as np
+            @_op("my_reduce")
+            def my_reduce(x):
+                return np.sum(x)
+        """)
+        assert "GL009" in _rules_hit(findings)
+
+    def test_true_positive_register_call(self):
+        findings = _lint("""
+            import numpy as np
+            def fancy(x):
+                return np.asarray(x)
+            _REG.register("fancy", fancy)
+        """)
+        assert "GL009" in _rules_hit(findings)
+
+    def test_true_negative_whitelisted_numpy_static(self):
+        # shape_of/stack/unstack are DOCUMENTED numpy-static (their host
+        # behavior is the contract) — never flagged
+        findings = _lint("""
+            import numpy as np
+            @_op("stack")
+            def stack(*xs, axis=0):
+                return np.stack([np.asarray(x) for x in xs], axis=axis)
+
+            @_op("shape_of")
+            def shape_of(x):
+                return np.asarray(x.shape, np.int32)
+        """)
+        assert "GL009" not in _rules_hit(findings)
+
+    def test_true_negative_jnp_and_helpers(self):
+        # jnp inside an impl and np inside a NON-op helper are both fine
+        findings = _lint("""
+            import numpy as np
+            import jax.numpy as jnp
+
+            GRAPH_OPS = {"ok_op": lambda a: jnp.asarray(a).sum()}
+
+            def plain_helper(x):
+                return np.asarray(x)   # not a graph-op impl
+        """)
+        assert "GL009" not in _rules_hit(findings)
+
+    def test_repo_op_impl_numpy_is_whitelisted_or_justified(self):
+        """The live ops/ tree carries no un-justified np in op impls —
+        every hit is either whitelisted (shape_of/stack/unstack) or has an
+        inline disable with a written justification."""
+        findings = lint_paths(["deeplearning4j_tpu/ops"], REPO,
+                              rules=["GL009"])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
 class TestGL006RegistryShadowing:
     def test_repo_whitelist_is_exact(self):
         from deeplearning4j_tpu.lint.rules_consistency import (

@@ -2,15 +2,16 @@
 
 Per-pass equivalence (optimized vs unoptimized outputs AND grads on a mixed
 graph), pipeline idempotence, the stale-cache invalidation contract
-(constant rebind + graph mutation), per-pass opt-out, and the
-last_compile_stats instrumentation surface.
+(constant rebind + graph mutation), per-pass opt-out, the
+last_compile_stats instrumentation surface, and the graftcheck
+pass-invariance contract (docs/ANALYSIS.md).
 """
 
 import numpy as np
 import pytest
 
 from deeplearning4j_tpu.autodiff.optimize import (
-    PASS_ORDER, OptimizeStats, optimize_graph)
+    PASS_ORDER, OptimizeStats, _canon_kwargs, optimize_graph)
 from deeplearning4j_tpu.autodiff.samediff import SameDiff
 
 
@@ -152,6 +153,123 @@ class TestPassEquivalence:
         # graph is bf16-policy; the add-zero survives (only fold may claim
         # it — as a constant expression — never the algebraic strip)
         assert sd.last_compile_stats.passes["algebraic"]["removed"] == 0
+
+
+class TestPassInvariance:
+    """Every pass is shape/dtype-preserving on the requested outputs,
+    verified through the graftcheck abstract interpreter
+    (docs/OPTIMIZER.md § Pass invariance)."""
+
+    def _interface(self, sd, nodes, extra_consts, name):
+        """Abstract aval of `name` after executing `nodes` (interpreter)."""
+        from deeplearning4j_tpu.analysis import infer_nodes, seed_avals
+
+        avals, known = seed_avals(sd)
+        for k, v in extra_consts.items():
+            from deeplearning4j_tpu.analysis import AVal
+
+            avals[k] = AVal.of_array(v, keep_value=True)
+            known.add(k)
+        infer_nodes(list(enumerate(nodes)), avals, sd._local_ops,
+                    findings=[], known_names=known)
+        return avals.get(name)
+
+    @pytest.mark.parametrize("passes", [(p,) for p in PASS_ORDER])
+    def test_each_pass_preserves_interface_avals(self, passes):
+        # the satellite contract: for EVERY pass, the interpreter-derived
+        # shape/dtype of the surviving output matches the unoptimized graph
+        sd, _ = _mixed_graph()
+        seed_dtypes = {n: np.dtype(a.dtype) for n, a in sd._arrays.items()}
+        before = self._interface(sd, sd._nodes, {}, "loss")
+        plan = optimize_graph(sd._nodes, ["loss"],
+                              const_env=sd._const_env(),
+                              seed_dtypes=seed_dtypes,
+                              var_shapes={n: tuple(np.shape(a))
+                                          for n, a in sd._arrays.items()},
+                              local_ops=sd._local_ops,
+                              passes=passes,
+                              input_avals=sd._input_avals())
+        after = self._interface(sd, plan.nodes, plan.extra_consts,
+                                plan.resolve("loss"))
+        assert before.shape == after.shape == ()  # scalar loss, both known
+        assert before.dtype == after.dtype == np.dtype(np.float32)
+
+    def test_invariant_checks_run_by_default(self):
+        sd, feeds = _mixed_graph()
+        sd.output(feeds, ["loss"])
+        st = sd.last_compile_stats
+        assert st.invariant_checks > 0
+        assert st.to_dict()["invariant_checks"] == st.invariant_checks
+
+    def test_env_opt_out(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_CHECK_PASSES", "0")
+        sd, feeds = _mixed_graph()
+        sd.output(feeds, ["loss"])
+        assert sd.last_compile_stats.invariant_checks == 0
+
+    def test_interface_change_raises_naming_the_pass(self):
+        # drive the checker directly with a tampered "pass" result: the
+        # transpose that produced the output vanished, so the interface
+        # shape flips from (3, 2) to (2, 3) — the checker must name the
+        # offending pass
+        from deeplearning4j_tpu.analysis import PassInvariantError
+        from deeplearning4j_tpu.autodiff.optimize import (
+            OptimizeStats as _Stats, _InvariantChecker)
+
+        sd = SameDiff()
+        x = sd.placeholder("x", (2, 3))
+        t = sd._record("transpose", [x], {"axes": (1, 0)})
+        t.rename("out")
+        stats = _Stats()
+        checker = _InvariantChecker(["out"], sd._input_avals(), {}, {},
+                                    sd._local_ops, stats)
+        checker.snapshot(sd._nodes, {}, {})
+        tampered_alias = {"out": "x"}  # a broken pass aliased through
+        with pytest.raises(PassInvariantError, match="'algebraic'"):
+            checker.verify("algebraic", [], {}, tampered_alias)
+        assert stats.invariant_checks == 1
+
+
+class TestCanonKwargsHardening:
+    """_canon_kwargs must exclude un-canonicalizable nodes from CSE, never
+    abort the pass pipeline (satellite regression)."""
+
+    def test_mixed_type_dict_keys_canonicalize(self):
+        # int-vs-str dict keys are unorderable; repr-sort handles them
+        k1 = _canon_kwargs({"cfg": {1: "a", "b": 2}})
+        k2 = _canon_kwargs({"cfg": {"b": 2, 1: "a"}})
+        assert k1 is not None and k1 == k2
+
+    def test_raising_repr_excluded_not_fatal(self):
+        class Unrepresentable:
+            def __repr__(self):
+                raise ValueError("no repr")
+
+            __hash__ = object.__hash__
+
+        assert _canon_kwargs(
+            {"cfg": {Unrepresentable(): 1, "b": 2}}) is None
+
+    def test_nested_ndarray_kwargs_canonicalize(self):
+        a = np.asarray([1, 2])
+        k1 = _canon_kwargs({"paddings": [a, np.asarray([3, 4])]})
+        k2 = _canon_kwargs({"paddings": [a.copy(), np.asarray([3, 4])]})
+        assert k1 is not None and k1 == k2
+
+    def test_pipeline_survives_weird_kwargs_end_to_end(self, monkeypatch):
+        from deeplearning4j_tpu.autodiff import samediff as sdmod
+
+        monkeypatch.setitem(sdmod.GRAPH_OPS, "kwargs_probe",
+                            lambda a, **kw: a * 2.0)
+        sd = SameDiff()
+        x = sd.placeholder("x", (3,))
+        bad_kw = {"cfg": {1: "a", "b": [np.asarray([1.0])]}}
+        y1 = sd._record("kwargs_probe", [x], dict(bad_kw))
+        y2 = sd._record("kwargs_probe", [x], dict(bad_kw))
+        (y1 + y2).rename("out")
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        res = sd.output({"x": v}, ["out"])["out"]
+        np.testing.assert_allclose(res, v * 4)
 
 
 class TestIdempotence:

@@ -108,19 +108,20 @@ def native_stage() -> bool:
     return True
 
 
-def lint_stage() -> bool:
-    """graftlint over the whole repo (docs/LINT.md). Emits the linter's one
+def _baselined_tool_stage(tool: str, script: str, label: str) -> bool:
+    """Shared stage driver for the baselined static-analysis tools
+    (graftlint / graftcheck): run the script with --json, echo its ONE
     JSON summary line into the gate log so driver artifacts stay
-    diagnosable; fails on any finding not grandfathered in
-    lint_baseline.json."""
-    print("== gate: graftlint (static analysis) ==", flush=True)
+    diagnosable, fail on any finding beyond the tool's shrink-only
+    baseline."""
+    print(f"== gate: {tool} ({label}) ==", flush=True)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
         proc = subprocess.run(
-            [sys.executable, "tools/graftlint.py", "--json"],
+            [sys.executable, script, "--json"],
             cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     except subprocess.TimeoutExpired:
-        print("   FAIL (graftlint timeout)")
+        print(f"   FAIL ({tool} timeout)")
         return False
     line = next((l for l in proc.stdout.splitlines()
                  if l.startswith("{") and '"tool"' in l), None)
@@ -128,12 +129,26 @@ def lint_stage() -> bool:
         print(f"   {line}")
     if proc.returncode != 0 or line is None:
         tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
-        print(f"   FAIL (graftlint exit {proc.returncode})\n{tail}")
+        print(f"   FAIL ({tool} exit {proc.returncode})\n{tail}")
         return False
     rec = json.loads(line)
-    print(f"   ok (graftlint: {rec['total']} findings, "
+    print(f"   ok ({tool}: {rec['total']} findings, "
           f"{rec['baselined']} grandfathered, {rec['new']} new)")
     return True
+
+
+def lint_stage() -> bool:
+    """graftlint over the whole repo (docs/LINT.md), vs
+    lint_baseline.json."""
+    return _baselined_tool_stage("graftlint", "tools/graftlint.py",
+                                 "static analysis")
+
+
+def check_stage() -> bool:
+    """graftcheck over the fixture zoo (docs/ANALYSIS.md), vs
+    check_baseline.json."""
+    return _baselined_tool_stage("graftcheck", "tools/graftcheck.py",
+                                 "graph shape/dtype verification")
 
 
 def main() -> int:
@@ -143,6 +158,10 @@ def main() -> int:
     # static analysis runs in BOTH modes: it is the cheapest stage and the
     # one that catches the hang class before anything can hang
     results["lint"] = lint_stage()
+    # graph verification also runs in BOTH modes: build-only (no jit), so
+    # it is nearly free and catches importer/optimizer shape regressions
+    # before the pytest stage spends minutes compiling them
+    results["check"] = check_stage()
 
     if not fast:  # --fast stays "pytest only" (pre-commit speed)
         results["native"] = native_stage()

@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Thin wrapper: `python tools/graftcheck.py` ==
+`python -m deeplearning4j_tpu.analysis` (graftcheck — docs/ANALYSIS.md).
+Kept in tools/ so the gate and humans share one entry point layout with
+tools/graftlint.py."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()
