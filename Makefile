@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile native native-test lint lint-baseline check check-baseline
+.PHONY: test gate gate-fast bench bench-compile native native-test lint lint-baseline check check-baseline obs-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -21,6 +21,12 @@ check:
 
 check-baseline:
 	JAX_PLATFORMS=cpu python tools/graftcheck.py --write-baseline
+
+# observability smoke (docs/OBSERVABILITY.md): run the obsreport demo
+# workload on CPU and emit ONE JSON line — fails unless train steps,
+# recompile-ledger events, and serving percentiles all came out nonzero.
+obs-smoke:
+	JAX_PLATFORMS=cpu python tools/obsreport.py --json
 
 # DL4J_TPU_REQUIRE_NATIVE=1: a missing native lib FAILS the ctypes tests
 # instead of silently exercising the numpy fallback (SURVEY §5.3)

@@ -27,23 +27,28 @@ import time
 import numpy as np
 
 
-def _ensure_backend() -> str:
-    """Probe the default JAX backend in a SUBPROCESS (an unreachable TPU can
-    hang or crash the initializer — BENCH_r05 recorded rc=1 crashes); on
-    failure pin this process to CPU so the run still produces data.
+def probe_default_backend(timeout: float = 240.0) -> bool:
+    """Probe the default JAX backend in a SUBPROCESS — an unreachable TPU
+    can hang or crash the initializer (BENCH_r05 recorded rc=1 crashes;
+    MULTICHIP_r05 a 1200s hang), so the probe runs where a hang costs a
+    bounded timeout. THE one backend probe: tools/obsreport.py and
+    __graft_entry__.py import it rather than growing drifting copies."""
+    try:
+        proc = subprocess.run([sys.executable, "-c",
+                               "import jax; jax.devices()"],
+                              capture_output=True, timeout=timeout)
+        return proc.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
 
-    Returns "default", "pinned" (caller set JAX_PLATFORMS) or
-    "cpu-fallback"."""
+
+def _ensure_backend() -> str:
+    """Probe the default backend; on failure pin this process to CPU so the
+    run still produces data. Returns "default", "pinned" (caller set
+    JAX_PLATFORMS) or "cpu-fallback"."""
     if os.environ.get("JAX_PLATFORMS"):
         return "pinned"
-    probe = "import jax; jax.devices()"
-    try:
-        proc = subprocess.run([sys.executable, "-c", probe],
-                              capture_output=True, timeout=240)
-        ok = proc.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        ok = False
-    if ok:
+    if probe_default_backend():
         return "default"
     os.environ["JAX_PLATFORMS"] = "cpu"
     return "cpu-fallback"
@@ -182,6 +187,77 @@ def _bench_attention(iters: int):
     return t_gen / t_flash, "flash_attention_t8192_speedup_vs_generic"
 
 
+def _bench_serving(qps: float, n_requests: int, max_batch: int):
+    """Serving-latency benchmark (BENCH_MODEL=serving): a fixed-QPS open
+    load of ``ParallelInference.predict`` calls against a small MLP —
+    requests are issued on schedule regardless of completions (open-loop,
+    the honest way to measure tail latency under load; closed loops hide
+    queueing). Value = achieved req/sec; the JSON line carries p50/p99 from
+    the measured per-request latencies AND the observe/ snapshot carries
+    the registry's serving histogram, so the bench trajectory records
+    latency, not just throughput. CPU-smoke sized under the subprocess-
+    probe fallback."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from deeplearning4j_tpu import nn
+    from deeplearning4j_tpu.parallel.mesh import ParallelInference
+
+    n_in, n_out = 32, 10
+    conf = (nn.builder().seed(0).updater(nn.Adam(learning_rate=1e-3)).list()
+            .layer(nn.DenseLayer(n_out=64, activation="relu"))
+            .layer(nn.OutputLayer(n_out=n_out, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(n_in)).build())
+    net = nn.MultiLayerNetwork(conf).init()
+    pi = ParallelInference(net, max_batch=max_batch, window_ms=2.0).start()
+    lat = [None] * n_requests
+    try:
+        pi.predict(np.zeros(n_in, np.float32))  # compile the serving path
+        r = np.random.RandomState(0)
+        reqs = r.randn(n_requests, n_in).astype(np.float32)
+
+        def issue(i, t0):
+            # t0 is the SUBMIT time: executor queueing counts toward the
+            # client-perceived latency — starting the clock at worker
+            # pickup would reintroduce coordinated omission exactly when
+            # the pool saturates (the overload regime tails matter in)
+            pi.predict(reqs[i])
+            lat[i] = time.perf_counter() - t0
+
+        futs = []
+        with ThreadPoolExecutor(max_workers=32) as ex:
+            t_start = time.perf_counter()
+            for i in range(n_requests):
+                delay = (t_start + i / qps) - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                futs.append(ex.submit(issue, i, time.perf_counter()))
+        t_total = time.perf_counter() - t_start
+        # a failed request must fail the bench, not silently shrink the
+        # sample — survivors-only percentiles would record an inflated
+        # watermark from a partially broken serving path
+        errs = [f.exception() for f in futs if f.exception() is not None]
+        if errs:
+            raise RuntimeError(
+                f"{len(errs)}/{n_requests} serving requests failed; "
+                f"first: {errs[0]!r}")
+    finally:
+        pi.stop()
+    done = sorted(l for l in lat if l is not None)
+    assert done, "no serving request completed"
+
+    def pct(q):
+        return done[min(len(done) - 1, int(q * len(done)))]
+
+    extra = {"p50_ms": round(pct(0.50) * 1e3, 3),
+             "p99_ms": round(pct(0.99) * 1e3, 3),
+             "offered_qps": qps, "completed": len(done)}
+    return len(done) / t_total, "serving_fixed_qps_req_per_sec", extra
+
+
 def _bench_graph_compile(layers: int, width: int):
     """Graph-compile metric (docs/OPTIMIZER.md, `make bench-compile`): a
     redundant SameDiff graph — per-layer duplicated subexpressions, foldable
@@ -279,13 +355,15 @@ _UNITS = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
           "lenet5_mnist_train_images_per_sec": "images/sec/chip",
           "bert_base_mlm_train_tokens_per_sec": "tokens/sec/chip",
           "flash_attention_t8192_speedup_vs_generic": "x vs XLA generic",
-          "graph_compile_optimizer_speedup": "x trace+compile speedup"}
+          "graph_compile_optimizer_speedup": "x trace+compile speedup",
+          "serving_fixed_qps_req_per_sec": "req/sec"}
 
 _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "lenet": "lenet5_mnist_train_images_per_sec",
                  "bert": "bert_base_mlm_train_tokens_per_sec",
                  "attention": "flash_attention_t8192_speedup_vs_generic",
-                 "graph_compile": "graph_compile_optimizer_speedup"}
+                 "graph_compile": "graph_compile_optimizer_speedup",
+                 "serving": "serving_fixed_qps_req_per_sec"}
 
 
 def main() -> None:
@@ -326,6 +404,14 @@ def main() -> None:
             width = int(os.environ.get("BENCH_GRAPH_WIDTH", "192"))
             value, metric, extra = _bench_graph_compile(layers, width)
             method = f"L{layers}w{width}"
+        elif model == "serving":
+            qps = float(os.environ.get("BENCH_QPS", "25" if smoke else "200"))
+            nreq = int(os.environ.get("BENCH_REQUESTS",
+                                      "50" if smoke else "1000"))
+            mb = int(os.environ.get("BENCH_MAX_BATCH",
+                                    "8" if smoke else "32"))
+            value, metric, extra = _bench_serving(qps, nreq, mb)
+            method = f"q{qps:g}n{nreq}b{mb}"
         else:
             value, metric = _bench_resnet50(batch, iters, image, dtype)
             method = f"b{batch}x{image}i{iters}{'' if dtype == 'mixed' else dtype}"
@@ -393,6 +479,14 @@ def main() -> None:
     mfu = _mfu(metric, value, image)
     if mfu is not None:
         line["mfu"] = mfu
+    # embed the observe/ snapshot (recompiles, step + serving latency
+    # percentiles) so the bench trajectory carries latency, not just
+    # throughput — docs/OBSERVABILITY.md
+    from deeplearning4j_tpu import observe
+
+    obs = observe.summary()
+    if obs:
+        line["observe"] = obs
     print(json.dumps(line))
 
 

@@ -21,6 +21,8 @@ Package map (reference layer in parens — SURVEY §2):
   eval/       Evaluation/ROC/regression                   (nd4j evaluation)
   imports/    TF frozen-graph importer                    (samediff-import)
   native_ops/ C++ host-side codecs via ctypes             (libnd4j native role)
+  observe/    unified runtime telemetry: metrics registry,
+              span tracer, recompile ledger               (listener/profiler fragments, unified)
   utils/      profiling (chrome trace), UI stats shim     (OpProfiler/UI)
   arbiter     hyperparameter search                       (arbiter-core)
 """

@@ -200,14 +200,16 @@ class LocalOptimizationRunner:
         self.results: List[TrialResult] = []
 
     def execute(self) -> TrialResult:
-        start = time.time()
+        # monotonic clock for budget/duration math (an NTP step mid-search
+        # must not end the run early or corrupt duration_s)
+        start = time.perf_counter()
         for idx, params in enumerate(self.generator):
             if idx >= self.max_candidates:
                 break
             if self.max_time_s is not None and \
-                    time.time() - start > self.max_time_s:
+                    time.perf_counter() - start > self.max_time_s:
                 break
-            t0 = time.time()
+            t0 = time.perf_counter()
             net = self.model_builder(dict(params))
             if self.fit_fn is not None:
                 self.fit_fn(net, dict(params))
@@ -218,7 +220,7 @@ class LocalOptimizationRunner:
             score = float(self.score_fn(net, self.score_data))
             self.results.append(TrialResult(
                 index=idx, parameters=dict(params), score=score,
-                duration_s=time.time() - t0,
+                duration_s=time.perf_counter() - t0,
                 net=net if self.keep_nets else None))
         if not self.results:
             raise RuntimeError("no candidates were evaluated (empty "

@@ -50,7 +50,9 @@ class HistoryListener:
         self.history = History()
         self._epoch_losses: List[float] = []
         self._current_epoch: Optional[int] = None
-        self._t0 = time.time()
+        # monotonic clock: this anchor exists only to be subtracted — an
+        # NTP step between iterations must not corrupt training_time_millis
+        self._t0 = time.perf_counter()
 
     def iteration_done(self, model, iteration, epoch, score) -> None:
         s = float(score)
@@ -61,7 +63,8 @@ class HistoryListener:
             self._current_epoch = epoch
         self.history.loss_curve.append(s)
         self._epoch_losses.append(s)
-        self.history.training_time_millis = (time.time() - self._t0) * 1000.0
+        self.history.training_time_millis = \
+            (time.perf_counter() - self._t0) * 1000.0
 
     def _flush_epoch(self) -> None:
         if self._epoch_losses:

@@ -581,7 +581,19 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
     extra = {k: v for k, v in const_vals.items()
              if k not in const_env and k in referenced}
     stats.nodes_after = len(work)
-    stats.optimize_seconds = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    stats.optimize_seconds = t1 - t0
+    # telemetry (observe/ — docs/OBSERVABILITY.md): the optimizer pipeline
+    # is part of every compile; count it and put it on the shared timeline
+    from deeplearning4j_tpu import observe
+
+    m = observe.metrics()
+    m.counter("dl4j_tpu_graph_optimizations_total").inc()
+    m.histogram("dl4j_tpu_graph_optimize_seconds").observe(
+        stats.optimize_seconds)
+    observe.tracer().complete_between(
+        "optimize_graph", t0, t1, category="compile",
+        nodes_before=stats.nodes_before, nodes_after=stats.nodes_after)
     return GraphPlan(nodes=work, extra_consts=extra, alias=alias,
                      outputs=list(outputs), stats=stats)
 
@@ -618,6 +630,16 @@ class CompiledGraph:
             t2 = time.perf_counter()
             self.stats.trace_seconds = round(t1 - t0, 4)
             self.stats.compile_seconds = round(t2 - t1, 4)
+            # the trace/compile split joins the unified span timeline and
+            # the compile-latency histograms (observe/)
+            from deeplearning4j_tpu import observe
+
+            tr = observe.tracer()
+            tr.complete_between("jit_trace", t0, t1, category="compile")
+            tr.complete_between("xla_compile", t1, t2, category="compile")
+            m = observe.metrics()
+            m.histogram("dl4j_tpu_trace_seconds").observe(t1 - t0)
+            m.histogram("dl4j_tpu_xla_compile_seconds").observe(t2 - t1)
             try:
                 return ex(var_arrays, feeds)
             except TypeError:

@@ -23,6 +23,7 @@ StableHLO text export of the compiled computation (`as_stablehlo`).
 from __future__ import annotations
 
 import json
+import time
 import zipfile
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -759,6 +760,12 @@ class SameDiff:
         # graftcheck wiring (analysis/ — docs/ANALYSIS.md)
         self.validate = validate
         self.last_check_report = None
+        # recompile-ledger wiring (observe/ — docs/OBSERVABILITY.md): the
+        # cause of the most recent cache invalidation, applied by
+        # _note_compile to EVERY previously-compiled key rebuilt after it
+        # (keys never compiled before stay "first_compile")
+        self._pending_invalidate: Optional[str] = None
+        self._ever_compiled: set = set()
 
     # ------------------------------------------------------------- factories
     @staticmethod
@@ -844,7 +851,46 @@ class SameDiff:
             n.outputs = [new if o == old else o for o in n.outputs]
         # renaming is a graph mutation: cached optimizer plans hold frozen
         # node-name snapshots and compiled traces key envs by name
+        self._invalidate("graph_mutation")
+
+    def _invalidate(self, cause: str) -> None:
+        """Clear the jit cache, remembering WHY — the recompile ledger tags
+        rebuilt keys with this cause. A clear while the cache is empty AND
+        no cause is pending (graph still being built, nothing ever
+        compiled) is not an invalidation; an empty cache WITH a pending
+        cause means we are between invalidation and recompile, where a
+        second invalidation (e.g. rebind then mutate) updates the cause to
+        the latest one instead of silently keeping the first."""
+        if self._jit_cache or self._pending_invalidate is not None:
+            self._pending_invalidate = cause
         self._jit_cache.clear()
+
+    def _note_compile(self, fn, kind: str, signature: str,
+                      stable_key: Any = None) -> None:
+        """Report a compile to the recompile ledger iff this (fn, input
+        signature) pair has not run before (observe.note_jit_signature: the
+        seen-signature set lives ON the cached function, so every
+        `_jit_cache` invalidation path drops the history with it).
+
+        Cause resolution: ``stable_key`` mirrors the `_jit_cache` key and
+        survives invalidation in ``_ever_compiled`` — a key compiled before
+        that shows up as a fresh fn was REBUILT, and reports the pending
+        invalidation cause (graph_mutation / constant_rebind /
+        variable_rebind) — every such key after one invalidation, not just
+        the first to recompile. A key never compiled before reports
+        first_compile; a cached fn seeing a new shape/dtype signature
+        reports new_shape (jax retraces per shape)."""
+        from deeplearning4j_tpu import observe
+
+        ident = (kind, stable_key)
+        rebuilt = ident in self._ever_compiled
+        pend = (self._pending_invalidate if rebuilt else None) \
+            or "first_compile"
+        cause = observe.note_jit_signature(
+            fn, graph="samediff", key=kind, signature=signature,
+            stats=self.last_compile_stats, cause_if_new_fn=pend)
+        if cause is not None:
+            self._ever_compiled.add(ident)
 
     # -------------------------------------------------------------- recording
     def _record(self, op: str, inputs: List[SDVariable],
@@ -857,7 +903,7 @@ class SameDiff:
             v = SDVariable(self, n, "ARRAY")
             self._vars[n] = v
             outs.append(v)
-        self._jit_cache.clear()  # graph changed; recompile
+        self._invalidate("graph_mutation")  # graph changed; recompile
         return outs[0] if n_out == 1 else tuple(outs)
 
     # -------------------------------------------------------------- execution
@@ -1037,6 +1083,11 @@ class SameDiff:
         if isinstance(outputs, str):
             outputs = [outputs]
         fn = self._exec_fn(tuple(outputs))
+        from deeplearning4j_tpu.observe import signature_of
+
+        self._note_compile(fn, "exec", signature_of(**feeds),
+                           stable_key=(tuple(outputs), bool(self.optimize),
+                                       self.optimize_passes))
         res = fn(self._var_arrays(fn),
                  {k: jnp.asarray(v) for k, v in feeds.items()})
         return {k: np.asarray(v) for k, v in res.items()}
@@ -1075,6 +1126,10 @@ class SameDiff:
             fn = jax.jit(jax.grad(loss_of))
             fn._const_names = frozenset(const_env)
             self._jit_cache[cache_key] = fn
+        from deeplearning4j_tpu.observe import signature_of
+
+        self._note_compile(fn, "grad", signature_of(**feeds),
+                           stable_key=cache_key)
         train_vars = {n: self._arrays[n] for n in wrt}
         other = {n: a for n, a in self._arrays.items()
                  if n not in train_vars and n not in fn._const_names}
@@ -1146,10 +1201,21 @@ class SameDiff:
         if isinstance(iterator, DataSet):
             iterator = ListDataSetIterator(iterator, batch_size=32)
 
+        from deeplearning4j_tpu import observe
+        from deeplearning4j_tpu.observe import signature_of
+
+        _m = observe.metrics()
+        _steps_c = _m.counter("dl4j_tpu_train_steps_total", model="samediff")
+        _ex_c = _m.counter("dl4j_tpu_train_examples_total", model="samediff")
+        _xfer_c = _m.counter("dl4j_tpu_host_to_device_transfers_total",
+                             model="samediff")
+        _step_h = _m.histogram("dl4j_tpu_train_step_seconds",
+                               model="samediff")
         history = []
         listeners = getattr(self, "_listeners", [])
         for ep in range(epochs):
             losses = []
+            t_prev = time.perf_counter()
             for ds in iterator:
                 feeds = {}
                 feats = ds.features if isinstance(ds.features, (list, tuple)) else [ds.features]
@@ -1158,6 +1224,8 @@ class SameDiff:
                     feeds[name] = jnp.asarray(arr)
                 for name, arr in zip(tc.label_mapping, labs):
                     feeds[name] = jnp.asarray(arr)
+                self._note_compile(step_fn, "train", signature_of(**feeds),
+                                   stable_key=step_key)
                 train_vars = {n: self._arrays[n] for n in trainable}
                 # constants are baked into step_fn's closure (_const_env)
                 other = {n: a for n, a in self._arrays.items()
@@ -1169,9 +1237,22 @@ class SameDiff:
                 self._arrays.update(new_vars)
                 self._step += 1
                 losses.append(loss)
+                # inter-step latency (includes compile on the first step);
+                # counters/histograms are host-side — never under the trace
+                now = time.perf_counter()
+                _step_h.observe(now - t_prev)
+                t_prev = now
+                _steps_c.inc()
+                _ex_c.inc(ds.num_examples())
+                _xfer_c.inc(len(feeds))
                 for lst in listeners:
                     lst.iteration_done(self, self._step, ep, loss)
-            history.append(float(jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))))
+            ep_loss = float(jnp.mean(jnp.stack([jnp.asarray(l) for l in losses])))
+            history.append(ep_loss)
+            # epoch is 1-based after completion, matching the network
+            # classes' post-increment epoch_count in the same JSONL schema
+            observe.log_event("train_epoch", model="samediff", epoch=ep + 1,
+                              steps=len(losses), mean_loss=ep_loss)
         return history
 
     # ---------------------------------------------------------- control flow
@@ -1385,11 +1466,11 @@ class SameDiff:
             # constants are BAKED into cached traces (_exec_fn/_const_env)
             # AND into optimizer plans (fold results); changing one must
             # invalidate every cached computation and plan
-            self._jit_cache.clear()
+            self._invalidate("constant_rebind")
         elif old is None or old.dtype != arr.dtype or old.shape != arr.shape:
             # a VARIABLE changing dtype/shape invalidates optimizer plans
             # (dtype-guarded identity strips) and forces a retrace anyway
-            self._jit_cache.clear()
+            self._invalidate("variable_rebind")
 
     def summary(self) -> str:
         lines = [f"SameDiff: {len(self._vars)} variables, {len(self._nodes)} ops"]

@@ -8,6 +8,8 @@ GL005  mutable default arguments in public APIs
 GL007  bare except / swallowed exceptions
 GL009  np.* inside a GRAPH_OPS / registry op impl off the numpy-static
        whitelist — silent host fallback under jit, in op-impl form
+GL010  time.time() subtraction used as a duration — wall clocks jump with
+       NTP; durations belong on time.perf_counter() (timestamps are fine)
 
 (GL006 and GL008 live in rules_consistency — they need the live registries.)
 
@@ -524,6 +526,85 @@ def rule_numpy_in_op_impl(tree, lines, path) -> List[Finding]:
                         f"fallback / tracer leak); use jnp, or add the op "
                         f"to the documented numpy-static whitelist "
                         f"(shape_of/stack/unstack) with justification"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL010 — wall-clock subtraction used as a duration
+# ---------------------------------------------------------------------------
+
+
+def _walltime_aliases(tree: ast.Module) -> Set[str]:
+    """Dotted spellings that denote ``time.time`` in this module:
+    ``{"time.time"}`` under ``import time`` (any asname), plus bare names
+    from ``from time import time``. Stdlib-only — a local ``def time()``
+    never registers because it is not an import."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    out.add((a.asname or "time") + ".time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    out.add(a.asname or "time")
+    return out
+
+
+def _is_walltime_call(node: ast.AST, aliases: Set[str]) -> bool:
+    return (isinstance(node, ast.Call) and not node.args
+            and _dotted(node.func) in aliases)
+
+
+@ast_rule("GL010", "time.time() subtraction used as a duration")
+def rule_walltime_duration(tree, lines, path) -> List[Finding]:
+    """``time.time()`` is a WALL clock: NTP steps/slews move it, so a
+    subtraction of two readings is not a duration — it can be negative or
+    hours off, silently corrupting training-time stats, ETA math, and time
+    budgets (the reference's PerformanceListener class of bugs).
+
+    Flagged: ``a - b`` where BOTH operands are wall-time readings — a
+    direct ``time.time()`` call or a name/attribute assigned from one
+    anywhere in the module (``self._t0 = time.time()`` in ``__init__``,
+    subtracted in another method, is the repo's own pattern). Requiring
+    both sides keeps timestamps whitelisted: ``time.time() - 86400``
+    (epoch arithmetic) and plain timestamp fields never fire. Blind spot
+    (documented in docs/LINT.md): deadline COMPARISONS
+    (``time.time() > t0 + budget``) are not subtractions and pass."""
+    aliases = _walltime_aliases(tree)
+    if not aliases:
+        return []
+    timeish: Set[str] = set()
+    for node in ast.walk(tree):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign) and \
+                _is_walltime_call(node.value, aliases):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and \
+                node.value is not None and \
+                _is_walltime_call(node.value, aliases):
+            targets = [node.target]
+        for t in targets:
+            name = _dotted(t)
+            if name:
+                timeish.add(name)
+
+    def is_timeish(node: ast.AST) -> bool:
+        if _is_walltime_call(node, aliases):
+            return True
+        d = _dotted(node)
+        return d is not None and d in timeish
+
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and is_timeish(node.left) and is_timeish(node.right):
+            findings.append(Finding(
+                path=path, line=node.lineno, rule="GL010", severity="error",
+                message="time.time() subtraction used as a duration — the "
+                        "wall clock jumps with NTP; use time.perf_counter() "
+                        "for both readings (timestamps themselves are fine)"))
     return findings
 
 

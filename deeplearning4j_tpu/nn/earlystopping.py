@@ -68,10 +68,12 @@ class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
         self._start = None
 
     def terminate(self, score):
+        # monotonic clock: the max-time budget is a duration, and a wall
+        # clock jumping (NTP) would terminate training early or never
         if self._start is None:
-            self._start = time.time()
+            self._start = time.perf_counter()
             return False
-        return time.time() - self._start > self.max_seconds
+        return time.perf_counter() - self._start > self.max_seconds
 
 
 class InMemoryModelSaver:

@@ -19,11 +19,14 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from deeplearning4j_tpu import observe
 
 from deeplearning4j_tpu.nn import conf as C
 from deeplearning4j_tpu.nn.layers import Layer, build_layer, apply_preprocessor
@@ -902,11 +905,24 @@ class ComputationGraph:
             self._jit_cache["train_step"] = step_fn
         in_name = self.conf.network_inputs[0]
         out_name = self.conf.network_outputs[0]
+        _m = observe.metrics()
+        _steps_c = _m.counter("dl4j_tpu_train_steps_total", model="graph")
+        _ex_c = _m.counter("dl4j_tpu_train_examples_total", model="graph")
+        _xfer_c = _m.counter("dl4j_tpu_host_to_device_transfers_total",
+                             model="graph")
+        _step_h = _m.histogram("dl4j_tpu_train_step_seconds", model="graph")
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self)
+            t_prev = time.perf_counter()
+            n_steps = 0
             for ds in data:
                 self.last_batch_size = ds.num_examples()
+                observe.note_jit_signature(
+                    step_fn, graph="graph", key="train_step",
+                    signature=observe.signature_of(
+                        x=ds.features, y=ds.labels, fm=ds.features_mask,
+                        lm=ds.labels_mask))
                 self._key, sub = jax.random.split(self._key)
                 feeds = {in_name: jnp.asarray(ds.features)}
                 labs = {out_name: jnp.asarray(ds.labels)}
@@ -920,9 +936,18 @@ class ComputationGraph:
                     feeds, labs, fmasks, lmasks)
                 self._score = loss
                 self.iteration_count += 1
+                now = time.perf_counter()
+                _step_h.observe(now - t_prev)
+                t_prev = now
+                n_steps += 1
+                _steps_c.inc()
+                _ex_c.inc(ds.num_examples())
+                _xfer_c.inc(2 + (fmasks is not None) + (lmasks is not None))
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count, self.epoch_count, loss)
             self.epoch_count += 1
+            observe.log_event("train_epoch", model="graph",
+                              epoch=self.epoch_count, steps=n_steps)
             for lst in self.listeners:
                 lst.on_epoch_end(self)
 

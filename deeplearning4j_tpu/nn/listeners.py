@@ -73,6 +73,14 @@ class PerformanceListener(TrainingListener):
                 "iter_ms": 1000.0 * dt / iters,
             }
             self.history.append(rec)
+            # re-based onto the process-wide registry (observe/): the same
+            # throughput numbers the log line carries become scrapeable
+            # gauges on /metrics
+            from deeplearning4j_tpu import observe
+
+            m = observe.metrics()
+            m.gauge("dl4j_tpu_examples_per_sec").set(rec["samples_per_sec"])
+            m.gauge("dl4j_tpu_batches_per_sec").set(rec["batches_per_sec"])
             msg = (f"iteration {iteration}: {rec['batches_per_sec']:.1f} batches/sec, "
                    f"{rec['samples_per_sec']:.1f} samples/sec, {rec['iter_ms']:.2f} ms/iter")
             if self.report_score:

@@ -20,11 +20,14 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from deeplearning4j_tpu import observe
 
 from deeplearning4j_tpu.nn import conf as C
 from deeplearning4j_tpu.nn.layers import Layer, build_layer, apply_preprocessor
@@ -487,11 +490,26 @@ class MultiLayerNetwork:
                        else self._make_train_step())
             self._jit_cache[cache_name] = step_fn
 
+        _m = observe.metrics()
+        _steps_c = _m.counter("dl4j_tpu_train_steps_total", model="mln")
+        _ex_c = _m.counter("dl4j_tpu_train_examples_total", model="mln")
+        _xfer_c = _m.counter("dl4j_tpu_host_to_device_transfers_total",
+                             model="mln")
+        _step_h = _m.histogram("dl4j_tpu_train_step_seconds", model="mln")
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self)
+            t_prev = time.perf_counter()
+            n_steps = 0
             for ds in data:
                 self.last_batch_size = ds.num_examples()
+                # recompile ledger: a new feed shape/dtype signature on the
+                # cached jitted step is a silent XLA retrace — record it
+                observe.note_jit_signature(
+                    step_fn, graph="mln", key=cache_name,
+                    signature=observe.signature_of(
+                        x=ds.features, y=ds.labels, fm=ds.features_mask,
+                        lm=ds.labels_mask))
                 # host-side reference only (no copy): StatsListener's
                 # activation charts feed_forward this batch on demand
                 self._last_features = ds.features
@@ -510,9 +528,21 @@ class MultiLayerNetwork:
                 # step and stall async dispatch; score() converts lazily
                 self._score = loss
                 self.iteration_count += 1
+                # inter-step latency on the monotonic clock (first delta
+                # includes compile); all telemetry is host-side, off-trace
+                now = time.perf_counter()
+                _step_h.observe(now - t_prev)
+                t_prev = now
+                n_steps += 1
+                _steps_c.inc()
+                _ex_c.inc(ds.num_examples())
+                _xfer_c.inc(2 + (ds.features_mask is not None)
+                            + (ds.labels_mask is not None))
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count, self.epoch_count, loss)
             self.epoch_count += 1
+            observe.log_event("train_epoch", model="mln",
+                              epoch=self.epoch_count, steps=n_steps)
             for lst in self.listeners:
                 lst.on_epoch_end(self)
 
@@ -561,14 +591,22 @@ class MultiLayerNetwork:
                 return p, o, s, losses
 
             self._jit_cache[cache_key] = many
+        observe.note_jit_signature(
+            many, graph="mln", key="fit_scanned",
+            signature=observe.signature_of(x=xs, y=ys))
         self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
         self.params, self.opt_state, self.net_state, losses = many(
             self.params, self.opt_state, self.net_state,
             jnp.asarray(self.iteration_count, jnp.int32), sub, xs, ys)
         start = self.iteration_count
         self.iteration_count += n_steps
+        _m = observe.metrics()
+        _m.counter("dl4j_tpu_train_steps_total", model="mln").inc(n_steps)
+        _m.counter("dl4j_tpu_host_to_device_transfers_total",
+                   model="mln").inc(2)
         self._score = losses[-1]
-        losses = np.asarray(losses)
+        losses = np.asarray(losses)  # host sync: the chunk is done here
         # listeners fire AFTER the fused chunk, once per inner step with the
         # recorded loss — coarser timing than fit() (params are only current
         # as of the chunk end) but checkpoint/score listeners keep working on
@@ -576,6 +614,11 @@ class MultiLayerNetwork:
         # Iteration-major order so multi-listener interleaving matches fit()
         self.last_batch_size = int(xs.shape[1]) if per_step_data \
             else int(xs.shape[0])
+        _m.counter("dl4j_tpu_train_examples_total", model="mln").inc(
+            n_steps * self.last_batch_size)
+        observe.tracer().complete_between(
+            "fit_scanned", t0, time.perf_counter(), category="train",
+            steps=n_steps)
         for k in range(n_steps):
             for lst in self.listeners:
                 lst.iteration_done(self, start + k + 1, self.epoch_count,

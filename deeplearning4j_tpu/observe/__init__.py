@@ -1,0 +1,113 @@
+"""Unified runtime telemetry (docs/OBSERVABILITY.md).
+
+One metric model for the whole framework:
+
+* :func:`metrics` — the process-wide :class:`MetricsRegistry` (counters,
+  gauges, histograms with streaming p50/p95/p99), served as Prometheus
+  text at the UI server's ``/metrics`` endpoint.
+* :func:`tracer` — the process-wide :class:`SpanTracer` (monotonic-clock
+  nested spans, Chrome-trace export — the SAME format
+  ``utils/profiling.py`` writes).
+* :func:`ledger` — the :class:`RecompileLedger` fed by every
+  ``SameDiff``/network jit-cache miss with its shape/dtype signature and
+  cause.
+* :func:`log_event` — JSONL event log, enabled by ``DL4J_TPU_OBS_LOG=path``.
+* :func:`summary` — the compact snapshot ``bench.py`` embeds in its final
+  JSON line and ``tools/obsreport.py`` prints.
+
+This package imports neither jax nor the model runtimes — it is safe to
+import from any layer (including before backend selection).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from deeplearning4j_tpu.observe.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    OBS_LOG_ENV,
+    default_registry,
+    log_event,
+    reset_default_registry,
+)
+from deeplearning4j_tpu.observe.tracing import (
+    SpanTracer,
+    default_tracer,
+    reset_default_tracer,
+)
+from deeplearning4j_tpu.observe.ledger import (
+    CompileEvent,
+    RecompileLedger,
+    default_ledger,
+    note_jit_signature,
+    reset_default_ledger,
+    signature_of,
+)
+
+# short accessors — the names call sites use
+metrics = default_registry
+tracer = default_tracer
+ledger = default_ledger
+
+
+def reset() -> None:
+    """Fresh registry/tracer/ledger (test isolation; never used in prod)."""
+    reset_default_registry()
+    reset_default_tracer()
+    reset_default_ledger()
+
+
+def _ms(seconds) -> Any:
+    return None if seconds is None else round(seconds * 1e3, 3)
+
+
+def summary() -> Dict[str, Any]:
+    """Compact cross-layer snapshot: recompiles, train-step latency
+    percentiles, serving latency percentiles. Empty sections are omitted —
+    the bench JSON line only carries what the run actually exercised."""
+    m = metrics()
+    out: Dict[str, Any] = {}
+
+    led = ledger().summary()
+    if led["total"]:
+        out["recompiles"] = led
+
+    steps = m.family_total("dl4j_tpu_train_steps_total")
+    if steps:
+        h = m.merged_histogram("dl4j_tpu_train_step_seconds")
+        pct = h.percentiles()
+        out["train"] = {
+            "steps": int(steps),
+            "examples": int(
+                m.family_total("dl4j_tpu_train_examples_total")),
+            "step_p50_ms": _ms(pct["p50"]),
+            "step_p95_ms": _ms(pct["p95"]),
+            "step_p99_ms": _ms(pct["p99"]),
+        }
+
+    reqs = m.counter("dl4j_tpu_serving_requests_total").value
+    if reqs:
+        h = m.histogram("dl4j_tpu_serving_request_seconds")
+        pct = h.percentiles()
+        occ = m.histogram("dl4j_tpu_serving_batch_occupancy")
+        out["serving"] = {
+            "requests": int(reqs),
+            "batches": int(m.counter("dl4j_tpu_serving_batches_total").value),
+            "p50_ms": _ms(pct["p50"]),
+            "p95_ms": _ms(pct["p95"]),
+            "p99_ms": _ms(pct["p99"]),
+            "batch_occupancy_mean": round(occ.mean, 4) if occ.count else None,
+        }
+    return out
+
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "SpanTracer",
+    "CompileEvent", "RecompileLedger", "OBS_LOG_ENV",
+    "metrics", "tracer", "ledger", "default_registry", "default_tracer",
+    "default_ledger", "log_event", "note_jit_signature", "signature_of",
+    "summary", "reset",
+]

@@ -1,0 +1,186 @@
+"""Recompile ledger — every jit cache miss, with its cause, on the record.
+
+Item 1 of the ROADMAP (shape-polymorphic AOT serving) exists because diverse
+traffic can trigger a recompile storm; this ledger makes the storm VISIBLE
+before that item fixes it. ``SameDiff`` (autodiff/samediff.py) and the
+network classes (nn/multilayer.py, nn/graph.py) report every compilation —
+a ``_jit_cache`` miss or a new input shape/dtype signature hitting a cached
+jit wrapper — as one :class:`CompileEvent` carrying:
+
+* ``graph``/``key``: which model and which cached function (exec / grad /
+  train_step / output ...),
+* ``signature``: the input shape/dtype signature that compiled,
+* ``cause``: ``first_compile`` | ``new_shape`` | ``graph_mutation`` |
+  ``constant_rebind`` | ``variable_rebind`` — the invalidation that forced
+  the miss (SameDiff threads the cause from the exact `_jit_cache.clear()`
+  sites),
+* ``stats``: the live ``OptimizeStats`` when the optimizer produced one, so
+  trace-vs-XLA-compile seconds appear in the event once ``CompiledGraph``
+  measures them (the stats object is shared, not copied — reads see the
+  final timings).
+
+Events also increment ``dl4j_tpu_recompiles_total`` (plus a per-cause
+counter) in the default metrics registry and append a ``recompile`` JSONL
+event when ``DL4J_TPU_OBS_LOG`` is set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+from deeplearning4j_tpu.observe.registry import default_registry, log_event
+
+CAUSES = ("first_compile", "new_shape", "graph_mutation",
+          "constant_rebind", "variable_rebind")
+
+_MAX_EVENTS = 2000
+
+
+@dataclasses.dataclass
+class CompileEvent:
+    seq: int
+    graph: str            # model identity ("samediff", "mln", "graph", ...)
+    key: str              # cached-function kind ("exec", "train", ...)
+    signature: str        # input shape/dtype signature
+    cause: str
+    timestamp: float      # epoch seconds (display only; never subtracted)
+    stats: Any = None     # OptimizeStats (live reference) or None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"seq": self.seq, "graph": self.graph, "key": self.key,
+               "signature": self.signature, "cause": self.cause,
+               "timestamp": self.timestamp}
+        st = self.stats
+        if st is not None:
+            out["trace_seconds"] = getattr(st, "trace_seconds", None)
+            out["compile_seconds"] = getattr(st, "compile_seconds", None)
+            out["optimize_seconds"] = getattr(st, "optimize_seconds", None)
+            out["nodes_before"] = getattr(st, "nodes_before", None)
+            out["nodes_after"] = getattr(st, "nodes_after", None)
+        return out
+
+
+class RecompileLedger:
+    """Bounded, thread-safe event log of compilations."""
+
+    def __init__(self, max_events: int = _MAX_EVENTS):
+        self._events: "deque[CompileEvent]" = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, *, graph: str, key: str, signature: str, cause: str,
+               stats: Any = None) -> CompileEvent:
+        if cause not in CAUSES:
+            raise ValueError(f"unknown recompile cause '{cause}'; "
+                             f"valid: {list(CAUSES)}")
+        with self._lock:
+            self._seq += 1
+            ev = CompileEvent(seq=self._seq, graph=graph, key=key,
+                              signature=signature, cause=cause,
+                              timestamp=time.time(), stats=stats)
+            self._events.append(ev)
+        m = default_registry()
+        m.counter("dl4j_tpu_recompiles_total").inc()
+        m.counter("dl4j_tpu_recompile_cause_total", cause=cause).inc()
+        log_event("recompile", graph=graph, key=key, signature=signature,
+                  cause=cause)
+        return ev
+
+    def events(self) -> Tuple[CompileEvent, ...]:
+        with self._lock:
+            return tuple(self._events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def summary(self) -> Dict[str, Any]:
+        evs = self.events()
+        by_cause: Dict[str, int] = {}
+        for ev in evs:
+            by_cause[ev.cause] = by_cause.get(ev.cause, 0) + 1
+        compile_s = [getattr(ev.stats, "compile_seconds", None)
+                     for ev in evs if ev.stats is not None]
+        compile_s = [s for s in compile_s if s is not None]
+        return {"total": len(evs), "by_cause": by_cause,
+                "compile_seconds_sum": round(sum(compile_s), 4)
+                if compile_s else None}
+
+
+_DEFAULT: Optional[RecompileLedger] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_ledger() -> RecompileLedger:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = RecompileLedger()
+        return _DEFAULT
+
+
+def reset_default_ledger() -> RecompileLedger:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = None
+    return default_ledger()
+
+
+# ---------------------------------------------------------------------------
+# helpers the runtimes call
+# ---------------------------------------------------------------------------
+
+
+def signature_of(*arrays: Any, **named: Any) -> str:
+    """Compact shape/dtype signature of a feed set, e.g.
+    ``x:f32[32,128],y:f32[32,10]``. Accepts positional arrays (labelled by
+    position) and/or name->array pairs; None entries are skipped."""
+    import numpy as np
+
+    parts = []
+    items = [(str(i), a) for i, a in enumerate(arrays)]
+    items += sorted(named.items())
+    for name, a in items:
+        if a is None:
+            continue
+        dt = np.dtype(getattr(a, "dtype", type(a))).name \
+            if hasattr(a, "dtype") else type(a).__name__
+        shape = ",".join(str(int(d)) for d in getattr(a, "shape", ()))
+        parts.append(f"{name}:{dt}[{shape}]")
+    return "|".join(parts)
+
+
+def note_jit_signature(fn: Any, *, graph: str, key: str, signature: str,
+                       stats: Any = None,
+                       cause_if_new_fn: str = "first_compile"
+                       ) -> Optional[str]:
+    """Record a compile event iff ``signature`` is new for ``fn``.
+
+    The seen-signature set rides ON the cached function object, so the
+    exact cache-invalidation paths that drop the function also drop its
+    history — a rebuilt fn reports ``cause_if_new_fn`` (the invalidation
+    cause), a cached fn seeing a fresh signature reports ``new_shape``
+    (jax retraces per shape under the hood). ``stats`` is attached only to
+    the new-fn event: a new_shape retrace never re-ran the optimizer, so
+    inheriting the original compile's OptimizeStats would double-count its
+    trace/compile seconds in ledger summaries. Returns the cause recorded,
+    or None on a plain cache hit."""
+    try:
+        sigs = fn._obs_sigs
+    except AttributeError:
+        try:
+            fn._obs_sigs = sigs = set()
+        except (AttributeError, TypeError):
+            return None  # fn refuses attributes; skip tracking, never fail
+    if signature in sigs:
+        return None
+    new_fn = not sigs
+    cause = cause_if_new_fn if new_fn else "new_shape"
+    sigs.add(signature)
+    default_ledger().record(graph=graph, key=key, signature=signature,
+                            cause=cause, stats=stats if new_fn else None)
+    return cause

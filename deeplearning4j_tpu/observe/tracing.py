@@ -1,0 +1,118 @@
+"""Lightweight span tracer — ONE trace format for the whole runtime.
+
+Chrome trace-event JSON (``chrome://tracing`` / Perfetto) was already the
+profiling artifact (``utils/profiling.py``); this module owns the format now
+and ``ChromeTraceWriter`` there subclasses :class:`SpanTracer`, so spans
+recorded by the training loop, the compile path, and the serving loop land
+in the same timeline as the listener-driven per-iteration events.
+
+Clocks are monotonic (``time.perf_counter``) — wall-clock (``time.time``)
+deltas jump with NTP and are banned for durations (graftlint GL010).
+
+Spans nest: each thread keeps its own depth counter and events carry the
+thread id as ``tid``, so concurrent serving clients render as separate
+tracks. The event buffer is bounded (newest kept) — tracing a week-long
+serving process must not grow host memory without bound.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_MAX_EVENTS = 20000
+
+
+class SpanTracer:
+    """Nested-span recorder emitting Chrome trace events."""
+
+    def __init__(self, max_events: Optional[int] = _MAX_EVENTS):
+        # max_events=None means unbounded (explicit artifact writers);
+        # the process-wide default tracer stays bounded, newest kept
+        self.events: "deque[Dict[str, Any]]" = deque(maxlen=max_events)
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+
+    # -- clock ---------------------------------------------------------------
+    def _us(self) -> float:
+        """Microseconds since tracer start (monotonic)."""
+        return (time.perf_counter() - self._t0) * 1e6
+
+    # -- recording -----------------------------------------------------------
+    def _append(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            self.events.append(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, category: str = "step", **args):
+        """Record a complete ('X') event around the with-block. Nesting is
+        carried by the event ts/dur intervals per tid — how the chrome
+        trace viewer reconstructs the stack."""
+        start = self._us()
+        try:
+            yield self
+        finally:
+            self._append({
+                "name": name, "cat": category, "ph": "X", "ts": start,
+                "dur": self._us() - start, "pid": 0,
+                "tid": threading.get_ident() % 1_000_000, "args": args,
+            })
+
+    def complete(self, name: str, start_us: float, dur_us: float,
+                 category: str = "step", **args) -> None:
+        """Record an explicit complete event (for externally measured
+        intervals, e.g. the AOT trace/compile split)."""
+        self._append({"name": name, "cat": category, "ph": "X",
+                      "ts": start_us, "dur": dur_us, "pid": 0,
+                      "tid": threading.get_ident() % 1_000_000, "args": args})
+
+    def complete_between(self, name: str, perf_start: float, perf_end: float,
+                         category: str = "step", **args) -> None:
+        """Record a complete event from two ``time.perf_counter()`` readings
+        (same monotonic clock as the tracer — no epoch conversion)."""
+        self.complete(name, (perf_start - self._t0) * 1e6,
+                      (perf_end - perf_start) * 1e6, category=category,
+                      **args)
+
+    def instant(self, name: str, **args) -> None:
+        self._append({"name": name, "cat": "marker", "ph": "i",
+                      "ts": self._us(), "pid": 0,
+                      "tid": threading.get_ident() % 1_000_000, "s": "g",
+                      "args": args})
+
+    # -- export --------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            events = list(self.events)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+
+
+_DEFAULT: Optional[SpanTracer] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_tracer() -> SpanTracer:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = SpanTracer()
+        return _DEFAULT
+
+
+def reset_default_tracer() -> SpanTracer:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = None
+    return default_tracer()

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import observe
 from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
 
 logger = logging.getLogger(__name__)
@@ -386,6 +387,7 @@ class ParallelInference:
         self._worker = None
         self._stop = False
         self._placed = None  # (params, net_state) device-resident for serving
+        self._obs = None     # serving instruments, resolved once in start()
 
     # ------------------------------------------------------------- serving
     def start(self) -> "ParallelInference":
@@ -394,6 +396,20 @@ class ParallelInference:
 
         if self._worker is not None:
             return self
+        # resolve the serving instruments ONCE — predict() runs on every
+        # client thread and must not take the registry creation lock per
+        # request (the train loops hoist theirs the same way)
+        m = observe.metrics()
+        self._obs = {
+            "requests": m.counter("dl4j_tpu_serving_requests_total"),
+            "request_h": m.histogram("dl4j_tpu_serving_request_seconds"),
+            "wait_h": m.histogram("dl4j_tpu_serving_queue_wait_seconds"),
+            "batch_h": m.histogram("dl4j_tpu_serving_batch_seconds"),
+            "occupancy_h": m.histogram("dl4j_tpu_serving_batch_occupancy"),
+            "batches": m.counter("dl4j_tpu_serving_batches_total"),
+            "rows": m.counter("dl4j_tpu_serving_rows_total"),
+            "depth": m.gauge("dl4j_tpu_serving_queue_depth"),
+        }
         self._queue = _queue.Queue()
         self._stop = False
         repl = NamedSharding(self.mesh, P())
@@ -427,20 +443,36 @@ class ParallelInference:
     def predict(self, x) -> np.ndarray:
         """Thread-safe single-request inference through the batching queue.
         x: one example (features without the batch dim) or a small batch;
-        returns the corresponding output rows."""
+        returns the corresponding output rows.
+
+        Serving telemetry (observe/ — docs/OBSERVABILITY.md): every request
+        lands in ``dl4j_tpu_serving_requests_total`` and its full
+        enqueue→response latency in the
+        ``dl4j_tpu_serving_request_seconds`` histogram (p50/p95/p99),
+        recorded on the CLIENT thread — the registry is thread-safe."""
+        import time as _time
         from concurrent.futures import Future
 
         if self._worker is None:
             raise RuntimeError("serving loop not running — call start()")
         x = np.asarray(x)
         fut = Future()
-        self._queue.put((x, fut))
-        return fut.result()
+        t0 = _time.perf_counter()
+        self._queue.put((x, fut, t0))
+        try:
+            return fut.result()
+        finally:
+            # finally: failed requests must still count — an incident is
+            # exactly when requests_total and the latency tail matter, and
+            # the slowest (failing) requests belong in p99
+            self._obs["requests"].inc()
+            self._obs["request_h"].observe(_time.perf_counter() - t0)
 
     def _serve_loop(self) -> None:
         import queue as _queue
         import time as _time
 
+        depth_g = self._obs["depth"]
         while not self._stop:
             try:
                 first = self._queue.get(timeout=0.1)
@@ -464,6 +496,7 @@ class ParallelInference:
                 batch.append(item)
                 rows += (item[0].shape[0]
                          if item[0].ndim == self._req_ndim() else 1)
+            depth_g.set(self._queue.qsize())
             self._run_batch(batch)
 
     def _req_ndim(self) -> int:
@@ -479,15 +512,30 @@ class ParallelInference:
         return 2
 
     def _run_batch(self, batch) -> None:
+        import time as _time
+
         try:
+            t_dispatch = _time.perf_counter()
+            obs = self._obs
             xs, futs, sizes = [], [], []
-            for x, fut in batch:
+            for x, fut, t_enq in batch:
+                # enqueue→dispatch wait: how long the request sat in the
+                # queue before a batch picked it up
+                obs["wait_h"].observe(t_dispatch - t_enq)
                 xb = x if x.ndim == self._req_ndim() else x[None]
                 xs.append(xb)
                 futs.append(fut)
                 sizes.append(xb.shape[0])
             data = np.concatenate(xs, axis=0)
             n = data.shape[0]
+            obs["batches"].inc()
+            obs["rows"].inc(n)
+            # occupancy: filled rows over the padded slots actually run —
+            # a dispatch can exceed max_batch (multi-row requests), so the
+            # denominator is the chunked-and-padded total, not one chunk;
+            # low occupancy means the padding (not the model) eats the chip
+            slots = -(-n // self.max_batch) * self.max_batch
+            obs["occupancy_h"].observe(n / slots)
             pad = self.max_batch - (n % self.max_batch or self.max_batch)
             if pad:
                 data = np.concatenate(
@@ -503,12 +551,19 @@ class ParallelInference:
                                       P("data", *([None] * (data.ndim - 1)))))
                     outs.append(np.asarray(fn(params, net_state, chunk)))
             out = np.concatenate(outs, axis=0)[:n]
+            t_done = _time.perf_counter()
+            obs["batch_h"].observe(t_done - t_dispatch)
+            observe.tracer().complete_between(
+                "serving_batch", t_dispatch, t_done, category="serving",
+                rows=n, requests=len(batch))
+            observe.log_event("serving_batch", rows=n, requests=len(batch),
+                              batch_seconds=round(t_done - t_dispatch, 6))
             off = 0
             for fut, sz in zip(futs, sizes):
                 fut.set_result(out[off:off + sz])
                 off += sz
         except Exception as e:  # pragma: no cover - propagate to callers
-            for _, fut in batch:
+            for _, fut, _t in batch:
                 if not fut.done():
                     fut.set_exception(e)
 

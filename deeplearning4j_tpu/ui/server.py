@@ -13,6 +13,8 @@ framework in the environment) serving
   * ``/train/sessions``   — attached session ids
   * ``/train/overview``   — score-vs-iteration series
   * ``/train/model``      — per-parameter update:param-ratio + norm series
+  * ``/metrics``          — Prometheus text exposition of the process-wide
+                            observe/ registry (docs/OBSERVABILITY.md)
 
 against the same StatsStorage records StatsListener emits, so the usage
 mirrors the reference exactly:
@@ -304,6 +306,14 @@ class UIServer:
                 elif path.endswith("/train/graph"):
                     body = json.dumps(ui.graph()).encode()
                     ctype = "application/json"
+                elif path.endswith("/metrics"):
+                    # Prometheus text exposition of the process-wide observe/
+                    # registry (recompiles, train-step + serving latency
+                    # histograms — docs/OBSERVABILITY.md)
+                    from deeplearning4j_tpu import observe
+
+                    body = observe.metrics().render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
                 else:
                     self.send_response(404)
                     self.end_headers()

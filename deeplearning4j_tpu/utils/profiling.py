@@ -22,6 +22,8 @@ import time
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
+from deeplearning4j_tpu.observe.tracing import SpanTracer
+
 
 class OpProfiler:
     """Op invocation counting — OpProfiler.java analog (trace-time).
@@ -68,35 +70,20 @@ class OpProfiler:
         return "\n".join(lines)
 
 
-class ChromeTraceWriter:
-    """Chrome trace-event JSON accumulation (ProfilingListener's format)."""
+class ChromeTraceWriter(SpanTracer):
+    """Chrome trace-event JSON accumulation (ProfilingListener's format).
 
-    def __init__(self):
-        self.events: List[Dict[str, Any]] = []
-        self._t0 = time.perf_counter()
+    Since the observe/ telemetry layer landed this is a thin subclass of
+    :class:`deeplearning4j_tpu.observe.tracing.SpanTracer` — profiling
+    artifacts and runtime telemetry spans share ONE trace format (same
+    event schema, same monotonic clock, same ``write()`` output), so a
+    ProfilingListener trace and an ``observe.tracer()`` dump merge cleanly
+    in chrome://tracing / Perfetto. Unbounded by default: an explicit
+    artifact writer must keep the whole run, unlike the bounded
+    process-wide default tracer."""
 
-    def _us(self) -> float:
-        return (time.perf_counter() - self._t0) * 1e6
-
-    @contextlib.contextmanager
-    def span(self, name: str, category: str = "step", **args):
-        start = self._us()
-        yield
-        self.events.append({
-            "name": name, "cat": category, "ph": "X", "ts": start,
-            "dur": self._us() - start, "pid": 0, "tid": 0,
-            "args": args,
-        })
-
-    def instant(self, name: str, **args):
-        self.events.append({"name": name, "cat": "marker", "ph": "i",
-                            "ts": self._us(), "pid": 0, "tid": 0, "s": "g",
-                            "args": args})
-
-    def write(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump({"traceEvents": self.events,
-                       "displayTimeUnit": "ms"}, f)
+    def __init__(self, max_events=None):
+        super().__init__(max_events=max_events)
 
 
 class ProfilingListener:

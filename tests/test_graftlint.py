@@ -390,6 +390,77 @@ class TestGL009NumpyInOpImpl:
         assert findings == [], "\n".join(f.render() for f in findings)
 
 
+class TestGL010WalltimeDuration:
+    def test_true_positive_direct_subtraction(self):
+        fs = _lint("""
+            import time
+
+            def run(job):
+                t0 = time.time()
+                job()
+                return time.time() - t0
+        """, rules={"GL010"})
+        assert len(fs) == 1
+        assert fs[0].rule == "GL010" and fs[0].severity == "error"
+        assert "perf_counter" in fs[0].message
+
+    def test_true_positive_attribute_anchor_across_methods(self):
+        # the repo's own listener pattern: anchor stashed in __init__,
+        # subtracted in a later callback
+        fs = _lint("""
+            import time
+
+            class L:
+                def __init__(self):
+                    self._t0 = time.time()
+
+                def done(self):
+                    return (time.time() - self._t0) * 1000.0
+        """, rules={"GL010"})
+        assert len(fs) == 1 and fs[0].rule == "GL010"
+
+    def test_true_positive_from_import_alias(self):
+        fs = _lint("""
+            from time import time as now
+
+            def f():
+                a = now()
+                return now() - a
+        """, rules={"GL010"})
+        assert len(fs) == 1 and fs[0].rule == "GL010"
+
+    def test_true_negative_timestamps_and_epoch_arithmetic(self):
+        # timestamps (stored, compared, shifted by a constant) are
+        # whitelisted: only a two-wall-operand subtraction is a duration
+        fs = _lint("""
+            import time
+
+            def record(store, timeout):
+                store["timestamp"] = time.time()
+                yesterday = time.time() - 86400
+                deadline = time.time() + timeout
+                return time.time() > deadline, yesterday
+        """, rules={"GL010"})
+        assert fs == []
+
+    def test_true_negative_perf_counter(self):
+        fs = _lint("""
+            import time
+
+            def run(job):
+                t0 = time.perf_counter()
+                job()
+                return time.perf_counter() - t0
+        """, rules={"GL010"})
+        assert fs == []
+
+    def test_repo_durations_are_monotonic(self):
+        """The package itself carries no wall-clock durations (the
+        observability PR swept listeners/arbiter/earlystopping)."""
+        findings = lint_paths(["deeplearning4j_tpu"], REPO, rules=["GL010"])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
 class TestGL006RegistryShadowing:
     def test_repo_whitelist_is_exact(self):
         from deeplearning4j_tpu.lint.rules_consistency import (

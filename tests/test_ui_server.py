@@ -205,6 +205,32 @@ class TestModelGraphPane:
             ui.stop()
 
 
+class TestMetricsEndpoint:
+    def test_prometheus_exposition(self):
+        """/metrics serves the process-wide observe/ registry in Prometheus
+        text format — the acceptance probe asserts the recompile counter
+        and the serving latency histogram are present (they are registered
+        eagerly, so the endpoint carries them even before traffic)."""
+        from deeplearning4j_tpu import observe
+
+        server = UIServer(port=0).start()
+        try:
+            observe.metrics().counter("dl4j_tpu_recompiles_total").inc()
+            observe.metrics().histogram(
+                "dl4j_tpu_serving_request_seconds").observe(0.004)
+            status, body = _get(server.port, "/metrics")
+            assert status == 200
+            text = body.decode()
+            assert "# TYPE dl4j_tpu_recompiles_total counter" in text
+            assert "dl4j_tpu_recompiles_total" in text
+            assert ("# TYPE dl4j_tpu_serving_request_seconds histogram"
+                    in text)
+            assert "dl4j_tpu_serving_request_seconds_bucket" in text
+            assert "dl4j_tpu_serving_request_seconds_count" in text
+        finally:
+            server.stop()
+
+
 class TestRemoteUIStatsStorageRouter:
     def test_worker_posts_reach_the_dashboard(self):
         """A remote router (the launcher-worker side) posts records over
