@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile native native-test lint lint-baseline check check-baseline obs-smoke
+.PHONY: test gate gate-fast bench bench-compile native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -27,6 +27,14 @@ check-baseline:
 # recompile-ledger events, and serving percentiles all came out nonzero.
 obs-smoke:
 	JAX_PLATFORMS=cpu python tools/obsreport.py --json
+
+# generative-serving smoke (docs/SERVING.md): continuous-batching
+# generation, smoke-sized, CPU-pinned — ONE JSON line with tokens/sec,
+# TTFT/inter-token percentiles and the observe generate section.
+serve-smoke:
+	JAX_PLATFORMS=cpu BENCH_MODEL=generate BENCH_RECORD=0 BENCH_QPS=5 \
+	BENCH_REQUESTS=8 BENCH_GEN_TOKENS=8 BENCH_SLOTS=4 BENCH_GPT=tiny \
+	python bench.py
 
 # DL4J_TPU_REQUIRE_NATIVE=1: a missing native lib FAILS the ctypes tests
 # instead of silently exercising the numpy fallback (SURVEY §5.3)

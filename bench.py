@@ -187,6 +187,13 @@ def _bench_attention(iters: int):
     return t_gen / t_flash, "flash_attention_t8192_speedup_vs_generic"
 
 
+def _pct_ms(sorted_xs, q: float) -> float:
+    """Nearest-rank percentile of an ascending latency list, in ms — ONE
+    convention for every latency report this file emits."""
+    return round(sorted_xs[min(len(sorted_xs) - 1,
+                               int(q * len(sorted_xs)))] * 1e3, 3)
+
+
 def _bench_serving(qps: float, n_requests: int, max_batch: int):
     """Serving-latency benchmark (BENCH_MODEL=serving): a fixed-QPS open
     load of ``ParallelInference.predict`` calls against a small MLP —
@@ -248,14 +255,69 @@ def _bench_serving(qps: float, n_requests: int, max_batch: int):
         pi.stop()
     done = sorted(l for l in lat if l is not None)
     assert done, "no serving request completed"
-
-    def pct(q):
-        return done[min(len(done) - 1, int(q * len(done)))]
-
-    extra = {"p50_ms": round(pct(0.50) * 1e3, 3),
-             "p99_ms": round(pct(0.99) * 1e3, 3),
+    extra = {"p50_ms": _pct_ms(done, 0.50), "p99_ms": _pct_ms(done, 0.99),
              "offered_qps": qps, "completed": len(done)}
     return len(done) / t_total, "serving_fixed_qps_req_per_sec", extra
+
+
+def _bench_generate(qps: float, n_requests: int, gen_tokens: int,
+                    max_slots: int, preset: str):
+    """Generative-serving benchmark (BENCH_MODEL=generate): a fixed-QPS
+    open-loop stream of text-generation requests against the continuous-
+    batching engine (docs/SERVING.md) — submissions follow the schedule
+    regardless of completions, same honesty argument as BENCH_MODEL=serving.
+    Value = generated tokens/sec; the JSON line carries p50/p99
+    time-to-first-token AND inter-token latency from the per-request
+    measurements, plus the observe/ snapshot (admit/evict/generated
+    counters, decode-step percentiles). The snapshot is PROCESS-WIDE and
+    includes the warmup request's compile-inclusive latencies (same
+    semantics as BENCH_MODEL=serving) — the steady-state percentiles are
+    the top-level ttft_*/intertoken_* fields, measured post-warmup.
+    Smoke-sized under the subprocess-probe CPU fallback."""
+    from deeplearning4j_tpu.models.gpt import GptConfig, GptModel
+    from deeplearning4j_tpu.serving import GenerativeEngine
+
+    cfg = GptConfig.tiny(vocab_size=512) if preset == "tiny" else \
+        GptConfig.base(vocab_size=8192, max_position=512)
+    model = GptModel(cfg, seed=0)
+    max_prompt = int(os.environ.get("BENCH_MAX_PROMPT", "16"))
+    pages_per_seq = -(-(max_prompt + gen_tokens + 1) // 16) + 1
+    eng = GenerativeEngine(model, max_slots=max_slots, page_size=16,
+                           max_pages_per_seq=pages_per_seq,
+                           max_prompt=max_prompt, seed=0).start()
+    try:
+        r = np.random.RandomState(0)
+        prompts = [r.randint(1, cfg.vocab_size,
+                             size=r.randint(2, max_prompt)).astype(np.int32)
+                   for _ in range(n_requests)]
+        # warm both compiled paths so the timed window measures serving,
+        # not the first prefill/decode XLA compile
+        eng.submit(prompts[0][:2], max_new_tokens=2,
+                   eos_token=-1).result(timeout=600)
+        futs = []
+        t_start = time.perf_counter()
+        for i in range(n_requests):
+            delay = (t_start + i / qps) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futs.append(eng.submit(
+                prompts[i], max_new_tokens=gen_tokens, temperature=0.8,
+                top_k=40, top_p=0.95, eos_token=-1))
+        results = [f.result(timeout=600) for f in futs]
+        t_total = time.perf_counter() - t_start
+    finally:
+        eng.stop()
+    n_tokens = sum(len(res.tokens) for res in results)
+    assert n_tokens > 0, "no tokens generated"
+    ttfts = sorted(res.ttft_s for res in results)
+    itls = sorted(g for res in results for g in res.intertoken_s)
+    extra = {"generated_tokens": n_tokens,
+             "ttft_p50_ms": _pct_ms(ttfts, 0.50),
+             "ttft_p99_ms": _pct_ms(ttfts, 0.99),
+             "intertoken_p50_ms": _pct_ms(itls, 0.50) if itls else None,
+             "intertoken_p99_ms": _pct_ms(itls, 0.99) if itls else None,
+             "offered_qps": qps, "completed": len(results)}
+    return n_tokens / t_total, "generate_open_loop_tokens_per_sec", extra
 
 
 def _bench_graph_compile(layers: int, width: int):
@@ -356,14 +418,16 @@ _UNITS = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
           "bert_base_mlm_train_tokens_per_sec": "tokens/sec/chip",
           "flash_attention_t8192_speedup_vs_generic": "x vs XLA generic",
           "graph_compile_optimizer_speedup": "x trace+compile speedup",
-          "serving_fixed_qps_req_per_sec": "req/sec"}
+          "serving_fixed_qps_req_per_sec": "req/sec",
+          "generate_open_loop_tokens_per_sec": "tokens/sec"}
 
 _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "lenet": "lenet5_mnist_train_images_per_sec",
                  "bert": "bert_base_mlm_train_tokens_per_sec",
                  "attention": "flash_attention_t8192_speedup_vs_generic",
                  "graph_compile": "graph_compile_optimizer_speedup",
-                 "serving": "serving_fixed_qps_req_per_sec"}
+                 "serving": "serving_fixed_qps_req_per_sec",
+                 "generate": "generate_open_loop_tokens_per_sec"}
 
 
 def main() -> None:
@@ -412,6 +476,18 @@ def main() -> None:
                                     "8" if smoke else "32"))
             value, metric, extra = _bench_serving(qps, nreq, mb)
             method = f"q{qps:g}n{nreq}b{mb}"
+        elif model == "generate":
+            qps = float(os.environ.get("BENCH_QPS", "4" if smoke else "16"))
+            nreq = int(os.environ.get("BENCH_REQUESTS",
+                                      "8" if smoke else "64"))
+            gen = int(os.environ.get("BENCH_GEN_TOKENS",
+                                     "8" if smoke else "64"))
+            slots = int(os.environ.get("BENCH_SLOTS", "4" if smoke else "16"))
+            preset = os.environ.get("BENCH_GPT",
+                                    "tiny" if smoke else "base")
+            value, metric, extra = _bench_generate(qps, nreq, gen, slots,
+                                                   preset)
+            method = f"q{qps:g}n{nreq}g{gen}s{slots}{preset}"
         else:
             value, metric = _bench_resnet50(batch, iters, image, dtype)
             method = f"b{batch}x{image}i{iters}{'' if dtype == 'mixed' else dtype}"

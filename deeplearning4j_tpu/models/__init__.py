@@ -10,6 +10,7 @@ from deeplearning4j_tpu.models.zoo import (
     Darknet19,
     UNet,
     TextGenerationLSTM,
+    GPT,
     VGG19,
     SqueezeNet,
     Xception,
@@ -17,4 +18,5 @@ from deeplearning4j_tpu.models.zoo import (
     YOLO2,
     InceptionResNetV1,
 )
+from deeplearning4j_tpu.models.gpt import GptConfig, GptModel
 from deeplearning4j_tpu.models.hub import ModelHub

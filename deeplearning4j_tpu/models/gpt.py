@@ -1,0 +1,274 @@
+"""GPT — decoder-only causal transformer for generative serving.
+
+The generative tier of the model zoo (ROADMAP item 2): the block layout —
+post-LN residual attention + FFN with the same param names (Wq/bq … W2/b2,
+ln_gamma/ln_beta) — is ``models/bert.py``'s encoder block reused verbatim,
+so TP sharding rules (`parallel.mesh.DEFAULT_TP_RULES`) and checkpoint
+mapping apply unchanged. What differs is the attention pattern and the
+execution split the serving engine needs:
+
+* **prefill** (:func:`gpt_prefill`): the whole prompt in ONE causal
+  attention pass through the registry's ``dot_product_attention`` — the
+  Pallas flash platform helper fires on TPU above the ``flash_min_t()``
+  crossover, the XLA path below it — returning per-position logits AND the
+  per-layer K/V the serving engine scatters into its paged cache.
+* **decode** (:func:`gpt_decode_step`): ONE token per sequence against the
+  block-paged KV cache via the registry's ``paged_decode_attention``
+  (Pallas on TPU, gather fallback elsewhere). All shapes are functions of
+  the slot capacity, never of the number of active sequences, so the
+  serving loop compiles exactly once (docs/SERVING.md).
+
+Tied embeddings: logits project through ``embeddings.word.T`` (the BERT MLM
+head convention), so the checkpoint is exactly the param pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import zipfile
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.bert import _layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class GptConfig:
+    """GPT-2-small defaults; ``tiny()`` for tests and CPU smoke serving."""
+
+    vocab_size: int = 50257
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    intermediate: int = 3072
+    max_position: int = 1024
+    layer_norm_eps: float = 1e-5
+    eos_token: int = 0
+
+    @staticmethod
+    def base(**kw) -> "GptConfig":
+        return GptConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "GptConfig":
+        """Test-sized config (mirrors BertConfig.tiny)."""
+        d = dict(vocab_size=256, hidden=64, layers=2, heads=4,
+                 intermediate=128, max_position=128)
+        d.update(kw)
+        return GptConfig(**d)
+
+    # ------------------------------------------------------------- round-trip
+    def to_json(self) -> str:
+        return json.dumps({"@type": "GptConfig",
+                           **dataclasses.asdict(self)}, indent=1)
+
+    @staticmethod
+    def from_json(s: str) -> "GptConfig":
+        d = json.loads(s)
+        d.pop("@type", None)
+        return GptConfig(**d)
+
+
+def init_gpt_params(key, cfg: GptConfig, dtype=jnp.float32) -> Dict[str, Any]:
+    """Parameter pytree; block layout and names identical to
+    ``init_bert_params`` encoder blocks (attn Wq…Wo + ln, ffn W1/W2 + ln)."""
+    ks = iter(jax.random.split(key, 4 + cfg.layers * 16))
+
+    def nrm(shape):
+        return 0.02 * jax.random.normal(next(ks), shape, dtype)
+
+    p: Dict[str, Any] = {
+        "embeddings": {
+            "word": nrm((cfg.vocab_size, cfg.hidden)),
+            "position": nrm((cfg.max_position, cfg.hidden)),
+            "ln_gamma": jnp.ones((cfg.hidden,), dtype),
+            "ln_beta": jnp.zeros((cfg.hidden,), dtype),
+        },
+        "blocks": [],
+    }
+    for _ in range(cfg.layers):
+        p["blocks"].append({
+            "attn": {
+                "Wq": nrm((cfg.hidden, cfg.hidden)), "bq": jnp.zeros((cfg.hidden,), dtype),
+                "Wk": nrm((cfg.hidden, cfg.hidden)), "bk": jnp.zeros((cfg.hidden,), dtype),
+                "Wv": nrm((cfg.hidden, cfg.hidden)), "bv": jnp.zeros((cfg.hidden,), dtype),
+                "Wo": nrm((cfg.hidden, cfg.hidden)), "bo": jnp.zeros((cfg.hidden,), dtype),
+                "ln_gamma": jnp.ones((cfg.hidden,), dtype),
+                "ln_beta": jnp.zeros((cfg.hidden,), dtype),
+            },
+            "ffn": {
+                "W1": nrm((cfg.hidden, cfg.intermediate)),
+                "b1": jnp.zeros((cfg.intermediate,), dtype),
+                "W2": nrm((cfg.intermediate, cfg.hidden)),
+                "b2": jnp.zeros((cfg.hidden,), dtype),
+                "ln_gamma": jnp.ones((cfg.hidden,), dtype),
+                "ln_beta": jnp.zeros((cfg.hidden,), dtype),
+            },
+        })
+    return p
+
+
+def _ffn(blk, x, eps):
+    f = blk["ffn"]
+    hdn = jax.nn.gelu(x @ f["W1"] + f["b1"])
+    return _layer_norm(x + hdn @ f["W2"] + f["b2"],
+                       f["ln_gamma"], f["ln_beta"], eps)
+
+
+def gpt_prefill(params, ids, cfg: GptConfig, *, mask=None
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Causal full-prompt forward.
+
+    ids: (N, T) int32; mask: optional (N, T) 1=real token (end padding).
+    Returns ``(logits (N, T, V), kv (L, 2, N, T, H, Dh))`` — the per-layer
+    keys/values the serving engine scatters into its paged cache.
+    """
+    from deeplearning4j_tpu.ops import exec_op
+
+    emb = params["embeddings"]
+    n, t = ids.shape
+    if t > cfg.max_position:
+        # the position gather would silently CLAMP indices past
+        # max_position (every excess token reusing the last embedding) —
+        # reject instead of returning quietly-wrong logits
+        raise ValueError(
+            f"sequence length {t} exceeds max_position={cfg.max_position}")
+    h, dh = cfg.heads, cfg.hidden // cfg.heads
+    x = emb["word"][ids] + emb["position"][jnp.arange(t)][None]
+    x = _layer_norm(x, emb["ln_gamma"], emb["ln_beta"], cfg.layer_norm_eps)
+
+    def split(a):  # (N, T, E) -> (N, H, T, Dh)
+        return a.reshape(n, t, h, dh).transpose(0, 2, 1, 3)
+
+    m4 = None if mask is None else mask[:, None, None, :].astype(bool)
+    kvs = []
+    for blk in params["blocks"]:
+        a = blk["attn"]
+        q = split(x @ a["Wq"] + a["bq"])
+        k = split(x @ a["Wk"] + a["bk"])
+        v = split(x @ a["Wv"] + a["bv"])
+        # (2, N, T, H, Dh) — token-major, the paged-cache scatter layout
+        kvs.append(jnp.stack([k.transpose(0, 2, 1, 3),
+                              v.transpose(0, 2, 1, 3)]))
+        out = exec_op("dot_product_attention", q, k, v, m4, scaled=True,
+                      causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(n, t, cfg.hidden)
+        x = _layer_norm(x + out @ a["Wo"] + a["bo"],
+                        a["ln_gamma"], a["ln_beta"], cfg.layer_norm_eps)
+        x = _ffn(blk, x, cfg.layer_norm_eps)
+    logits = x @ emb["word"].T
+    return logits, jnp.stack(kvs)
+
+
+def gpt_decode_step(params, kv_pages, tokens, positions, page_table,
+                    seq_lens_incl, write_page, write_offset, cfg: GptConfig
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One decode token for every slot, against the paged KV cache.
+
+    kv_pages: (L, 2, P, page, H, Dh) — functionally updated (donate it);
+    tokens/positions: (S,) int32 — the token being fed and its position;
+    page_table: (S, max_pages) int32; seq_lens_incl: (S,) valid length
+    INCLUDING this token; write_page/write_offset: (S,) where this token's
+    K/V land (the engine points inactive slots at its trash page).
+    Returns ``(kv_pages, logits (S, V))``.
+    """
+    from deeplearning4j_tpu.ops import exec_op
+
+    emb = params["embeddings"]
+    s_n = tokens.shape[0]
+    h, dh = cfg.heads, cfg.hidden // cfg.heads
+    pos = jnp.clip(positions, 0, cfg.max_position - 1)
+    x = emb["word"][tokens] + emb["position"][pos]
+    x = _layer_norm(x, emb["ln_gamma"], emb["ln_beta"], cfg.layer_norm_eps)
+    for li, blk in enumerate(params["blocks"]):
+        a = blk["attn"]
+        q = (x @ a["Wq"] + a["bq"]).reshape(s_n, h, dh)
+        k = (x @ a["Wk"] + a["bk"]).reshape(s_n, h, dh)
+        v = (x @ a["Wv"] + a["bv"]).reshape(s_n, h, dh)
+        kv_pages = kv_pages.at[li, 0, write_page, write_offset].set(k)
+        kv_pages = kv_pages.at[li, 1, write_page, write_offset].set(v)
+        attn = exec_op("paged_decode_attention", q, kv_pages[li, 0],
+                       kv_pages[li, 1], page_table, seq_lens_incl,
+                       scale=1.0 / math.sqrt(dh))
+        attn = attn.reshape(s_n, cfg.hidden)
+        x = _layer_norm(x + attn @ a["Wo"] + a["bo"],
+                        a["ln_gamma"], a["ln_beta"], cfg.layer_norm_eps)
+        x = _ffn(blk, x, cfg.layer_norm_eps)
+    logits = x @ emb["word"].T
+    return kv_pages, logits
+
+
+def reference_generate(params, cfg: GptConfig, prompt, n_new: int
+                       ) -> np.ndarray:
+    """Greedy autoregressive oracle: re-runs the FULL causal prefill for
+    every generated token — O(T²) per token, test-sized only. The paged
+    decode path must reproduce these tokens exactly (tests/test_serving.py
+    greedy-equivalence gate)."""
+    toks = list(np.asarray(prompt).tolist())
+    for _ in range(n_new):
+        ids = jnp.asarray(np.array(toks, np.int32)[None])
+        logits, _ = gpt_prefill(params, ids, cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return np.array(toks[len(prompt):], np.int32)
+
+
+class GptModel:
+    """Decoder model handle: config + params (+ serde). The serving loop
+    (``serving.GenerativeEngine``) owns batching, cache, and sampling."""
+
+    def __init__(self, cfg: GptConfig, seed: int = 0, dtype=jnp.float32,
+                 params: Optional[Dict[str, Any]] = None):
+        self.cfg = cfg
+        self.params = params if params is not None else init_gpt_params(
+            jax.random.key(seed), cfg, dtype)
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(self.params))
+
+    def logits(self, ids) -> np.ndarray:
+        """Convenience full-sequence forward (no cache)."""
+        out, _ = gpt_prefill(self.params, jnp.asarray(ids, jnp.int32),
+                             self.cfg)
+        return np.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# serde — the ModelSerializer zip layout (nn/serde.py) for the raw pytree
+# ---------------------------------------------------------------------------
+
+
+def save_gpt(model: GptModel, path: str) -> None:
+    """configuration.json + coefficients.bin, the nn/serde.py zip layout.
+    The coefficients buffer is f32 (widening bf16 losslessly); meta.json
+    records the param dtype so restore casts back instead of silently
+    promoting a bf16 model to f32 (2x param + KV-cache memory)."""
+    from deeplearning4j_tpu.nn.serde import flatten_pytree
+
+    dtype = str(jnp.dtype(jax.tree.leaves(model.params)[0].dtype))
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json", model.cfg.to_json())
+        z.writestr("meta.json", json.dumps({"dtype": dtype}))
+        z.writestr("coefficients.bin", flatten_pytree(model.params).tobytes())
+
+
+def restore_gpt(path: str) -> GptModel:
+    from deeplearning4j_tpu.nn.serde import unflatten_pytree
+
+    with zipfile.ZipFile(path, "r") as z:
+        cfg = GptConfig.from_json(z.read("configuration.json").decode())
+        flat = np.frombuffer(z.read("coefficients.bin"), np.float32)
+        dtype = jnp.float32
+        if "meta.json" in z.namelist():
+            dtype = jnp.dtype(json.loads(z.read("meta.json"))["dtype"])
+    # abstract template: same structure/shapes/dtypes, zero materialization
+    # cost (a real init would burn the full param memory + PRNG time just
+    # to be overwritten)
+    template = jax.eval_shape(
+        lambda: init_gpt_params(jax.random.key(0), cfg, dtype))
+    return GptModel(cfg, params=unflatten_pytree(template, flat))

@@ -58,6 +58,7 @@ class ModelHub:
         directory."""
         from deeplearning4j_tpu.nn.serde import save_model
         from deeplearning4j_tpu.nn.graph import ComputationGraph, save_graph
+        from deeplearning4j_tpu.models.gpt import GptModel, save_gpt
 
         d = self._dir(name)
         os.makedirs(d, exist_ok=True)
@@ -65,6 +66,9 @@ class ModelHub:
         if isinstance(net, ComputationGraph):
             save_graph(net, artifact)
             kind = "ComputationGraph"
+        elif isinstance(net, GptModel):
+            save_gpt(net, artifact)
+            kind = "GptModel"
         else:
             save_model(net, artifact)
             kind = "MultiLayerNetwork"
@@ -83,6 +87,7 @@ class ModelHub:
         """Load + checksum-verify a published model."""
         from deeplearning4j_tpu.nn.serde import restore_model
         from deeplearning4j_tpu.nn.graph import restore_graph
+        from deeplearning4j_tpu.models.gpt import restore_gpt
 
         manifest = self.manifest(name)
         d = self._dir(name)
@@ -96,6 +101,8 @@ class ModelHub:
         artifact = os.path.join(d, "model.zip")
         if manifest["kind"] == "ComputationGraph":
             return restore_graph(artifact)
+        if manifest["kind"] == "GptModel":
+            return restore_gpt(artifact)
         return restore_model(artifact)
 
     def manifest(self, name: str) -> Dict[str, Any]:

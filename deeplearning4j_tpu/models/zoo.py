@@ -369,6 +369,30 @@ class TextGenerationLSTM(ZooModel):
         return nn.MultiLayerNetwork(conf).init()
 
 
+class GPT(ZooModel):
+    """Decoder-only generative transformer (models/gpt.py) — the zoo entry
+    for the continuous-batching serving tier (docs/SERVING.md). No reference
+    Java analog: the reference zoo stops at TextGenerationLSTM; this is the
+    TPU-native step past it. ``init()`` returns a ``GptModel`` (raw-pytree
+    model like BERT, not a MultiLayerNetwork); serve it through
+    ``serving.GenerativeEngine`` / ``ParallelInference.generative``."""
+
+    def __init__(self, preset: str = "tiny", seed: int = 0, **overrides):
+        from deeplearning4j_tpu.models.gpt import GptConfig
+
+        if preset not in ("tiny", "base"):
+            raise ValueError(f"unknown GPT preset {preset!r} "
+                             "(known: tiny, base)")
+        self.cfg = (GptConfig.tiny(**overrides) if preset == "tiny"
+                    else GptConfig.base(**overrides))
+        self.seed = seed
+
+    def init(self):
+        from deeplearning4j_tpu.models.gpt import GptModel
+
+        return GptModel(self.cfg, seed=self.seed)
+
+
 class VGG19(ZooModel):
     """zoo/model/VGG19.java: 16 conv + 3 dense (VGG16 with one extra conv
     in each of the last three stages)."""

@@ -30,9 +30,48 @@ def _flat_state(states) -> np.ndarray:
     leaves = []
     for s in states:
         leaves.extend(_sorted_leaves(s))
+    return flatten_pytree(leaves)
+
+
+def flatten_pytree(tree) -> np.ndarray:
+    """Flatten any param pytree to ONE f32 coefficients buffer in
+    ``jax.tree.leaves`` order (deterministic for a fixed structure) — the
+    coefficients.bin convention for raw-pytree models (models/gpt.py)."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
     if not leaves:
         return np.zeros((0,), np.float32)
-    return np.concatenate([np.asarray(l).reshape(-1).astype(np.float32) for l in leaves])
+    return np.concatenate(
+        [np.asarray(l).reshape(-1).astype(np.float32) for l in leaves])
+
+
+def unflatten_pytree(template, flat: np.ndarray):
+    """Inverse of :func:`flatten_pytree`: rebuild ``template``'s structure
+    (shapes/dtypes from the template leaves) from the flat buffer. The
+    template may hold real arrays OR abstract ``jax.eval_shape`` leaves —
+    only ``.shape``/``.dtype`` are read."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(template)
+    out, offset = [], 0
+    for l in leaves:
+        shape = tuple(getattr(l, "shape", np.shape(l)))
+        dtype = getattr(l, "dtype", None) or jnp.asarray(l).dtype
+        n = int(np.prod(shape)) if shape else 1
+        chunk = flat[offset:offset + n]
+        if chunk.size != n:
+            raise ValueError(
+                f"coefficients buffer exhausted: leaf needs {n} values, "
+                f"{chunk.size} left — config/params mismatch")
+        out.append(jnp.asarray(chunk.reshape(shape), dtype=dtype))
+        offset += n
+    if offset != flat.size:
+        raise ValueError(
+            f"coefficients buffer has {flat.size - offset} trailing values "
+            f"— config/params mismatch")
+    return jax.tree.unflatten(treedef, out)
 
 
 def save_model(net: MultiLayerNetwork, path: str, save_updater: bool = True,

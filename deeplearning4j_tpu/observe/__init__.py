@@ -88,6 +88,25 @@ def summary() -> Dict[str, Any]:
             "step_p99_ms": _ms(pct["p99"]),
         }
 
+    gen = m.counter("dl4j_tpu_serving_generated_tokens_total").value
+    if gen:
+        dec = m.histogram("dl4j_tpu_serving_decode_step_seconds").percentiles()
+        ttft = m.histogram("dl4j_tpu_serving_ttft_seconds").percentiles()
+        itl = m.histogram("dl4j_tpu_serving_intertoken_seconds").percentiles()
+        out["generate"] = {
+            "generated_tokens": int(gen),
+            "admitted": int(
+                m.counter("dl4j_tpu_serving_admitted_total").value),
+            "evicted": int(
+                m.family_total("dl4j_tpu_serving_evicted_total")),
+            "decode_p50_ms": _ms(dec["p50"]),
+            "decode_p99_ms": _ms(dec["p99"]),
+            "ttft_p50_ms": _ms(ttft["p50"]),
+            "ttft_p99_ms": _ms(ttft["p99"]),
+            "intertoken_p50_ms": _ms(itl["p50"]),
+            "intertoken_p99_ms": _ms(itl["p99"]),
+        }
+
     reqs = m.counter("dl4j_tpu_serving_requests_total").value
     if reqs:
         h = m.histogram("dl4j_tpu_serving_request_seconds")

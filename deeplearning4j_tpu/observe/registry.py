@@ -306,6 +306,15 @@ _CORE_METRICS: Tuple[Tuple[str, str], ...] = (
     ("histogram", "dl4j_tpu_serving_batch_seconds"),
     ("histogram", "dl4j_tpu_serving_batch_occupancy"),
     ("gauge", "dl4j_tpu_serving_queue_depth"),
+    # generative serving (serving/ — docs/SERVING.md). evicted_total grows
+    # reason-labelled children next to this eagerly-registered base.
+    ("counter", "dl4j_tpu_serving_admitted_total"),
+    ("counter", "dl4j_tpu_serving_evicted_total"),
+    ("counter", "dl4j_tpu_serving_generated_tokens_total"),
+    ("gauge", "dl4j_tpu_serving_slot_occupancy"),
+    ("histogram", "dl4j_tpu_serving_decode_step_seconds"),
+    ("histogram", "dl4j_tpu_serving_ttft_seconds"),
+    ("histogram", "dl4j_tpu_serving_intertoken_seconds"),
 )
 
 

@@ -447,17 +447,23 @@ def one_hot(indices, *, depth: int, on_value: float = 1.0, off_value: float = 0.
 
 @op("dot_product_attention")
 def dot_product_attention(q, k, v, mask=None, *, scaled: bool = True,
+                          causal: bool = False,
                           dropout_rate: float = 0.0, dropout_rng=None):
     """q:[...,Lq,Dk] k:[...,Lk,Dk] v:[...,Lk,Dv] -> [...,Lq,Dv].
 
-    ``dropout_rate``/``dropout_rng``: post-softmax attention-prob dropout
-    (the reference's attention dropout order); the Pallas platform helper
-    implements the same semantics in-kernel."""
+    ``causal``: lower-triangular mask (decoder prefill); composes with
+    ``mask``. ``dropout_rate``/``dropout_rng``: post-softmax attention-prob
+    dropout (the reference's attention dropout order); the Pallas platform
+    helper implements the same semantics in-kernel."""
     scores = jnp.einsum("...qd,...kd->...qk", q, k)
     if scaled:
         scores = scores / jnp.sqrt(jnp.asarray(q.shape[-1], scores.dtype))
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    if causal:
+        l_q, l_k = scores.shape[-2], scores.shape[-1]
+        tri = jnp.tril(jnp.ones((l_q, l_k), bool), k=l_k - l_q)
+        scores = jnp.where(tri, scores, jnp.asarray(-1e9, scores.dtype))
     weights = jax.nn.softmax(scores, axis=-1)
     if dropout_rate > 0.0:
         if dropout_rng is None:

@@ -56,9 +56,26 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # Shortest kv length at which the Pallas kernel beats the XLA fused /
-# generic materialized paths on-chip (tools/bench_attention_sweep.py table
-# in BENCH_HISTORY.json 'attention_sweep'; re-measure per device class).
-FLASH_MIN_T = 2048
+# generic materialized paths on-chip. BENCH_HISTORY.json 'attention_sweep'
+# shows flash at 0.65-0.99x vs XLA below t=4096 (grid overhead dominates),
+# so the default crossover is 4096; override per device class with
+# DL4J_TPU_FLASH_MIN_T after re-running tools/bench_attention_sweep.py.
+FLASH_MIN_T_DEFAULT = 4096
+
+
+def flash_min_t() -> int:
+    """Live dispatch threshold: kv lengths below this use the XLA path.
+
+    Read from the environment at resolve time (not import time) so a
+    serving process can be re-pointed at a re-measured crossover without
+    code changes, and tests can cover both sides of the boundary."""
+    import os
+
+    v = os.environ.get("DL4J_TPU_FLASH_MIN_T")
+    try:
+        return int(v) if v else FLASH_MIN_T_DEFAULT
+    except ValueError:
+        return FLASH_MIN_T_DEFAULT
 
 
 def _keep_mask(seed, bh, q0, k0, *, block_q: int, block_k: int, rate: float):
@@ -561,14 +578,195 @@ def flash_mha(q, k, v, *, num_heads: int, causal: bool = False,
     return out.reshape(n, num_heads, t, dh).transpose(0, 2, 1, 3).reshape(n, t, d)
 
 
+# ---------------------------------------------------------------------------
+# Paged decode attention — the serving-side kernel (docs/SERVING.md)
+# ---------------------------------------------------------------------------
+#
+# Generation serves ONE query token per sequence against a block-paged KV
+# cache (vLLM/PagedAttention layout): K/V live in fixed-size pages
+# (num_pages, page_size, heads, head_dim) and each sequence owns a page-table
+# row of page indices. The decode step therefore needs a gather-attention:
+# softmax(q · K[pages]) · V[pages] with positions >= seq_len masked out.
+#
+# Two implementations, selected through the registry platform table exactly
+# like flash attention above:
+#   * `paged_decode_attention_xla` — generic: gather the page table with
+#     fancy indexing and run masked attention; runs anywhere (the CPU-host
+#     fallback) at the cost of materializing the gathered (S, T_max, H, D)
+#     keys in HBM.
+#   * `_paged_decode_call` — Pallas: grid (slot, page) with the page walk
+#     innermost; the page table rides scalar-prefetch (PrefetchScalarGridSpec)
+#     so each grid step DMAs exactly ONE (page_size, H, D) K/V tile straight
+#     from its paged HBM home — the gathered contiguous copy never exists.
+#     Online-softmax running state lives in VMEM scratch across the page
+#     walk of one slot (the FlashAttention-2 recurrence, page-granular).
+
+
+def paged_decode_attention_xla(q, k_pages, v_pages, page_table, seq_lens, *,
+                               scale: Optional[float] = None):
+    """Generic gather path: q:[S,H,D], k/v_pages:[P,page,H,D],
+    page_table:[S,max_pages] int32, seq_lens:[S] int32 -> [S,H,D].
+
+    Scores accumulate in f32 regardless of cache dtype (matches the Pallas
+    kernel's preferred_element_type accumulators)."""
+    s_n, h, d = q.shape
+    page = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    k = k_pages[page_table].reshape(s_n, max_pages * page, h, d)
+    v = v_pages[page_table].reshape(s_n, max_pages * page, h, d)
+    s = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_pages * page)
+    s = jnp.where(pos[None, None, :] < seq_lens[:, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("sht,sthd->shd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _paged_decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, mx_ref, l_ref, *, page: int, scale: float,
+                         heads: int):
+    """One (slot, page) grid step. The per-head q·K dots run as unrolled 2D
+    matmuls (heads is static and small at decode) — Mosaic lowers plain 2D
+    dots reliably where a batched dot_general would not; M=1 rows waste MXU
+    lanes but decode is memory-bound on the K/V stream, not FLOP-bound."""
+    s_idx, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        mx_ref[:] = jnp.full_like(mx_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]        # (H, D)
+    kblk = k_ref[0]     # (page, H, D)
+    vblk = v_ref[0]
+    seq_len = sl_ref[s_idx]
+
+    rows = [_mm_nt(q[h:h + 1, :], kblk[:, h, :]) for h in range(heads)]
+    s = jnp.concatenate(rows, axis=0) * scale   # f32 (H, page)
+    pos = j * page + jax.lax.broadcasted_iota(jnp.int32, (heads, page), 1)
+    s = jnp.where(pos < seq_len, s, -1e30)
+
+    m_prev = mx_ref[:, :1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[:, :1] = l_ref[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+    outs = [_mm_nn(p[h:h + 1, :], vblk[:, h, :]) for h in range(heads)]
+    acc_ref[:] = acc_ref[:] * alpha + jnp.concatenate(outs, axis=0)
+    mx_ref[:, :1] = m_new
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _paged_decode_call(q, k_pages, v_pages, page_table, seq_lens, *,
+                       scale: Optional[float] = None,
+                       interpret: Optional[bool] = None):
+    """Pallas paged decode. Same contract as paged_decode_attention_xla."""
+    s_n, h, d = q.shape
+    page = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    interpret = _resolve_interpret(interpret)
+    kernel = functools.partial(_paged_decode_kernel, page=page, scale=scale,
+                               heads=h)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_n, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda s, j, pt, sl: (s, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda s, j, pt, sl: (pt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda s, j, pt, sl: (pt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda s, j, pt, sl: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_n, h, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def _paged_usable(q, k_pages, v_pages, page_table, seq_lens, **kw):
+    """PlatformHelper::isUsable for the Pallas paged path: shapes must be
+    the documented ranks and the page/head-dim tiles Mosaic-aligned."""
+    if getattr(q, "ndim", 0) != 3 or getattr(k_pages, "ndim", 0) != 4:
+        return False
+    if getattr(page_table, "ndim", 0) != 2 or getattr(seq_lens, "ndim", 0) != 1:
+        return False
+    return q.shape[-1] % 8 == 0 and k_pages.shape[1] % 8 == 0
+
+
+def _check_paged_decode_attention():
+    """Validation case (ops.validation ratchet): XLA gather path vs a
+    straight numpy oracle, and the Pallas interpret kernel vs both."""
+    import numpy as np
+
+    r = np.random.RandomState(7)
+    s_n, h, d, page, n_pages, max_pages = 3, 4, 16, 8, 10, 3
+    q = r.randn(s_n, h, d).astype(np.float32)
+    kp = r.randn(n_pages, page, h, d).astype(np.float32)
+    vp = r.randn(n_pages, page, h, d).astype(np.float32)
+    pt = np.stack([r.choice(n_pages, max_pages, replace=False)
+                   for _ in range(s_n)]).astype(np.int32)
+    sl = np.array([5, 17, 24], np.int32)
+    scale = 1.0 / math.sqrt(d)
+    want = np.zeros_like(q)
+    for i in range(s_n):
+        gk = kp[pt[i]].reshape(-1, h, d)[:sl[i]]
+        gv = vp[pt[i]].reshape(-1, h, d)[:sl[i]]
+        for hh in range(h):
+            sc = gk[:, hh] @ q[i, hh] * scale
+            p = np.exp(sc - sc.max())
+            p = p / p.sum()
+            want[i, hh] = p @ gv[:, hh]
+    got = paged_decode_attention_xla(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(pt), jnp.asarray(sl))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+    got_pl = _paged_decode_call(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(pt), jnp.asarray(sl), interpret=True)
+    np.testing.assert_allclose(np.asarray(got_pl), want, rtol=1e-4, atol=1e-5)
+
+
 def register_platform_attention() -> None:
     """Install flash attention as the TPU platform override for the generic
-    dot_product_attention op (the cuDNN PlatformHelper pattern)."""
+    dot_product_attention op, and register the paged decode-attention op
+    (generic gather impl + Pallas TPU helper) — the cuDNN PlatformHelper
+    pattern both times."""
     from deeplearning4j_tpu.ops.registry import registry
+    from deeplearning4j_tpu.ops import validation as _validation
 
     reg = registry()
 
+    if "paged_decode_attention" not in reg:
+        reg.register(
+            "paged_decode_attention", paged_decode_attention_xla,
+            doc="decode-step attention over a block-paged KV cache "
+                "(q:[S,H,D], k/v_pages:[P,page,H,D], page_table:[S,max_pages],"
+                " seq_lens:[S] -> [S,H,D])")
+        reg.register_platform("paged_decode_attention", "tpu",
+                              _paged_decode_call, _paged_usable)
+        _validation.add_case("paged_decode_attention",
+                             _check_paged_decode_attention)
+
     def flash_dpa(q, k, v, mask=None, *, scaled: bool = True,
+                  causal: bool = False,
                   dropout_rate: float = 0.0, dropout_rng=None):
         scale = (1.0 / math.sqrt(q.shape[-1])) if scaled else 1.0
         if dropout_rate > 0.0 and dropout_rng is None:
@@ -585,28 +783,32 @@ def register_platform_attention() -> None:
             if mask is not None:
                 m = jnp.repeat(mask.reshape(b, tk).astype(jnp.float32), h, axis=0)
             out = flash_attention(fold(q), fold(k), fold(v), m, seed, scale,
-                                  False, None, None, None, rate)
+                                  causal, None, None, None, rate)
             return out.reshape(b, h, t, q.shape[-1])
         m = None if mask is None else mask.reshape(q.shape[0], k.shape[1])
-        return flash_attention(q, k, v, m, seed, scale, False, None, None,
+        return flash_attention(q, k, v, m, seed, scale, causal, None, None,
                                None, rate)
 
     def usable(q, k, v, mask=None, **kw):
-        # Measured crossover (BENCH_HISTORY.json 'attention_sweep', v5e,
-        # bf16 fwd+bwd, round-5 DCE-proof harness w/ variance): below
-        # T=2048 the materialized paths are 1.1-1.6x FASTER than the
-        # Pallas kernel (grid overhead dominates); at 2048 it's par
-        # (+-15%); above, Pallas wins 1.5-3.6x vs XLA fused (the 19-25x
-        # rows at T=8192 are an XLA shape pathology, not the typical win).
-        # Defer below the crossover — PlatformHelper::isUsable (SURVEY §3.1).
-        # EXCEPT with attention-prob dropout: the generic path materializes
-        # a (T, T) bernoulli mask in HBM while flash regenerates it
-        # in-kernel, which flips the crossover (BERT-base seq 512 w/
-        # dropout 0.1: 108k tok/s flash vs 77k generic — BENCH_HISTORY
-        # bert series, round 4).
+        # Measured crossover (BENCH_HISTORY.json 'attention_sweep'): below
+        # the flash_min_t() threshold the materialized paths are FASTER
+        # than the Pallas kernel (grid overhead dominates); above, Pallas
+        # wins 1.5-3.6x vs XLA fused (the 19-25x rows at T=8192 are an XLA
+        # shape pathology, not the typical win). Defer below the
+        # crossover — PlatformHelper::isUsable (SURVEY §3.1). EXCEPT with
+        # attention-prob dropout: the generic path materializes a (T, T)
+        # bernoulli mask in HBM while flash regenerates it in-kernel,
+        # which flips the crossover (BERT-base seq 512 w/ dropout 0.1:
+        # 108k tok/s flash vs 77k generic — BENCH_HISTORY bert, round 4).
         t_kv = k.shape[2] if q.ndim == 4 else k.shape[1]
-        if t_kv < FLASH_MIN_T and not kw.get("dropout_rate", 0.0):
+        if t_kv < flash_min_t() and not kw.get("dropout_rate", 0.0):
             return False
+        if kw.get("causal"):
+            # the kernel's causal mask is start-aligned; only t_q == t_kv
+            # agrees with the reference end-aligned convention
+            t_q = q.shape[2] if q.ndim == 4 else q.shape[1]
+            if t_q != t_kv:
+                return False
         if q.ndim == 3:
             mask_ok = mask is None or (
                 hasattr(mask, "ndim") and mask.ndim in (2, 3)

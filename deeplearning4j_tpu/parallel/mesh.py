@@ -389,6 +389,27 @@ class ParallelInference:
         self._placed = None  # (params, net_state) device-resident for serving
         self._obs = None     # serving instruments, resolved once in start()
 
+    # ------------------------------------------------------- generative tier
+    @staticmethod
+    def generative(model, **engine_kwargs):
+        """Facade to the continuous-batching GENERATIVE serving tier
+        (docs/SERVING.md): where this class batches stateless forwards in a
+        fixed window, a :class:`~deeplearning4j_tpu.serving.GenerativeEngine`
+        schedules a decoder model (``models/gpt.py``) at decode-ITERATION
+        granularity over a block-paged KV cache — admit/evict mid-flight,
+        per-slot sampling. Same lifecycle shape as this class::
+
+            eng = ParallelInference.generative(gpt_model, max_slots=8).start()
+            fut = eng.submit(prompt_ids, max_new_tokens=64, temperature=0.8)
+            result = fut.result()
+            eng.stop()
+
+        ``engine_kwargs`` pass through to ``GenerativeEngine`` (slot
+        capacity, page geometry, prompt bucket, seed)."""
+        from deeplearning4j_tpu.serving import GenerativeEngine
+
+        return GenerativeEngine(model, **engine_kwargs)
+
     # ------------------------------------------------------------- serving
     def start(self) -> "ParallelInference":
         import queue as _queue

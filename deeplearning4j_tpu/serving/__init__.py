@@ -1,0 +1,33 @@
+"""Continuous-batching generative serving (docs/SERVING.md).
+
+The generative-inference tier ROADMAP item 2 names: a decoder-only
+transformer (``models/gpt.py``) served through
+
+* :class:`PagedKVCache` — block-paged KV memory with a free-list allocator
+  (the PagedAttention/vLLM layout), sized once at server start;
+* :class:`SlotScheduler` — iteration-level (Orca-style) continuous
+  batching: admit into free slots / evict finished + overflowing sequences
+  between decode steps;
+* :class:`GenerativeEngine` — the compiled prefill/decode/write functions
+  whose jit signatures depend only on server configuration (compile once,
+  serve any mix of sequences) plus temperature/top-k/top-p sampling with
+  per-slot split PRNG keys (``serving/sampling.py``).
+
+Serve it directly or through the ``ParallelInference.generative`` facade
+(``parallel/mesh.py``). ``BENCH_MODEL=generate`` (bench.py) measures
+tokens/sec with p50/p99 TTFT and inter-token latency.
+"""
+
+from deeplearning4j_tpu.serving.cache import PagedKVCache
+from deeplearning4j_tpu.serving.engine import GenerativeEngine
+from deeplearning4j_tpu.serving.sampling import sample_tokens
+from deeplearning4j_tpu.serving.scheduler import (
+    GenerationRequest,
+    GenerationResult,
+    SlotScheduler,
+)
+
+__all__ = [
+    "PagedKVCache", "GenerativeEngine", "sample_tokens",
+    "GenerationRequest", "GenerationResult", "SlotScheduler",
+]

@@ -1,0 +1,131 @@
+"""Block-paged KV cache — the PagedAttention memory model (SOSP '23).
+
+Generative serving cannot pre-reserve ``max_slots × max_context`` of KV
+memory per sequence: real prompts/outputs vary by two orders of magnitude
+and the reserved-but-unused tail is the memory that would have held more
+concurrent sequences. The vLLM answer, reproduced here:
+
+* KV storage is ONE device array of fixed-size **pages**
+  ``(layers, 2, num_pages + 1, page_size, heads, head_dim)`` allocated once
+  at server start — decode steps never reallocate device memory and their
+  jit signature never changes (the compile-once property
+  ``tests/test_serving.py`` asserts through the RecompileLedger).
+* Each sequence owns an ordered list of pages recorded in a **page table**
+  row ``(max_slots, max_pages_per_seq)``; logical token position ``t`` lives
+  at ``(page_table[slot, t // page_size], t % page_size)``.
+* A host-side **free list** hands out pages at admit/growth and takes them
+  back at evict — allocation is O(1) list ops between decode iterations,
+  never device work.
+
+The LAST page (index ``num_pages``) is the **trash page**: inactive slots'
+decode writes and unallocated page-table entries point at it, so the fully
+vectorized decode step needs no scatter masking — garbage lands where
+nothing ever reads it (attention masks positions ``>= seq_len``).
+
+Invariants (exercised by tests/test_serving.py):
+  * every page is either in the free list or owned by exactly one slot;
+  * ``len(free) + sum(owned) == num_pages`` at all times;
+  * a freed slot's page-table row points wholly at the trash page.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PagedKVCache:
+    """Fixed-pool paged KV storage + free-list allocator (host-side
+    bookkeeping, device-side ``kv`` array threaded through the jitted
+    decode step functionally)."""
+
+    def __init__(self, *, layers: int, heads: int, head_dim: int,
+                 page_size: int = 16, num_pages: int = 64,
+                 max_slots: int = 4, max_pages_per_seq: int = 8,
+                 dtype=jnp.float32):
+        if page_size <= 0 or num_pages <= 0:
+            raise ValueError("page_size and num_pages must be positive")
+        self.layers = layers
+        self.heads = heads
+        self.head_dim = head_dim
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_slots = int(max_slots)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.trash_page = self.num_pages
+        # +1: the trash page — see module docstring
+        self.kv = jnp.zeros((layers, 2, self.num_pages + 1, self.page_size,
+                             heads, head_dim), dtype)
+        self.free: List[int] = list(range(self.num_pages))
+        self.page_table = np.full((self.max_slots, self.max_pages_per_seq),
+                                  self.trash_page, np.int32)
+        self.seq_lens = np.zeros((self.max_slots,), np.int32)
+        self.owned: List[List[int]] = [[] for _ in range(self.max_slots)]
+
+    # ----------------------------------------------------------- accounting
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` tokens."""
+        return -(-int(n_tokens) // self.page_size)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self.free)
+
+    def used_pages(self) -> int:
+        return sum(len(o) for o in self.owned)
+
+    def max_context(self) -> int:
+        """Longest sequence one slot can hold."""
+        return self.max_pages_per_seq * self.page_size
+
+    # ----------------------------------------------------------- allocation
+    def ensure_capacity(self, slot: int, n_tokens: int) -> str:
+        """Grow ``slot``'s page list to cover ``n_tokens`` tokens.
+
+        Returns ``"ok"`` on success, ``"overflow"`` when the sequence would
+        exceed its page-table row (evict: the sequence is at max context),
+        ``"oom"`` when the free list is exhausted (evict: pool pressure).
+        Partial growth never happens — the slot's pages are untouched on
+        either failure."""
+        need = self.pages_for(n_tokens)
+        have = len(self.owned[slot])
+        if need <= have:
+            return "ok"
+        if need > self.max_pages_per_seq:
+            return "overflow"
+        if need - have > len(self.free):
+            return "oom"
+        for i in range(have, need):
+            page = self.free.pop()
+            self.owned[slot].append(page)
+            self.page_table[slot, i] = page
+        return "ok"
+
+    def free_slot(self, slot: int) -> int:
+        """Return ``slot``'s pages to the free list; reset its row to the
+        trash page. Returns the number of pages released."""
+        released = len(self.owned[slot])
+        self.free.extend(self.owned[slot])
+        self.owned[slot] = []
+        self.page_table[slot, :] = self.trash_page
+        self.seq_lens[slot] = 0
+        return released
+
+    def check_invariants(self) -> None:
+        """Allocator soundness (test hook): partition property + table/owned
+        agreement. Raises AssertionError on violation."""
+        all_pages = sorted(self.free + [p for o in self.owned for p in o])
+        assert all_pages == list(range(self.num_pages)), (
+            f"page pool corrupt: free={sorted(self.free)} "
+            f"owned={self.owned}")
+        for slot, pages in enumerate(self.owned):
+            row = self.page_table[slot]
+            assert list(row[:len(pages)]) == pages, (
+                f"slot {slot} page-table row {row} disagrees with owned "
+                f"{pages}")
+            assert all(int(p) == self.trash_page
+                       for p in row[len(pages):]), (
+                f"slot {slot} has stale table entries past its pages: {row}")
+            assert self.seq_lens[slot] <= len(pages) * self.page_size

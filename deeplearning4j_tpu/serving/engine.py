@@ -1,0 +1,421 @@
+"""Generative serving engine — prefill/decode dispatch over the paged cache.
+
+The device half of the serving subsystem (docs/SERVING.md): three jitted
+functions whose signatures depend ONLY on server-start configuration
+(slot capacity, page geometry, prompt bucket) — never on the number of
+active sequences — so the RecompileLedger records exactly one
+``first_compile`` per function and NO ``new_shape`` events across
+admits/evicts (asserted in tests/test_serving.py):
+
+* **prefill** — the whole (padded) prompt through one causal
+  ``gpt_prefill`` pass + first-token sampling; returns the per-layer K/V
+  for the cache scatter. TTFT is measured across this call.
+* **write-prompt** — scatter the prefill K/V into the slot's pages
+  (donated cache array; unused prompt-pad positions land on the trash
+  page).
+* **decode** — one token for EVERY slot (inactive slots ride along masked:
+  they write to the trash page and their outputs are ignored), paged
+  attention via the registry's ``paged_decode_attention``, then the
+  vectorized temperature/top-k/top-p sampler with per-slot keys split from
+  this step's fresh key.
+
+Observability (docs/OBSERVABILITY.md catalog additions): admitted/evicted/
+generated-token counters, slot-occupancy gauge, decode-step latency
+histogram, TTFT + inter-token histograms, ``serving_prefill``/
+``serving_decode`` spans, and ledger notes on both compiled functions.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import observe
+from deeplearning4j_tpu.models.gpt import GptModel, gpt_decode_step, gpt_prefill
+from deeplearning4j_tpu.serving.cache import PagedKVCache
+from deeplearning4j_tpu.serving.sampling import sample_tokens
+from deeplearning4j_tpu.serving.scheduler import (
+    GenerationRequest, GenerationResult, SlotScheduler)
+
+logger = logging.getLogger(__name__)
+
+
+class GenerativeEngine:
+    """Continuous-batching text generation over a ``GptModel``.
+
+    Synchronous use (tests, batch jobs)::
+
+        eng = GenerativeEngine(model, max_slots=4)
+        results = eng.generate([prompt1, prompt2], max_new_tokens=32)
+
+    Serving use (the ``ParallelInference`` shape)::
+
+        eng.start()
+        fut = eng.submit(prompt, temperature=0.8, top_p=0.95)
+        result = fut.result()
+        eng.stop()
+    """
+
+    def __init__(self, model: GptModel, *, max_slots: int = 4,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 max_pages_per_seq: int = 8, max_prompt: int = 32,
+                 seed: int = 0):
+        cfg = model.cfg
+        if cfg.hidden % cfg.heads:
+            raise ValueError("hidden must be divisible by heads")
+        if max_prompt > cfg.max_position:
+            # gpt_prefill's position gather would silently CLAMP indices
+            # past max_position — reject the misconfiguration instead
+            raise ValueError(
+                f"max_prompt={max_prompt} exceeds the model's "
+                f"max_position={cfg.max_position}")
+        self.model = model
+        self.cfg = cfg
+        self.max_prompt = int(max_prompt)
+        if num_pages is None:
+            # full reservation by default; oversubscribe explicitly to make
+            # the free-list pressure (oom evictions) reachable
+            num_pages = max_slots * max_pages_per_seq
+        self.cache = PagedKVCache(
+            layers=cfg.layers, heads=cfg.heads,
+            head_dim=cfg.hidden // cfg.heads, page_size=page_size,
+            num_pages=num_pages, max_slots=max_slots,
+            max_pages_per_seq=max_pages_per_seq,
+            dtype=jax.tree.leaves(model.params)[0].dtype)
+        if self.max_prompt + 1 > self.cache.max_context():
+            raise ValueError(
+                f"max_prompt={max_prompt} + 1 exceeds per-slot context "
+                f"{self.cache.max_context()} "
+                f"(page_size*max_pages_per_seq)")
+        self.scheduler = SlotScheduler(max_slots)
+        self._key = jax.random.key(seed)
+        # key-hygiene audit trail: raw key data of every key handed to a
+        # jitted sampler, bounded; tests assert no value ever repeats
+        self.key_trail: "deque[bytes]" = deque(maxlen=4096)
+        self._prefill_fn = None
+        self._write_fn = None
+        self._decode_fn = None
+        self._worker: Optional[threading.Thread] = None
+        self._stop_flag = False
+        self._error: Optional[Exception] = None
+        m = observe.metrics()
+        self._obs = {
+            "admitted": m.counter("dl4j_tpu_serving_admitted_total"),
+            "generated": m.counter("dl4j_tpu_serving_generated_tokens_total"),
+            "occupancy": m.gauge("dl4j_tpu_serving_slot_occupancy"),
+            "decode_h": m.histogram("dl4j_tpu_serving_decode_step_seconds"),
+            "ttft_h": m.histogram("dl4j_tpu_serving_ttft_seconds"),
+            "itl_h": m.histogram("dl4j_tpu_serving_intertoken_seconds"),
+        }
+
+    # ------------------------------------------------------------------ keys
+    def _next_key(self):
+        """Split a fresh subkey off the root key — the ONLY way keys leave
+        the engine, so the audit trail sees every one exactly once."""
+        self._key, sub = jax.random.split(self._key)
+        self.key_trail.append(np.asarray(jax.random.key_data(sub)).tobytes())
+        return sub
+
+    # ---------------------------------------------------------- compiled fns
+    def _build_prefill(self):
+        cfg = self.cfg
+
+        @jax.jit
+        def prefill(params, ids, prompt_len, key, temp, top_k, top_p):
+            mask = (jnp.arange(ids.shape[1]) < prompt_len)[None, :]
+            logits, kv = gpt_prefill(params, ids, cfg,
+                                     mask=mask.astype(jnp.int32))
+            last = logits[0, prompt_len - 1][None]  # (1, V)
+            tok = sample_tokens(last, key, temp, top_k, top_p)[0]
+            return kv[:, :, 0], tok  # (L, 2, T, H, Dh), scalar
+
+        return prefill
+
+    def _build_write(self):
+        cache = self.cache
+        page, trash = cache.page_size, cache.trash_page
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def write_prompt(kv_pages, kv_prompt, pt_row, prompt_len):
+            pos = jnp.arange(kv_prompt.shape[2])
+            valid = pos < prompt_len
+            page_idx = jnp.where(valid, pt_row[pos // page], trash)
+            off = pos % page
+            return kv_pages.at[:, :, page_idx, off].set(kv_prompt)
+
+        return write_prompt
+
+    def _build_decode(self):
+        cfg, cache = self.cfg, self.cache
+        page, trash = cache.page_size, cache.trash_page
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def decode(params, kv_pages, page_table, seq_lens, tokens, active,
+                   key, temp, top_k, top_p):
+            s_n = tokens.shape[0]
+            on = active > 0
+            write_page = jnp.where(
+                on, page_table[jnp.arange(s_n), seq_lens // page], trash)
+            write_off = seq_lens % page
+            seq_incl = seq_lens + on.astype(jnp.int32)
+            kv_pages, logits = gpt_decode_step(
+                params, kv_pages, tokens, seq_lens, page_table, seq_incl,
+                write_page, write_off, cfg)
+            toks = sample_tokens(logits, key, temp, top_k, top_p)
+            return kv_pages, toks, logits
+
+        return decode
+
+    # ------------------------------------------------------------------- api
+    def submit(self, prompt, *, max_new_tokens: int = 16,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               eos_token: Optional[int] = None
+               ) -> "Future[GenerationResult]":
+        """Queue one generation; returns a Future (thread-safe). A stopped
+        engine rejects new work — build a fresh one."""
+        if self._error is not None:
+            raise RuntimeError("engine loop died") from self._error
+        if self._stop_flag and self._worker is None:
+            raise RuntimeError("engine stopped — submit rejected")
+        eos = self.cfg.eos_token if eos_token is None else eos_token
+        req = GenerationRequest(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p, eos_token=eos)
+        if req.prompt.size > self.max_prompt:
+            raise ValueError(
+                f"prompt length {req.prompt.size} exceeds the engine's "
+                f"prefill bucket max_prompt={self.max_prompt}")
+        lo, hi = int(req.prompt.min()), int(req.prompt.max())
+        if lo < 0 or hi >= self.cfg.vocab_size:
+            # the embedding gather would silently clamp/wrap out-of-range
+            # ids into plausible-but-wrong generations
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.cfg.vocab_size}), "
+                f"got range [{lo}, {hi}]")
+        fut = self.scheduler.submit(req)
+        if self._error is not None or (self._stop_flag
+                                       and self._worker is None):
+            # the loop died or stop() completed between the checks above
+            # and our enqueue — its fail_all may have drained pending
+            # before we appended; fail everything (incl. this future) so
+            # result() can never hang
+            self.scheduler.fail_all(
+                RuntimeError("engine stopped" if self._error is None
+                             else "engine loop died"))
+        return fut
+
+    def generate(self, prompts: Sequence, **kw) -> List[GenerationResult]:
+        """Synchronous batch generation: submit everything, run the
+        scheduler loop inline until drained."""
+        if self._worker is not None:
+            raise RuntimeError("generate() is the inline mode — the engine "
+                               "is already running a serving loop; use "
+                               "submit()")
+        futs = [self.submit(p, **kw) for p in prompts]
+        while self.scheduler.has_work():
+            self.step()
+        return [f.result() for f in futs]
+
+    def start(self) -> "GenerativeEngine":
+        if self._worker is not None:
+            return self
+        self._stop_flag = False
+        self._worker = threading.Thread(target=self._serve_loop, daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_flag = True
+        if self._worker is not None:
+            self._worker.join(timeout=30)
+            if self._worker.is_alive():
+                # do NOT null _worker: a restart would race the stuck
+                # thread over the same cache/scheduler (double page frees,
+                # double-donated kv buffer)
+                raise RuntimeError(
+                    "serving loop still running after 30s (a decode step "
+                    "is stuck); engine left stopping, not restartable")
+            self._worker = None
+        # in-flight sequences retire with their partial output and the
+        # documented "stopped" reason (the worker is joined — no race);
+        # queued-but-never-admitted requests fail
+        for slot in self.scheduler.active_slots():
+            self._retire(slot, "stopped")
+        self.scheduler.fail_all(
+            RuntimeError("GenerativeEngine stopped before this request "
+                         "completed"))
+
+    def _serve_loop(self) -> None:
+        while not self._stop_flag:
+            if not self.scheduler.has_work():
+                time.sleep(1e-3)
+                continue
+            try:
+                self.step()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.exception("serving loop died")
+                self._error = e
+                self.scheduler.fail_all(e)
+                return
+
+    # ------------------------------------------------------------ scheduling
+    def _retire(self, slot: int, reason: str) -> None:
+        self.scheduler.retire(slot, reason)
+        self.cache.free_slot(slot)
+        observe.metrics().counter(
+            "dl4j_tpu_serving_evicted_total", reason=reason).inc()
+
+    def step(self) -> int:
+        """ONE scheduler iteration: capacity-evict, admit, retire finished,
+        then one decode step for the whole slot bank. Returns the number of
+        tokens generated (0 when idle)."""
+        cache, sched = self.cache, self.scheduler
+
+        # 1. retire sequences completed by the previous iteration FIRST:
+        #    a finished slot must neither grab capacity pages it will never
+        #    write nor be mis-retired as oom/overflow (which would skip the
+        #    eos trim and steal pages a live neighbour needed)
+        for slot in sched.active_slots():
+            reason = sched.should_finish(slot)
+            if reason:
+                self._retire(slot, reason)
+
+        # 2. capacity: every surviving slot needs room for one more token
+        for slot in sched.active_slots():
+            need = int(cache.seq_lens[slot]) + 1
+            if need > self.cfg.max_position:
+                self._retire(slot, "overflow")
+                continue
+            status = cache.ensure_capacity(slot, need)
+            if status != "ok":
+                self._retire(slot, status)
+
+        # 3. admissions into free slots, in arrival order (submit() already
+        #    bounds prompts to the max_prompt bucket, which __init__ bounds
+        #    to the per-slot context — no per-request overflow check here)
+        while sched.pending:
+            free = sched.free_slot_ids()
+            if not free:
+                break
+            req, fut, t_sub = sched.pending[0]
+            p_len = int(req.prompt.size)
+            # p_len + 1 everywhere: the SAME iteration's decode writes the
+            # first generated token's K/V at position p_len, so a page-
+            # aligned prompt needs its next page NOW — allocating only the
+            # prompt's pages would send that write to the trash page
+            if cache.pages_for(p_len + 1) > cache.free_pages:
+                if not sched.slots:
+                    # nothing active to ever free pages — config-impossible
+                    sched.pending.popleft()
+                    if not fut.done():
+                        fut.set_exception(RuntimeError(
+                            f"prompt needs {cache.pages_for(p_len + 1)} "
+                            f"pages but the pool only has "
+                            f"{cache.num_pages}"))
+                    continue
+                break  # pool pressure: wait for evictions to free pages
+            slot = free[0]
+            cache.ensure_capacity(slot, p_len + 1)
+            first_tok = self._prefill_into(slot, req)
+            cache.seq_lens[slot] = p_len
+            now = time.perf_counter()
+            sched.admit(slot, req, fut, t_sub, first_tok, now)
+            sched.pending.popleft()
+            self._obs["admitted"].inc()
+            self._obs["generated"].inc()
+            self._obs["ttft_h"].observe(now - t_sub)
+
+        # 4. a just-admitted sequence can already be done (first token was
+        #    its eos, or max_new_tokens == 1) — retire before decoding
+        for slot in sched.active_slots():
+            reason = sched.should_finish(slot)
+            if reason:
+                self._retire(slot, reason)
+
+        self._obs["occupancy"].set(sched.occupancy())
+        active = sched.active_slots()
+        if not active:
+            return 0
+
+        # 5. one decode iteration over the whole slot bank
+        s_n = cache.max_slots
+        tokens = np.zeros((s_n,), np.int32)
+        act = np.zeros((s_n,), np.int32)
+        temp = np.zeros((s_n,), np.float32)
+        top_k = np.zeros((s_n,), np.int32)
+        top_p = np.ones((s_n,), np.float32)
+        for slot in active:
+            st = sched.slots[slot]
+            tokens[slot] = st.tokens[-1]
+            act[slot] = 1
+            temp[slot] = st.request.temperature
+            top_k[slot] = st.request.top_k
+            top_p[slot] = st.request.top_p
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        key = self._next_key()
+        args = (jnp.asarray(cache.page_table), jnp.asarray(cache.seq_lens),
+                jnp.asarray(tokens), jnp.asarray(act))
+        observe.note_jit_signature(
+            self._decode_fn, graph="serving", key="decode",
+            signature=observe.signature_of(
+                page_table=cache.page_table, seq_lens=cache.seq_lens,
+                tokens=tokens, active=act))
+        t0 = time.perf_counter()
+        with observe.tracer().span("serving_decode", category="serving",
+                                   slots=len(active)):
+            cache.kv, next_toks, _logits = self._decode_fn(
+                self.model.params, cache.kv, *args, key,
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
+            next_toks = np.asarray(next_toks)
+        dt = time.perf_counter() - t0
+        self._obs["decode_h"].observe(dt)
+        now = time.perf_counter()
+        for slot in active:
+            cache.seq_lens[slot] += 1  # the fed token is cached now
+            st = sched.slots[slot]
+            if st.last_token_t is not None:
+                self._obs["itl_h"].observe(now - st.last_token_t)
+            sched.on_decode_token(slot, int(next_toks[slot]), now)
+        self._obs["generated"].inc(len(active))
+        observe.log_event("serving_decode", slots=len(active),
+                          step_seconds=round(dt, 6))
+        return len(active)
+
+    def _prefill_into(self, slot: int, req: GenerationRequest) -> int:
+        """Run the (bucketed) prefill, scatter K/V into the slot's pages,
+        return the first sampled token."""
+        cache = self.cache
+        p_len = int(req.prompt.size)
+        ids = np.zeros((1, self.max_prompt), np.int32)
+        ids[0, :p_len] = req.prompt
+        if self._prefill_fn is None:
+            self._prefill_fn = self._build_prefill()
+        if self._write_fn is None:
+            self._write_fn = self._build_write()
+        key = self._next_key()
+        observe.note_jit_signature(
+            self._prefill_fn, graph="serving", key="prefill",
+            signature=observe.signature_of(ids=ids))
+        with observe.tracer().span("serving_prefill", category="serving",
+                                   prompt_len=p_len):
+            kv_prompt, tok = self._prefill_fn(
+                self.model.params, jnp.asarray(ids),
+                jnp.asarray(p_len, jnp.int32), key,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32))
+            cache.kv = self._write_fn(
+                cache.kv, kv_prompt, jnp.asarray(cache.page_table[slot]),
+                jnp.asarray(p_len, jnp.int32))
+            tok = int(tok)
+        return tok

@@ -1,0 +1,56 @@
+"""Per-slot token sampling — temperature / top-k / top-p, jit-stable.
+
+One vectorized function over the whole slot axis: every knob is a device
+array of shape ``(S,)`` so heterogeneous requests (a greedy slot next to a
+temperature-1.2 top-p slot) share ONE compiled sampler — no per-request
+recompiles, which is the entire point of the fixed-capacity decode step.
+
+PRNG hygiene (graftlint GL004): the caller passes ONE fresh step key; it is
+split into per-slot keys HERE, once, and every key is consumed exactly once
+by its slot's categorical draw. The serving engine derives the step key by
+splitting its root key every iteration — ``tests/test_serving.py`` asserts
+no key value ever repeats across the scheduler loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(logits, step_key, temperature, top_k, top_p):
+    """Sample one token per slot.
+
+    logits: (S, V) f32; step_key: ONE jax PRNG key for this decode step;
+    temperature: (S,) f32 — ``<= 0`` means greedy argmax for that slot;
+    top_k: (S,) int32 — ``0`` disables the k cutoff;
+    top_p: (S,) f32 — ``1.0`` disables the nucleus cutoff.
+    Returns (S,) int32.
+    """
+    s_n, vocab = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    # top-k: keep scores >= the k-th largest per row (k=0 -> keep all)
+    k = jnp.clip(jnp.where(top_k > 0, top_k, vocab), 1, vocab)
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(desc, (k - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled >= kth, scaled, -jnp.inf)
+
+    # top-p (nucleus) on the k-masked distribution: keep the smallest
+    # prefix of descending probs whose mass reaches top_p. A sorted token
+    # is kept when the mass BEFORE it is < top_p, so the cutoff prob is
+    # the smallest kept prob; >= maps the cutoff back to vocab order.
+    probs = jax.nn.softmax(masked, axis=-1)
+    sp = jnp.sort(probs, axis=-1)[:, ::-1]
+    cum = jnp.cumsum(sp, axis=-1)
+    keep_sorted = (cum - sp) < top_p[:, None]
+    cutoff = jnp.min(jnp.where(keep_sorted, sp, jnp.inf), axis=-1,
+                     keepdims=True)
+    masked = jnp.where(probs >= cutoff, masked, -jnp.inf)
+
+    keys = jax.random.split(step_key, s_n)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -1,0 +1,178 @@
+"""Slot-based continuous-batching scheduler — iteration-level scheduling.
+
+Orca (OSDI '22) named the policy this module implements: schedule at the
+**decode-iteration** boundary, not the request boundary. A fixed bank of
+``max_slots`` slots rides one compiled decode step; between iterations the
+scheduler (a) retires slots whose sequence finished (EOS / token budget) or
+must leave (page-table overflow, page-pool exhaustion), returning their
+pages to the free list, and (b) admits queued requests into the freed slots
+— so a 5-token reply never holds its slot hostage for a 500-token
+neighbour's lifetime, which is what fixed-window batching
+(``ParallelInference``'s request path) does for stateless inference.
+
+The scheduler is pure host-side policy/state: no jax, no device work — the
+``GenerativeEngine`` owns prefill/decode dispatch and calls in here between
+iterations. Timing fields use ``time.perf_counter`` only (graftlint GL010).
+
+Slot lifecycle::
+
+    FREE --admit(prefill ok)--> ACTIVE --finish(eos|length)--> FREE
+                                   \\--evict(overflow|oom|stopped)--> FREE
+
+``GenerationResult.finish_reason`` records which arc retired the request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+FINISH_REASONS = ("eos", "length", "overflow", "oom", "stopped")
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    """One text-generation request (token-id space; tokenization is the
+    caller's concern)."""
+
+    prompt: np.ndarray               # (t,) int32 token ids
+    max_new_tokens: int = 16
+    temperature: float = 0.0         # <= 0 -> greedy
+    top_k: int = 0                   # 0 -> disabled
+    top_p: float = 1.0               # 1.0 -> disabled
+    eos_token: int = -1              # -1 -> never stop on a token
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 disables), "
+                             f"got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            # top_p == 0 would mask EVERY token and silently degenerate to
+            # emitting id 0; "disable" is top_p=1.0
+            raise ValueError(f"top_p must be in (0, 1] (1.0 disables), "
+                             f"got {self.top_p}")
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    """Completed (or evicted) generation + its latency raw material."""
+
+    tokens: np.ndarray               # generated ids (no prompt, no eos)
+    finish_reason: str
+    prompt_len: int
+    ttft_s: Optional[float]          # submit -> first token (perf_counter)
+    intertoken_s: List[float]        # successive decode-token gaps
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: GenerationRequest
+    future: "Future[GenerationResult]"
+    submit_t: float
+    prompt_len: int
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    ttft_s: Optional[float] = None
+    intertoken_s: List[float] = dataclasses.field(default_factory=list)
+    last_token_t: Optional[float] = None
+
+
+class SlotScheduler:
+    """Pending queue + slot bank. Thread-safe enough for one engine loop
+    plus submitting client threads (the deque is the only shared mutable;
+    appends/popleft are atomic)."""
+
+    def __init__(self, max_slots: int):
+        self.max_slots = int(max_slots)
+        self.pending: Deque[tuple] = deque()
+        self.slots: Dict[int, _Slot] = {}
+
+    # ------------------------------------------------------------ submission
+    def submit(self, request: GenerationRequest) -> "Future[GenerationResult]":
+        fut: "Future[GenerationResult]" = Future()
+        self.pending.append((request, fut, time.perf_counter()))
+        return fut
+
+    # --------------------------------------------------------------- queries
+    def active_slots(self) -> List[int]:
+        return sorted(self.slots)
+
+    def free_slot_ids(self) -> List[int]:
+        return [s for s in range(self.max_slots) if s not in self.slots]
+
+    def has_work(self) -> bool:
+        return bool(self.slots) or bool(self.pending)
+
+    def occupancy(self) -> float:
+        return len(self.slots) / self.max_slots if self.max_slots else 0.0
+
+    # ------------------------------------------------------------- lifecycle
+    def admit(self, slot: int, request: GenerationRequest,
+              future: "Future[GenerationResult]", submit_t: float,
+              first_token: int, now: float) -> None:
+        """Install a prefilled request into ``slot`` with its first sampled
+        token (TTFT is measured here: prefill produced a token)."""
+        st = _Slot(request=request, future=future, submit_t=submit_t,
+                   prompt_len=int(request.prompt.size))
+        st.tokens.append(int(first_token))
+        st.ttft_s = now - submit_t
+        st.last_token_t = now
+        self.slots[slot] = st
+
+    def on_decode_token(self, slot: int, token: int, now: float) -> None:
+        st = self.slots[slot]
+        st.tokens.append(int(token))
+        if st.last_token_t is not None:
+            st.intertoken_s.append(now - st.last_token_t)
+        st.last_token_t = now
+
+    def should_finish(self, slot: int) -> Optional[str]:
+        """``"eos"``/``"length"`` when the slot's sequence is complete."""
+        st = self.slots[slot]
+        if st.tokens and st.tokens[-1] == st.request.eos_token:
+            return "eos"
+        if len(st.tokens) >= st.request.max_new_tokens:
+            return "length"
+        return None
+
+    def retire(self, slot: int, reason: str) -> GenerationResult:
+        """Remove ``slot`` and complete its future. The caller frees the
+        slot's cache pages (the scheduler never touches device state)."""
+        if reason not in FINISH_REASONS:
+            raise ValueError(f"unknown finish reason {reason!r}")
+        st = self.slots.pop(slot)
+        toks = st.tokens
+        if reason == "eos" and toks and toks[-1] == st.request.eos_token:
+            toks = toks[:-1]
+        result = GenerationResult(
+            tokens=np.asarray(toks, np.int32), finish_reason=reason,
+            prompt_len=st.prompt_len, ttft_s=st.ttft_s,
+            intertoken_s=list(st.intertoken_s))
+        if not st.future.done():
+            st.future.set_result(result)
+        return result
+
+    def fail_all(self, exc: Exception) -> None:
+        """Engine shutdown/crash: fail every in-flight and queued future so
+        blocked callers wake instead of hanging (the ParallelInference.stop
+        contract)."""
+        for slot in list(self.slots):
+            st = self.slots.pop(slot, None)  # tolerate a concurrent caller
+            if st is not None and not st.future.done():
+                st.future.set_exception(exc)
+        while True:
+            try:
+                _req, fut, _t = self.pending.popleft()
+            except IndexError:  # drained (possibly by a concurrent caller)
+                break
+            if not fut.done():
+                fut.set_exception(exc)

@@ -163,3 +163,86 @@ class TestFlashAttention:
         for a, b in zip(g, r):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-4)
+
+
+class TestShapeAwareDispatch:
+    """The registry must route dot_product_attention by kv length: XLA below
+    the measured crossover (flash loses to the fused path at small T —
+    BENCH_HISTORY attention_sweep), the Pallas helper at/above it. The
+    threshold is DL4J_TPU_FLASH_MIN_T (default 4096), read at resolve time."""
+
+    def _desc(self):
+        from deeplearning4j_tpu.ops.registry import registry
+
+        register_platform_attention()  # idempotent under `in reg` guard
+        return registry().get("dot_product_attention")
+
+    def _qkv(self, t, d=16):
+        x = jnp.zeros((2, t, d), jnp.float32)
+        return x, x, x
+
+    def test_default_threshold(self, monkeypatch):
+        from deeplearning4j_tpu.ops.pallas_attention import flash_min_t
+
+        monkeypatch.delenv("DL4J_TPU_FLASH_MIN_T", raising=False)
+        assert flash_min_t() == 4096
+        monkeypatch.setenv("DL4J_TPU_FLASH_MIN_T", "512")
+        assert flash_min_t() == 512
+        monkeypatch.setenv("DL4J_TPU_FLASH_MIN_T", "junk")
+        assert flash_min_t() == 4096
+
+    def test_dispatch_both_sides_of_boundary(self, monkeypatch):
+        from deeplearning4j_tpu.environment import environment
+
+        desc = self._desc()
+        env = environment()
+        old = env.helper_mode
+        env.helper_mode = "pallas"  # force platform-table resolution on CPU
+        try:
+            monkeypatch.setenv("DL4J_TPU_FLASH_MIN_T", "64")
+            below = desc.resolve(*self._qkv(t=63))
+            at = desc.resolve(*self._qkv(t=64))
+            above = desc.resolve(*self._qkv(t=128))
+            assert below is desc.fn, "below threshold must fall back to XLA"
+            assert at is desc.platform_impls["tpu"]
+            assert above is desc.platform_impls["tpu"]
+        finally:
+            env.helper_mode = old
+
+    def test_dropout_overrides_threshold(self, monkeypatch):
+        """In-kernel dropout flips the crossover (the generic path pays a
+        (T, T) HBM mask) — flash stays selected below the threshold."""
+        from deeplearning4j_tpu.environment import environment
+
+        desc = self._desc()
+        env = environment()
+        old = env.helper_mode
+        env.helper_mode = "pallas"
+        try:
+            monkeypatch.setenv("DL4J_TPU_FLASH_MIN_T", "4096")
+            q, k, v = self._qkv(t=32)
+            got = desc.resolve(q, k, v, dropout_rate=0.1,
+                               dropout_rng=jax.random.key(0))
+            assert got is desc.platform_impls["tpu"]
+        finally:
+            env.helper_mode = old
+
+    def test_causal_prefill_equivalence_across_dispatch(self):
+        """The serving prefill calls the op with causal=True: both resolved
+        impls must agree (1e-2/1e-5) so the dispatch threshold can never
+        change generated text."""
+        r = np.random.RandomState(4)
+        q = jnp.asarray(r.randn(1, 2, 24, 16).astype(np.float32))
+        k = jnp.asarray(r.randn(1, 2, 24, 16).astype(np.float32))
+        v = jnp.asarray(r.randn(1, 2, 24, 16).astype(np.float32))
+        mask = jnp.asarray((np.arange(24) < 20).astype(np.float32)
+                           .reshape(1, 1, 1, 24))
+        from deeplearning4j_tpu.ops.registry import registry
+
+        desc = registry().get("dot_product_attention")
+        generic = desc.fn(q, k, v, mask > 0.5, scaled=True, causal=True)
+        flash = desc.platform_impls["tpu"](q, k, v, mask, scaled=True,
+                                           causal=True)
+        np.testing.assert_allclose(np.asarray(flash)[:, :, :20],
+                                   np.asarray(generic)[:, :, :20],
+                                   rtol=1e-2, atol=1e-5)

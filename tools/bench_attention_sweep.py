@@ -9,8 +9,9 @@ T x {causal, full}, fwd+bwd in bf16:
 
 Records the full table in BENCH_HISTORY.json under 'attention_sweep' and
 prints one row per shape. The platform-helper usable gate auto-defers to
-XLA wherever this table shows Pallas losing (ops/pallas_attention.py
-FLASH_MIN_T).
+XLA wherever this table shows Pallas losing — set DL4J_TPU_FLASH_MIN_T to
+the re-measured crossover (ops/pallas_attention.py flash_min_t(), default
+4096).
 """
 
 from __future__ import annotations

@@ -21,6 +21,8 @@ Stages:
      backend/environment failures report an explicit skipped JSON line)
   6. obs smoke: tools/obsreport.py --json must report nonzero train steps,
      recompile-ledger events, and serving p50/p99 (docs/OBSERVABILITY.md)
+  7. serve smoke: BENCH_MODEL=generate continuous-batching generation must
+     produce tokens with a finite decode p99 (docs/SERVING.md)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -184,6 +186,42 @@ def obs_stage() -> bool:
     return ok
 
 
+def serve_stage() -> bool:
+    """Generative-serving smoke (docs/SERVING.md): BENCH_MODEL=generate
+    against the continuous-batching engine must emit ONE JSON line with
+    generated tokens > 0 and a finite decode p99 — the bench.py subprocess
+    backend probe gives it the CPU fallback, so this passes on CPU-only
+    hosts. Like lint/check/obs: one machine-parsable line in the log."""
+    print("== gate: serve-smoke (generate, open-loop) ==", flush=True)
+    env = dict(os.environ, BENCH_MODEL="generate", BENCH_RECORD="0",
+               BENCH_QPS="5", BENCH_REQUESTS="8", BENCH_GEN_TOKENS="8",
+               BENCH_SLOTS="4", BENCH_GPT="tiny")
+    try:
+        proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                              capture_output=True, text=True, timeout=1200)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (serve-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and "metric" in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (serve-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    gen = rec.get("observe", {}).get("generate", {})
+    p99 = gen.get("decode_p99_ms")
+    ok = ((rec.get("value") or 0) > 0
+          and (rec.get("generated_tokens") or 0) > 0
+          and isinstance(p99, (int, float)) and p99 == p99)
+    print(f"   {'ok' if ok else 'FAIL'} (serve-smoke: "
+          f"{rec.get('generated_tokens')} tokens at "
+          f"{rec.get('value')} tok/s, decode p99 {p99} ms)")
+    return ok
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -250,6 +288,7 @@ def main() -> int:
                   "smoke SKIPPED (do not snapshot a chip-affecting change "
                   "from this state) ==")
         results["obs"] = obs_stage()
+        results["serve"] = serve_stage()
         results["multichip"] = multichip_stage()
 
     failed = [k for k, v in results.items() if not v]
