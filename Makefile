@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -58,8 +58,19 @@ bench:
 # graph-compile metric (docs/OPTIMIZER.md): trace+XLA-compile speedup from
 # the pre-trace SameDiff optimizer, CPU-pinned (pure compile-time
 # measurement — no device loop), one gate-friendly JSON line on stdout.
+# Also asserts the fusion tier: a 2-layer imported BERT must report >= 1
+# attention fusion, so a matcher regression fails this target.
 bench-compile:
 	JAX_PLATFORMS=cpu BENCH_MODEL=graph_compile BENCH_RECORD=0 python bench.py
+
+# imported-BERT forward throughput, fusion on vs off (docs/OPTIMIZER.md
+# § Fusion tier): one JSON line with tokens/sec + fused_attention_count/
+# fused_epilogue_count. Smoke-sized here; unpinned `BENCH_MODEL=bert_import
+# python bench.py` measures the real chip.
+bench-import:
+	JAX_PLATFORMS=cpu BENCH_MODEL=bert_import BENCH_RECORD=0 \
+	BENCH_ITERS=3 BENCH_IMPORT_LAYERS=2 BENCH_SEQ=16 BENCH_IMPORT_D=128 \
+	BENCH_IMPORT_HEADS=2 BENCH_IMPORT_FF=256 python bench.py
 
 native:
 	cmake -S native -B native/build && cmake --build native/build -j

@@ -320,6 +320,63 @@ def _bench_generate(qps: float, n_requests: int, gen_tokens: int,
     return n_tokens / t_total, "generate_open_loop_tokens_per_sec", extra
 
 
+def _bench_bert_import(layers: int, seq: int, d: int, heads: int, ff: int,
+                       iters: int):
+    """Imported-BERT forward throughput (BENCH_MODEL=bert_import): the
+    SAME ONNX bytes imported twice — fusion off vs on (docs/OPTIMIZER.md
+    § Fusion tier) — timed end-to-end on repeated forward passes. Value =
+    tokens/sec WITH fusion; the JSON line carries the unfused rate, the
+    speedup, and the fused_attention_count/fused_epilogue_count hit
+    counters from OptimizeStats, so the import-path fast-kernel routing is
+    a number, not a claim. CPU-smoke sized under the subprocess-probe
+    fallback."""
+    from deeplearning4j_tpu.imports.onnx_import import import_onnx
+    from deeplearning4j_tpu.testing.onnx_builder import bert_onnx_model
+
+    batch = 1
+    model = bert_onnx_model(layers=layers, batch=batch, seq=seq, d=d,
+                            heads=heads, ff=ff)
+    r = np.random.RandomState(1)
+    feeds = {"ids": r.randint(0, 512, (batch, seq)).astype(np.float32),
+             "mask": (r.rand(batch, seq) > 0.1).astype(np.float32)}
+
+    def run(sd):
+        sd.output(feeds, ["y"])  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = sd.output(feeds, ["y"])["y"]
+        dt = time.perf_counter() - t0
+        assert np.isfinite(out).all()
+        return batch * seq * iters / dt
+
+    # pin BOTH legs explicitly — an ambient DL4J_TPU_FUSION=0 (the
+    # documented opt-out) must not silently turn the "fused" leg into a
+    # second unfused measurement (and a false regression assert)
+    prev = os.environ.get("DL4J_TPU_FUSION")
+    try:
+        os.environ["DL4J_TPU_FUSION"] = "0"
+        unfused_tps = run(import_onnx(model))
+        os.environ["DL4J_TPU_FUSION"] = "1"
+        sd = import_onnx(model)
+        fused_tps = run(sd)
+    finally:
+        if prev is None:
+            os.environ.pop("DL4J_TPU_FUSION", None)
+        else:
+            os.environ["DL4J_TPU_FUSION"] = prev
+    st = sd.last_compile_stats
+    att = st.fusions.get("attention", 0)
+    epi = st.fusions.get("epilogue", 0)
+    assert att >= layers, (
+        f"attention fusion regressed: {att} < {layers} blocks matched "
+        f"on a {layers}-layer imported BERT")
+    extra = {"fused_attention_count": att, "fused_epilogue_count": epi,
+             "tokens_per_sec_unfused": round(unfused_tps, 1),
+             "fusion_speedup": round(fused_tps / unfused_tps, 3),
+             "nodes_before": st.nodes_before, "nodes_after": st.nodes_after}
+    return fused_tps, "bert_import_forward_tokens_per_sec", extra
+
+
 def _bench_graph_compile(layers: int, width: int):
     """Graph-compile metric (docs/OPTIMIZER.md, `make bench-compile`): a
     redundant SameDiff graph — per-layer duplicated subexpressions, foldable
@@ -366,10 +423,37 @@ def _bench_graph_compile(layers: int, width: int):
         wall[mode] = time.perf_counter() - t0
         stats[mode] = sd.last_compile_stats
     np.testing.assert_allclose(outs[False], outs[True], rtol=1e-5, atol=1e-5)
+
+    # fusion gate (docs/OPTIMIZER.md § Fusion tier): a mini imported BERT
+    # must report attention fusions — a matcher regression fails
+    # `make bench-compile` (a gate-adjacent target), not just the separate
+    # BENCH_MODEL=bert_import benchmark
+    from deeplearning4j_tpu.imports.onnx_import import import_onnx
+    from deeplearning4j_tpu.testing.onnx_builder import bert_onnx_model
+
+    prev = os.environ.get("DL4J_TPU_FUSION")
+    os.environ["DL4J_TPU_FUSION"] = "1"  # the gate must test the matcher
+    try:                                 # even under an ambient opt-out
+        mini = import_onnx(bert_onnx_model(layers=2, seq=8, d=64, heads=2,
+                                           ff=128, vocab=64))
+        r = np.random.RandomState(2)
+        mini.output({"ids": r.randint(0, 64, (1, 8)).astype(np.float32),
+                     "mask": np.ones((1, 8), np.float32)}, ["y"])
+    finally:
+        if prev is None:
+            os.environ.pop("DL4J_TPU_FUSION", None)
+        else:
+            os.environ["DL4J_TPU_FUSION"] = prev
+    att = mini.last_compile_stats.fusions.get("attention", 0)
+    assert att >= 1, (
+        f"fusion regression: imported 2-layer BERT reports {att} attention "
+        f"fusions (expected >= 1)")
+
     extra = {"nodes_before": stats[True].nodes_before,
              "nodes_after": stats[True].nodes_after,
              "compile_s_unoptimized": round(wall[False], 3),
-             "compile_s_optimized": round(wall[True], 3)}
+             "compile_s_optimized": round(wall[True], 3),
+             "fused_attention_count": att}
     return wall[False] / wall[True], "graph_compile_optimizer_speedup", extra
 
 
@@ -418,6 +502,7 @@ _UNITS = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
           "bert_base_mlm_train_tokens_per_sec": "tokens/sec/chip",
           "flash_attention_t8192_speedup_vs_generic": "x vs XLA generic",
           "graph_compile_optimizer_speedup": "x trace+compile speedup",
+          "bert_import_forward_tokens_per_sec": "tokens/sec",
           "serving_fixed_qps_req_per_sec": "req/sec",
           "generate_open_loop_tokens_per_sec": "tokens/sec"}
 
@@ -426,6 +511,7 @@ _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "bert": "bert_base_mlm_train_tokens_per_sec",
                  "attention": "flash_attention_t8192_speedup_vs_generic",
                  "graph_compile": "graph_compile_optimizer_speedup",
+                 "bert_import": "bert_import_forward_tokens_per_sec",
                  "serving": "serving_fixed_qps_req_per_sec",
                  "generate": "generate_open_loop_tokens_per_sec"}
 
@@ -468,6 +554,19 @@ def main() -> None:
             width = int(os.environ.get("BENCH_GRAPH_WIDTH", "192"))
             value, metric, extra = _bench_graph_compile(layers, width)
             method = f"L{layers}w{width}"
+        elif model == "bert_import":
+            bl = int(os.environ.get("BENCH_IMPORT_LAYERS",
+                                    "2" if smoke else "12"))
+            seq = int(os.environ.get("BENCH_SEQ", "16" if smoke else "128"))
+            bd = int(os.environ.get("BENCH_IMPORT_D",
+                                    "128" if smoke else "768"))
+            bh = int(os.environ.get("BENCH_IMPORT_HEADS",
+                                    "2" if smoke else "12"))
+            bff = int(os.environ.get("BENCH_IMPORT_FF",
+                                     "256" if smoke else "3072"))
+            value, metric, extra = _bench_bert_import(bl, seq, bd, bh, bff,
+                                                      iters)
+            method = f"L{bl}s{seq}d{bd}i{iters}"
         elif model == "serving":
             qps = float(os.environ.get("BENCH_QPS", "25" if smoke else "200"))
             nreq = int(os.environ.get("BENCH_REQUESTS",

@@ -110,6 +110,46 @@ def bert_encoder_sym_batch(layers: int = 2, seq: int = 128, d: int = 64,
     return sd
 
 
+def fused_graph_sym_batch(seq: int = 32, d: int = 64, heads: int = 4,
+                          page: int = 8) -> SameDiff:
+    """A graph built on the optimizer's fusion-target registry ops —
+    ``dot_product_attention`` (incl. ``causal=``), ``fused_matmul_bias_act``
+    and ``paged_decode_attention`` — with a named symbolic batch dim. The
+    gate's ``check`` stage verifying this with ZERO findings proves the
+    first-class analysis rules cover fused graphs natively: the
+    ``jax.eval_shape`` probe cannot run over symbolic dims, so any rule
+    regression surfaces as GC006 opacity or a phantom error here."""
+    r = np.random.RandomState(5)
+    hd = d // heads
+    sd = SameDiff()
+    q = sd.placeholder("q", shape=(None, heads, seq, hd))
+    k = sd.placeholder("k", shape=(None, heads, seq, hd))
+    v = sd.placeholder("v", shape=(None, heads, seq, hd))
+    mask = sd.placeholder("mask", shape=(None, 1, 1, seq))
+    att = sd.op("dot_product_attention", q, k, v, mask, scaled=True)
+    catt = sd.op("dot_product_attention", q, k, v, scaled=True, causal=True)
+    x = sd.placeholder("x", shape=(None, d))
+    w1 = sd.var("w1", (r.randn(d, d) * 0.05).astype(np.float32))
+    b1 = sd.var("b1", np.zeros(d, np.float32))
+    h = sd.op("fused_matmul_bias_act", x, w1, b1, activation="gelu_exact")
+    h.rename("h")
+    att.rename("att")
+    catt.rename("causal_att")
+    # decode tier: one query token per slot against a block-paged KV cache
+    dq = sd.placeholder("dq", shape=(None, heads, hd))
+    kp = sd.var("k_pages", (r.randn(6, page, heads, hd) * 0.1)
+                .astype(np.float32))
+    vp = sd.var("v_pages", (r.randn(6, page, heads, hd) * 0.1)
+                .astype(np.float32))
+    pt = sd.placeholder("page_table", shape=(None, 3), dtype=np.int32)
+    sl = sd.placeholder("seq_lens", shape=(None,), dtype=np.int32)
+    sd.op("paged_decode_attention", dq, kp, vp, pt, sl).rename("decoded")
+    sd.graph_inputs = ["q", "k", "v", "mask", "x", "dq", "page_table",
+                       "seq_lens"]
+    sd.graph_outputs = ["att", "causal_att", "h", "decoded"]
+    return sd
+
+
 def shape_chain() -> SameDiff:
     """numpy-static shape arithmetic: shape_of → unstack → stack →
     reshape_dynamic — the constant-env surface."""
@@ -173,6 +213,7 @@ def clean_fixtures() -> List[Tuple[str, Any]]:
         ("zoo/mlp_sym_batch", mlp_sym_batch()),
         ("zoo/cnn_sym_batch", cnn_sym_batch()),
         ("zoo/bert_encoder_sym_batch", bert_encoder_sym_batch()),
+        ("zoo/fused_graph_sym_batch", fused_graph_sym_batch()),
         ("zoo/shape_chain", shape_chain()),
         ("onnx/mini_mlp", onnx_mini_import()),
     ]

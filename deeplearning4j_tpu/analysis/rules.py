@@ -774,6 +774,117 @@ def _sparse_loss(node, ins, emit):
 
 
 # ---------------------------------------------------------------------------
+# fused attention / matmul registry ops (the optimizer's fusion targets —
+# docs/OPTIMIZER.md § Fusion tier). First-class rules so the pass
+# invariant checker verifies fused graphs natively (symbolic batch dims
+# included) instead of through the concrete-only jax.eval_shape probe.
+# ---------------------------------------------------------------------------
+
+
+@op_rule("dot_product_attention")
+def _dot_product_attention(node, ins, emit):
+    q, k, v = ins[0], ins[1], ins[2]
+    # scores promote q with k (f32 weights after softmax), the output then
+    # promotes with v — k participates in the result dtype
+    dt = _float_result(promote_dtypes([q.dtype, k.dtype, v.dtype]))
+    for name, a in (("q", q), ("k", k), ("v", v)):
+        if a.rank is not None and a.rank < 2:
+            emit("GC001", f"'dot_product_attention': {name} must be rank "
+                          f">= 2 ([..., L, D]), got {fmt_shape(a.shape)}")
+            return [AVal(None, dt)]
+    if q.shape is not None and k.shape is not None and \
+            dims_provably_unequal(q.shape[-1], k.shape[-1]):
+        emit("GC002", f"'dot_product_attention': q/k head dims differ — "
+                      f"{q.shape[-1]} vs {k.shape[-1]} "
+                      f"({fmt_shape(q.shape)} vs {fmt_shape(k.shape)})")
+        return [AVal(None, dt)]
+    if k.shape is not None and v.shape is not None and \
+            dims_provably_unequal(k.shape[-2], v.shape[-2]):
+        emit("GC002", f"'dot_product_attention': k/v sequence lengths "
+                      f"differ — {k.shape[-2]} vs {v.shape[-2]} "
+                      f"({fmt_shape(k.shape)} vs {fmt_shape(v.shape)})")
+        return [AVal(None, dt)]
+    # `causal=` needs no extra shape constraint: the generic op's
+    # end-aligned tril is defined for any (Lq, Lk) pair; the flash helper's
+    # t_q == t_kv restriction is a dispatch gate, not a graph invariant
+    if q.shape is None or v.shape is None:
+        return [AVal(None, dt)]
+    if len(ins) > 3 and ins[3].shape is not None and k.shape is not None:
+        m = ins[3]
+        if len(m.shape) == 0:
+            emit("GC001", "'dot_product_attention': mask is 0-d — expected "
+                          "a key mask broadcastable over [..., Lq, Lkv]")
+        elif isinstance(m.shape[-1], int) and m.shape[-1] != 1 and \
+                dims_provably_unequal(m.shape[-1], k.shape[-2]):
+            emit("GC002", f"'dot_product_attention': mask trailing dim "
+                          f"{m.shape[-1]} matches neither 1 nor the kv "
+                          f"length {k.shape[-2]}")
+    return [AVal(q.shape[:-1] + (v.shape[-1],), dt)]
+
+
+@op_rule("paged_decode_attention")
+def _paged_decode_attention(node, ins, emit):
+    q, kp, vp, pt, sl = ins[0], ins[1], ins[2], ins[3], ins[4]
+    dt = q.dtype  # impl casts the f32 accumulator back to q's dtype
+    want_ranks = (("q", q, 3), ("k_pages", kp, 4), ("v_pages", vp, 4),
+                  ("page_table", pt, 2), ("seq_lens", sl, 1))
+    for name, a, want in want_ranks:
+        if a.rank is not None and a.rank != want:
+            emit("GC001", f"'paged_decode_attention': {name} must be rank "
+                          f"{want}, got {fmt_shape(a.shape)}")
+            return [AVal(None, dt)]
+    if q.shape is not None and kp.shape is not None:
+        for axis_q, axis_p, what in ((1, 2, "heads"), (2, 3, "head dim")):
+            if dims_provably_unequal(q.shape[axis_q], kp.shape[axis_p]):
+                emit("GC002", f"'paged_decode_attention': {what} differ — "
+                              f"q {fmt_shape(q.shape)} vs k_pages "
+                              f"{fmt_shape(kp.shape)}")
+                return [AVal(None, dt)]
+    if q.shape is not None and pt.shape is not None and \
+            dims_provably_unequal(q.shape[0], pt.shape[0]):
+        emit("GC002", f"'paged_decode_attention': slot counts differ — "
+                      f"q {fmt_shape(q.shape)} vs page_table "
+                      f"{fmt_shape(pt.shape)}")
+        return [AVal(None, dt)]
+    if pt.dtype is not None and not np.issubdtype(pt.dtype, np.integer):
+        emit("GC003", f"'paged_decode_attention': page_table dtype "
+                      f"{pt.dtype} is not integral")
+    return [AVal(q.shape, q.dtype)]
+
+
+@op_rule("fused_matmul_bias_act")
+def _fused_matmul_bias_act(node, ins, emit):
+    from deeplearning4j_tpu.ops.nn_ops import FUSED_MATMUL_ACTIVATIONS
+
+    x, w = ins[0], ins[1]
+    act = node.kwargs.get("activation", "none")
+    if act not in FUSED_MATMUL_ACTIVATIONS:
+        emit("GC001", f"'fused_matmul_bias_act': unknown activation "
+                      f"'{act}'; valid: {list(FUSED_MATMUL_ACTIVATIONS)}")
+    a, b = x.shape, w.shape
+    if node.kwargs.get("transpose_a"):
+        a = _swap_last2(a, emit, "'fused_matmul_bias_act'")
+    if node.kwargs.get("transpose_b"):
+        b = _swap_last2(b, emit, "'fused_matmul_bias_act'")
+    _maybe_promo_warn(ins[:2], emit)
+    shape = _matmul_shape(a, b, emit, "'fused_matmul_bias_act'")
+    dt = promote_dtypes([x.dtype, w.dtype])
+    if len(ins) > 2 and shape is not None and ins[2].shape is not None:
+        try:
+            shape = broadcast_shapes([shape, ins[2].shape])
+        except BroadcastError as e:
+            emit("GC002", f"'fused_matmul_bias_act': bias "
+                          f"{fmt_shape(ins[2].shape)} does not broadcast "
+                          f"onto {fmt_shape(shape)} ({e.detail})")
+            shape = None
+    if len(ins) > 2:
+        dt = promote_dtypes([dt, ins[2].dtype])
+    if act in ("tanh", "gelu", "gelu_exact"):
+        dt = _float_result(dt)  # these activations produce floats; "none"
+    return [AVal(shape, dt)]    # and "relu" keep integer inputs integral
+
+
+# ---------------------------------------------------------------------------
 # conv / pool (NHWC, matching ops/nn_ops.py)
 # ---------------------------------------------------------------------------
 

@@ -27,6 +27,15 @@ Passes (each independently sound; pipeline loops to a fixpoint):
                reshape-to-same-shape, and x*1 / x+0 / x-0 / x/1 / x**1 strips
                (only when the surviving operand's dtype provably absorbs the
                promotion — see ``_infer_dtypes``).
+``fusion``     rewrite imported subgraphs onto registry fast paths:
+               matmul→scale→(+mask)→softmax→matmul becomes ONE
+               ``dot_product_attention`` node (the Pallas flash dispatch
+               applies), matmul+bias(+activation) becomes
+               ``fused_matmul_bias_act``. Opt-out: ``DL4J_TPU_FUSION=0``.
+``autocast``   OPT-IN (``DL4J_TPU_AUTOCAST=bf16`` or an explicit
+               ``passes=`` entry): bf16 inputs for matmul/conv-class nodes
+               with an f32 interface (cast back at the node output);
+               softmax/layernorm/losses stay f32.
 
 The result is a :class:`GraphPlan` — an optimized node list, extra folded
 constants, and an alias map — which ``SameDiff._interpret`` executes instead
@@ -48,7 +57,56 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-PASS_ORDER: Tuple[str, ...] = ("dce", "fold", "cse", "algebraic")
+PASS_ORDER: Tuple[str, ...] = ("dce", "fold", "cse", "algebraic", "fusion")
+
+# opt-in passes: valid names for `passes=`, but never part of the default
+# pipeline. "autocast" (bf16 matmul inputs / f32 interface) changes VALUES
+# within bf16 tolerance, so it must be asked for — via passes= or
+# DL4J_TPU_AUTOCAST=bf16.
+OPTIONAL_PASSES: Tuple[str, ...] = ("autocast",)
+
+
+_ENV_ON = ("1", "on", "true", "yes")
+_ENV_OFF = ("", "0", "off", "false", "none", "no")
+_AUTOCAST_ON = _ENV_ON + ("bf16", "bfloat16")
+_AUTOCAST_OFF = _ENV_OFF + ("f32", "float32")
+
+# warn-once guards: default_passes() runs on EVERY cache-key computation
+# (_effective_passes per output/grad/train step) — an env typo must log a
+# single line, not one per step
+_WARNED_ENVS: set = set()
+
+
+def _env_warn_once(var: str, val: str, on_values) -> None:
+    import logging
+
+    if (var, val) not in _WARNED_ENVS:
+        _WARNED_ENVS.add((var, val))
+        logging.getLogger(__name__).warning(
+            "%s=%r not recognized (on: %s); using the default", var, val,
+            "/".join(v for v in on_values if v))
+
+
+def default_passes() -> Tuple[str, ...]:
+    """The pipeline the env asks for: PASS_ORDER, minus fusion under
+    DL4J_TPU_FUSION=0/off/false, plus autocast under
+    DL4J_TPU_AUTOCAST=bf16. Unrecognized values keep the default and log
+    one warning — a silent env typo (fp16, ofF) would otherwise be
+    invisible forever (the cache key matches the default plan)."""
+    import os
+
+    enabled = [p for p in PASS_ORDER]
+    fu = os.environ.get("DL4J_TPU_FUSION", "1").strip().lower() or "1"
+    if fu in _ENV_OFF:
+        enabled.remove("fusion")
+    elif fu not in _ENV_ON:
+        _env_warn_once("DL4J_TPU_FUSION", fu, _ENV_OFF)
+    ac = os.environ.get("DL4J_TPU_AUTOCAST", "").strip().lower()
+    if ac in _AUTOCAST_ON:
+        enabled.append("autocast")
+    elif ac not in _AUTOCAST_OFF:
+        _env_warn_once("DL4J_TPU_AUTOCAST", ac, _AUTOCAST_ON)
+    return tuple(enabled)
 
 # folded outputs larger than this (elements) stay in the graph: XLA would
 # bake them anyway, but materializing giants at plan time trades trace
@@ -74,6 +132,12 @@ class OptimizeStats:
     # graftcheck pass-invariance runs (docs/ANALYSIS.md): how many times
     # the interface shapes/dtypes were re-verified between passes
     invariant_checks: int = 0
+    # fusion-tier hit counts: {"attention": n, "epilogue": n,
+    # "autocast_casts": n} — docs/OPTIMIZER.md § Fusion tier
+    fusions: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def record_fusion(self, kind: str, n: int = 1) -> None:
+        self.fusions[kind] = self.fusions.get(kind, 0) + n
 
     def record_pass(self, name: str, before: int, after: int) -> None:
         entry = self.passes.setdefault(
@@ -93,7 +157,8 @@ class OptimizeStats:
                 "optimize_seconds": round(self.optimize_seconds, 4),
                 "trace_seconds": self.trace_seconds,
                 "compile_seconds": self.compile_seconds,
-                "invariant_checks": self.invariant_checks}
+                "invariant_checks": self.invariant_checks,
+                "fusions": dict(self.fusions)}
 
 
 class GraphPlan:
@@ -411,6 +476,695 @@ def _algebraic(nodes, const_vals, var_shapes, seed_dtypes,
 
 
 # ---------------------------------------------------------------------------
+# fusion (docs/OPTIMIZER.md § Fusion tier)
+#
+# Pattern-match imported subgraphs onto registry fast paths:
+#   * attention: matmul → scale → (+additive mask) → softmax → matmul
+#     becomes ONE `dot_product_attention` node, so the shape-aware Pallas
+#     flash dispatch (ops/pallas_attention.py, PR 7) applies to imported
+#     ONNX/TF graphs — which otherwise execute the verbatim softmax(QKᵀ)V
+#     chain forever (ROADMAP item 3).
+#   * epilogue: matmul + bias (+ relu/tanh/gelu or the decomposed erf-gelu
+#     chain exporters emit) becomes `fused_matmul_bias_act` (Pallas fused
+#     epilogue on TPU, exact same op chain via XLA elsewhere).
+#
+# Soundness: a rewrite only fires when the shape/dtype evidence (from the
+# graftcheck abstract interpreter over bound arrays, placeholder decls and
+# the const env) proves the pattern — scale value matches 1/sqrt(head_dim),
+# softmax normalizes the last axis, the mask chain is the standard
+# (1 - mask) * -big penalty, and every interior tensor is consumed only
+# inside the pattern. Anything else is left verbatim; the per-pass
+# invariant checker then re-verifies the fused graph via the first-class
+# analysis rules for the fused ops.
+# ---------------------------------------------------------------------------
+
+_FUSION_PASSTHROUGH = frozenset(["identity", "dropout_graph"])
+
+# epilogue activations matched as a single node (op name -> activation kwarg)
+_EPILOGUE_ACTS = {"relu": "relu", "tanh": "tanh", "gelu": "gelu"}
+
+_SQRT2 = float(np.sqrt(np.float32(2.0)))
+
+
+class _Namer:
+    """Fresh names for synthesized nodes, collision-checked per pipeline."""
+
+    def __init__(self, taken):
+        self._taken = taken
+        self._n = 0
+
+    def fresh(self, tag: str) -> str:
+        while True:
+            self._n += 1
+            name = f"__opt_{tag}_{self._n}"
+            if name not in self._taken:
+                self._taken.add(name)
+                return name
+
+
+def _abstract_avals(nodes, const_vals, var_shapes, seed_dtypes, input_avals,
+                    local_ops):
+    """Shape/dtype evidence for the fusion/autocast matchers — the same
+    seeding the invariant checker uses, walked once over the current list."""
+    from deeplearning4j_tpu import analysis as _an
+
+    avals: Dict[str, Any] = {}
+    for n, s in (var_shapes or {}).items():
+        avals[n] = _an.AVal(shape=tuple(s), dtype=(seed_dtypes or {}).get(n))
+    for n, dt in (seed_dtypes or {}).items():
+        if n not in avals:
+            avals[n] = _an.AVal(dtype=dt)
+    for n, a in (input_avals or {}).items():
+        avals.setdefault(n, a)
+    for n, v in const_vals.items():
+        avals[n] = _an.AVal.of_array(v, keep_value=np.size(v) <= 4096)
+    _an.infer_nodes(list(enumerate(nodes)), avals, local_ops,
+                    graph_name="<fusion>", findings=[])
+    return avals
+
+
+def _close(a: float, b: float, rtol: float = 1e-5) -> bool:
+    return abs(a - b) <= rtol * max(abs(a), abs(b), 1e-30)
+
+
+def _identity_perm(perm) -> bool:
+    return tuple(perm) == tuple(range(len(perm)))
+
+
+def _norm_perm(axes, rank):
+    if axes is None:
+        return tuple(reversed(range(rank)))
+    return tuple(int(a) % rank for a in axes)
+
+
+class _GraphView:
+    """Shared lookup state for one fusion-pass application."""
+
+    def __init__(self, nodes, outputs, alias, const_vals, avals,
+                 local_ops=None):
+        self.nodes = nodes
+        self.const_vals = const_vals
+        self.avals = avals
+        self.local_ops = local_ops or {}
+        self.producer: Dict[str, Tuple[int, Any]] = {}
+        self.consumers: Dict[str, int] = {}
+        # name -> [(idx, node), ...] distinct consumer NODES, in order
+        self._consumer_nodes: Dict[str, List[Tuple[int, Any]]] = {}
+        for idx, n in enumerate(nodes):
+            for o in n.outputs:
+                self.producer[o] = (idx, n)
+            for i in n.inputs:
+                self.consumers[i] = self.consumers.get(i, 0) + 1
+                lst = self._consumer_nodes.setdefault(i, [])
+                if not lst or lst[-1][0] != idx:
+                    lst.append((idx, n))
+        self.external = {_resolve(alias, o) for o in outputs}
+
+    def interior(self, name: str) -> bool:
+        """name is consumed exactly once and is not a requested output —
+        the precondition for removing its producer."""
+        return self.consumers.get(name, 0) == 1 and name not in self.external
+
+    def single_consumer(self, name: str):
+        """(idx, node) of the unique consumer of name, or None."""
+        if not self.interior(name):
+            return None
+        lst = self._consumer_nodes.get(name)
+        return lst[0] if lst else None
+
+    def consumer_nodes(self, name: str):
+        """All distinct consumer (idx, node) pairs of name, in order."""
+        return self._consumer_nodes.get(name, [])
+
+    def is_op(self, node, *names) -> bool:
+        """node matches one of the CATALOG ops ``names`` — an
+        instance-local op shadowing a catalog name (resolution order is
+        local-first) has arbitrary semantics and must never pattern-match."""
+        return node.op in names and node.op not in self.local_ops
+
+    def scalar(self, name: str):
+        """(value, dtype) for any SIZE-1 constant — unlike the algebraic
+        strips' 0-d-only ``_scalar_const``, rank does not matter here: the
+        fusion rewrite removes the whole chain, so a (1,)-shaped ONNX
+        scalar (the wire format's usual encoding) is as good as a 0-d."""
+        v = self.const_vals.get(name)
+        if v is None:
+            return None
+        arr = np.asarray(v)
+        if arr.size != 1:
+            return None
+        try:
+            return float(arr.reshape(())), arr.dtype
+        except (TypeError, ValueError):
+            return None
+
+    def aval(self, name: str):
+        return self.avals.get(name)
+
+
+def _match_mask_penalty(gv: _GraphView, name: str):
+    """Recognize the additive attention-mask penalty chains importers emit.
+
+    Returns ``("tensor", mask_name, expand_axes)`` for the standard
+    ``(1 - mask) * -big`` key-padding chain (``expand_axes``: expand_dims
+    axes applied AFTER the mul, to mirror onto the mask),
+    ``("causal", None, None)`` for a constant lower-triangular 0/-big
+    matrix, or None."""
+    # constant additive mask: causal tril pattern (decoder imports)
+    v = gv.const_vals.get(name)
+    if v is not None:
+        arr = np.asarray(v)
+        sq = arr.reshape(arr.shape[-2:]) if arr.ndim > 2 and \
+            all(d == 1 for d in arr.shape[:-2]) else arr
+        if sq.ndim == 2 and sq.shape[0] == sq.shape[1] and sq.shape[0] > 1:
+            tril = np.tril(np.ones(sq.shape, bool))
+            if np.all(sq[tril] == 0.0) and np.all(sq[~tril] <= -1e3):
+                return ("causal", None, None)
+        return None
+    expand_axes = []
+    prod = gv.producer.get(name)
+    while prod is not None and gv.is_op(prod[1], "expand_dims"):
+        expand_axes.append(prod[1].kwargs.get("axis", 0))
+        name = prod[1].inputs[0]
+        prod = gv.producer.get(name)
+    if prod is None or not gv.is_op(prod[1], "mul") \
+            or len(prod[1].inputs) != 2:
+        return None
+    mul = prod[1]
+    for pos in (0, 1):
+        sc = gv.scalar(mul.inputs[pos])
+        if sc is None or sc[0] > -1e3:
+            continue
+        inv = gv.producer.get(mul.inputs[1 - pos])
+        if inv is None or not gv.is_op(inv[1], "sub") \
+                or len(inv[1].inputs) != 2:
+            continue
+        one = gv.scalar(inv[1].inputs[0])
+        if one is None or one[0] != 1.0:
+            continue
+        mask_name = inv[1].inputs[1]
+        a = gv.aval(mask_name)
+        # mask contract: a float/bool BINARY attend mask. The matched
+        # (1 - mask) * -big chain is the exporters' encoding of a 0/1
+        # key-padding mask; the rewrite turns it into the fused op's
+        # where-style mask operand, which agrees with the additive penalty
+        # exactly for 0/1 values (ONNX Runtime's attention fuser makes the
+        # same binary-mask assumption). Fractional masks are outside the
+        # pattern: provably-non-binary CONSTANT masks are rejected here,
+        # runtime-fed masks are 0/1 by the documented contract
+        # (docs/OPTIMIZER.md § Fusion tier; opt-out DL4J_TPU_FUSION=0).
+        # Unknown or integral dtypes are a pattern miss — leave verbatim.
+        if a is None or a.dtype is None:
+            return None
+        if not (np.issubdtype(a.dtype, np.floating)
+                or a.dtype == np.dtype(bool)):
+            return None
+        mv = gv.const_vals.get(mask_name)
+        if mv is not None:
+            arr = np.asarray(mv)
+            if not np.all((arr == 0) | (arr == 1)):
+                return None
+        return ("tensor", mask_name, list(reversed(expand_axes)))
+    return None
+
+
+def _peel_transposed_k(gv: _GraphView, kt_name: str, namer: _Namer):
+    """scores = q @ B requires B = kᵀ (last two axes swapped). Recover k:
+    if B is a transpose node, compose its perm with a last-two swap — the
+    result is either the transpose's own input (plain kᵀ) or one
+    synthesized transpose (the composed head-split form the algebraic pass
+    produces). Returns (k_name, synth_node_or_None, kt_idx_or_None,
+    k_shape) or None."""
+    prod = gv.producer.get(kt_name)
+    if prod is None or not gv.is_op(prod[1], "transpose") \
+            or len(prod[1].inputs) != 1:
+        return None
+    kt_idx, kt = prod
+    axes = kt.kwargs.get("axes")
+    src_aval = gv.aval(kt.inputs[0])
+    rank = len(axes) if axes is not None else \
+        (src_aval.rank if src_aval is not None else None)
+    if rank is None or rank < 2:
+        return None
+    perm = _norm_perm(axes, rank)
+    k_perm = perm[:-2] + (perm[-1], perm[-2])
+    src_shape = src_aval.shape if src_aval is not None else None
+    k_shape = (tuple(src_shape[p] for p in k_perm)
+               if src_shape is not None and len(src_shape) == rank else None)
+    if _identity_perm(k_perm):
+        return kt.inputs[0], None, kt_idx, k_shape
+    synth = _Node_like(kt, "transpose", [kt.inputs[0]], {"axes": k_perm},
+                       [namer.fresh("k")])
+    return synth.outputs[0], synth, kt_idx, k_shape
+
+
+def _Node_like(template, op, inputs, kwargs, outputs):
+    return type(template)(op, list(inputs), dict(kwargs), list(outputs))
+
+
+def _try_attention(gv: _GraphView, ctx_idx: int, ctx, namer: _Namer):
+    """Match one attention block ending at ``ctx = mmul(probs, v)``.
+
+    Returns ``(removed_idxs, synth_nodes, fused_node, mask_pending)`` or
+    None. ``mask_pending`` is None or ``(mask_name, expand_axes)``: a
+    tensor mask the CALLER appends to the fused node's inputs — after the
+    claim check accepts the match — synthesizing (and caching) any
+    expand_dims mirror chain only for matches that actually apply."""
+    if not gv.is_op(ctx, "mmul") or len(ctx.inputs) != 2 or \
+            ctx.kwargs.get("transpose_a") or ctx.kwargs.get("transpose_b"):
+        return None
+    removed = {ctx_idx}
+    synth: List[Any] = []
+
+    # probs side: optional identity/dropout passthroughs over the softmax
+    p_name, v_name = ctx.inputs
+    while True:
+        prod = gv.producer.get(p_name)
+        if prod is None:
+            return None
+        if gv.is_op(prod[1], *_FUSION_PASSTHROUGH) \
+                and len(prod[1].outputs) == 1:
+            if not gv.interior(prod[1].outputs[0]):
+                return None
+            removed.add(prod[0])
+            p_name = prod[1].inputs[0]
+            continue
+        break
+    sm_idx, sm = prod
+    if not gv.is_op(sm, "softmax") or not gv.interior(sm.outputs[0]):
+        return None
+    axis = int(sm.kwargs.get("axis", -1))
+    sm_aval = gv.aval(sm.inputs[0])
+    rank = sm_aval.rank if sm_aval is not None else None
+    if axis != -1 and (rank is None or axis != rank - 1):
+        return None
+    removed.add(sm_idx)
+
+    # optional additive mask
+    s_name = sm.inputs[0]
+    prod = gv.producer.get(s_name)
+    if prod is None:
+        return None
+    mask = None
+    if gv.is_op(prod[1], "add") and len(prod[1].inputs) == 2:
+        if not gv.interior(prod[1].outputs[0]):
+            return None
+        for pos in (0, 1):
+            mask = _match_mask_penalty(gv, prod[1].inputs[pos])
+            if mask is not None:
+                removed.add(prod[0])
+                s_name = prod[1].inputs[1 - pos]
+                prod = gv.producer.get(s_name)
+                break
+        if mask is None:
+            return None  # an add that is not a recognized mask penalty
+        if prod is None:
+            return None
+
+    # optional scale on the scores: (kind, value, const name). The NAME is
+    # kept because the rewrite re-applies the ORIGINAL constant to q (see
+    # below) — never a freshly computed sqrt.
+    scale = None
+    if gv.is_op(prod[1], "div") and len(prod[1].inputs) == 2:
+        sc = gv.scalar(prod[1].inputs[1])
+        if sc is not None:
+            if not gv.interior(prod[1].outputs[0]):
+                return None
+            scale = ("div", sc[0], prod[1].inputs[1])
+            removed.add(prod[0])
+            s_name = prod[1].inputs[0]
+            prod = gv.producer.get(s_name)
+    elif gv.is_op(prod[1], "mul") and len(prod[1].inputs) == 2:
+        for pos in (0, 1):
+            sc = gv.scalar(prod[1].inputs[pos])
+            if sc is not None:
+                if not gv.interior(prod[1].outputs[0]):
+                    return None
+                scale = ("mul", sc[0], prod[1].inputs[pos])
+                removed.add(prod[0])
+                s_name = prod[1].inputs[1 - pos]
+                prod = gv.producer.get(s_name)
+                break
+    if prod is None:
+        return None
+
+    scores_idx, scores = prod
+    if not gv.is_op(scores, "mmul") or len(scores.inputs) != 2 or \
+            scores.kwargs.get("transpose_a") or \
+            not gv.interior(scores.outputs[0]):
+        return None
+    removed.add(scores_idx)
+
+    q_name = scores.inputs[0]
+    if scores.kwargs.get("transpose_b"):
+        k_name, k_shape = scores.inputs[1], None
+        ka = gv.aval(k_name)
+        if ka is not None:
+            k_shape = ka.shape
+    else:
+        peeled = _peel_transposed_k(gv, scores.inputs[1], namer)
+        if peeled is None:
+            return None
+        k_name, k_synth, kt_idx, k_shape = peeled
+        if k_synth is not None:
+            synth.append(k_synth)
+        if gv.interior(scores.inputs[1]):
+            removed.add(kt_idx)
+
+    # optional scale on q instead of on the scores: the q-side node is
+    # KEPT as the fused node's q input (already feed-robust — it applies
+    # the original constant to whatever is fed), only value-gated below
+    q_prescaled = False
+    if scale is None:
+        prod_q = gv.producer.get(q_name)
+        if prod_q is not None and gv.is_op(prod_q[1], "div", "mul") and \
+                len(prod_q[1].inputs) == 2:
+            qn, qd = prod_q[1].inputs[0], prod_q[1].inputs[1]
+            sc = gv.scalar(qd)
+            if prod_q[1].op == "mul" and sc is None:
+                sc = gv.scalar(qn)
+            if sc is not None:
+                scale = (prod_q[1].op, sc[0], None)
+                q_prescaled = True
+
+    # ---- shape/value evidence ------------------------------------------
+    qa = gv.aval(q_name)
+    va = gv.aval(v_name)
+    if qa is None or va is None or qa.rank not in (3, 4) or \
+            va.rank != qa.rank:
+        return None
+    dk = qa.shape[-1]
+    if not isinstance(dk, int) or dk <= 0:
+        return None
+    if k_shape is not None and len(k_shape) != qa.rank:
+        return None
+    if k_shape is not None and isinstance(k_shape[-1], int) \
+            and k_shape[-1] != dk:
+        return None
+    if scale is not None:
+        # pattern gate only: "is this the canonical attention scaling" —
+        # the REWRITE never recomputes sqrt(dk) at runtime (dk evidence
+        # may be placeholder-declared, and declarations are not enforced
+        # at feed time), it re-applies the matched constant to q
+        kind, val = scale[0], scale[1]
+        want = float(np.sqrt(np.float32(dk)))
+        ok = _close(val, want) if kind == "div" else _close(val, 1.0 / want)
+        if not ok:
+            return None
+    else:
+        scale = None
+
+    # ---- build the fused node ------------------------------------------
+    # scaled=False always: a matched scores-side scale becomes a
+    # synthesized q-side node reusing the ORIGINAL constant — linearity
+    # makes (q∘c) @ kᵀ ≡ (q @ kᵀ)∘c, and the numerics stay pinned to the
+    # imported graph's own constant under any feed shape
+    if scale is not None and not q_prescaled:
+        pre = _Node_like(ctx, scale[0], [q_name, scale[2]], {},
+                         [namer.fresh("qscale")])
+        synth.append(pre)
+        q_name = pre.outputs[0]
+    inputs = [q_name, k_name, v_name]
+    kwargs: Dict[str, Any] = {"scaled": False}
+    mask_pending = None
+    if mask is not None and mask[0] == "causal":
+        kwargs["causal"] = True
+    elif mask is not None:
+        mask_pending = (mask[1], tuple(mask[2]))
+    fused = _Node_like(ctx, "dot_product_attention", inputs, kwargs,
+                       list(ctx.outputs))
+    return removed, synth, fused, mask_pending
+
+
+def _match_erf_gelu(gv: _GraphView, h_name: str):
+    """Match the decomposed exact-gelu chain exporters emit downstream of a
+    bias add: ``h * 0.5 * (1 + erf(h / sqrt(2)))`` in its canonical node
+    order. Returns (removed_idxs, final_node) or None."""
+    if gv.consumers.get(h_name, 0) != 2 or h_name in gv.external:
+        return None
+    div_entry = None
+    for idx, n in gv.consumer_nodes(h_name):
+        if gv.is_op(n, "div") and n.inputs[0] == h_name:
+            sc = gv.scalar(n.inputs[1])
+            if sc is not None and _close(sc[0], _SQRT2):
+                div_entry = (idx, n)
+        elif gv.is_op(n, "mul"):
+            other = [i for i in n.inputs if i != h_name]
+            sc = gv.scalar(other[0]) if len(other) == 1 else None
+            if sc is not None and _close(sc[0], 1.0 / _SQRT2):
+                div_entry = (idx, n)
+    if div_entry is None:
+        return None
+    removed = {div_entry[0]}
+
+    def step(name, want_op):
+        nxt = gv.single_consumer(name)
+        if nxt is None or not gv.is_op(nxt[1], want_op):
+            return None
+        return nxt
+
+    erf = step(div_entry[1].outputs[0], "erf")
+    if erf is None:
+        return None
+    removed.add(erf[0])
+    add1 = step(erf[1].outputs[0], "add")
+    if add1 is None:
+        return None
+    other = [i for i in add1[1].inputs if i != erf[1].outputs[0]]
+    sc = gv.scalar(other[0]) if len(other) == 1 else None
+    if sc is None or sc[0] != 1.0:
+        return None
+    removed.add(add1[0])
+    mul_h = step(add1[1].outputs[0], "mul")
+    if mul_h is None or h_name not in mul_h[1].inputs:
+        return None
+    removed.add(mul_h[0])
+    half = step(mul_h[1].outputs[0], "mul")
+    if half is None:
+        return None
+    other = [i for i in half[1].inputs if i != mul_h[1].outputs[0]]
+    sc = gv.scalar(other[0]) if len(other) == 1 else None
+    if sc is None or sc[0] != 0.5:
+        return None
+    removed.add(half[0])
+    return removed, half[1]
+
+
+def _try_epilogue(gv: _GraphView, add_idx: int, add):
+    """Match ``act(x @ w + b)`` ending at the bias add (optionally plus an
+    activation node or the decomposed erf-gelu chain).
+
+    Returns ``(removed_idxs, fused_node)`` or None."""
+    if not gv.is_op(add, "add") or len(add.inputs) != 2:
+        return None
+    for pos in (0, 1):
+        prod = gv.producer.get(add.inputs[pos])
+        if prod is None or not gv.is_op(prod[1], "mmul"):
+            continue
+        mm_idx, mm = prod
+        if len(mm.inputs) != 2 or not gv.interior(mm.outputs[0]):
+            continue
+        b_name = add.inputs[1 - pos]
+        ba = gv.aval(b_name)
+        wa = gv.aval(mm.inputs[1])
+        if ba is None or ba.rank != 1 or wa is None or wa.rank != 2:
+            continue
+        kwargs: Dict[str, Any] = {"activation": "none"}
+        if mm.kwargs.get("transpose_a"):
+            kwargs["transpose_a"] = True
+        if mm.kwargs.get("transpose_b"):
+            kwargs["transpose_b"] = True
+        removed = {mm_idx, add_idx}
+        out_node = add
+
+        h_name = add.outputs[0]
+        act = gv.single_consumer(h_name)
+        if act is not None and gv.is_op(act[1], *_EPILOGUE_ACTS) and \
+                len(act[1].inputs) == 1 and not act[1].kwargs:
+            kwargs["activation"] = _EPILOGUE_ACTS[act[1].op]
+            removed.add(act[0])
+            out_node = act[1]
+        else:
+            gelu = _match_erf_gelu(gv, h_name)
+            if gelu is not None:
+                kwargs["activation"] = "gelu_exact"
+                removed |= gelu[0]
+                out_node = gelu[1]
+        fused = _Node_like(add, "fused_matmul_bias_act",
+                           [mm.inputs[0], mm.inputs[1], b_name], kwargs,
+                           list(out_node.outputs))
+        return removed, fused
+    return None
+
+
+def _pass_workspace(nodes, const_vals, var_shapes, seed_dtypes,
+                    input_avals, local_ops):
+    """(avals, namer) for one fusion/autocast pass application: the
+    abstract-interpreter evidence plus a fresh-name generator seeded with
+    every name the working graph can see."""
+    avals = _abstract_avals(nodes, const_vals, var_shapes, seed_dtypes,
+                            input_avals, local_ops)
+    taken = set(avals)
+    for n in nodes:
+        taken.update(n.outputs)
+        taken.update(n.inputs)
+    return avals, _Namer(taken)
+
+
+def _fusion(nodes, outputs, const_vals, var_shapes, seed_dtypes,
+            input_avals, alias, local_ops, stats):
+    """The fusion tier: attention first (its chain contains matmuls the
+    epilogue matcher must not claim), then matmul epilogues, one linear
+    scan each. Rewrites splice in place: removed nodes drop out, synthesized
+    nodes land immediately before the fused node, output names are
+    preserved so downstream consumers (and the alias map) never move."""
+    # every pattern anchors on a catalog mmul; graphs without one (conv
+    # nets, elementwise chains, most train steps) skip the abstract
+    # interpretation entirely — fusion is on the default compile path
+    if not any(n.op == "mmul" and n.op not in local_ops for n in nodes):
+        return nodes, False
+    avals, namer = _pass_workspace(nodes, const_vals, var_shapes,
+                                   seed_dtypes, input_avals, local_ops)
+    changed = False
+
+    for matcher, kind in ((_try_attention, "attention"),
+                          (_try_epilogue, "epilogue")):
+        gv = _GraphView(nodes, outputs, alias, const_vals, avals, local_ops)
+        mask_cache: Dict[Any, str] = {}
+        rewrites = {}   # anchor idx -> (removed, synth, fused)
+        claimed: set = set()
+        for idx, n in enumerate(nodes):
+            if n.op in local_ops:
+                continue
+            if matcher is _try_attention:
+                m = matcher(gv, idx, n, namer)
+                if m is None:
+                    continue
+                removed, synth, fused, mask_pending = m
+            else:
+                m = matcher(gv, idx, n)
+                if m is None:
+                    continue
+                removed, fused = m
+                synth, mask_pending = [], None
+            if removed & claimed:
+                continue  # overlaps an accepted match: discard whole
+            claimed |= removed
+            if mask_pending is not None:
+                # synthesize/cache the mask expansion mirror only for
+                # ACCEPTED matches — a discarded match must never leave a
+                # cache entry whose defining nodes were not spliced in
+                m_final = mask_cache.get(mask_pending)
+                if m_final is None:
+                    mask_name, expand_axes = mask_pending
+                    m_final = mask_name
+                    for ax in expand_axes:
+                        nd = _Node_like(fused, "expand_dims", [m_final],
+                                        {"axis": ax}, [namer.fresh("mask")])
+                        synth.append(nd)
+                        m_final = nd.outputs[0]
+                    mask_cache[mask_pending] = m_final
+                fused.inputs.append(m_final)
+            rewrites[idx] = (removed, synth, fused)
+            stats.record_fusion(kind)
+        if rewrites:
+            out_nodes = []
+            all_removed = set()
+            for removed, _s, _f in rewrites.values():
+                all_removed |= removed
+            for idx, n in enumerate(nodes):
+                if idx in rewrites:
+                    removed, synth, fused = rewrites[idx]
+                    out_nodes.extend(synth)
+                    out_nodes.append(fused)
+                elif idx not in all_removed:
+                    out_nodes.append(n)
+            nodes = out_nodes
+            changed = True
+    return nodes, changed
+
+
+# ---------------------------------------------------------------------------
+# autocast (opt-in — DL4J_TPU_AUTOCAST=bf16 or passes=(..., "autocast"))
+# ---------------------------------------------------------------------------
+
+# matmul/conv-class ops whose inputs are cast to bf16 (the MXU-fed set).
+# Softmax/layernorm/loss ops are deliberately NOT here: the policy keeps
+# normalizers and losses in f32 (the standard mixed-precision recipe).
+_AUTOCAST_OPS = frozenset(
+    ["mmul", "linear", "tensordot", "conv2d", "fused_matmul_bias_act"])
+
+
+def _autocast(nodes, const_vals, var_shapes, seed_dtypes, input_avals,
+              local_ops, stats):
+    """Cast the first two (matrix) operands of each matmul/conv-class node
+    to bf16 and the node's output back to f32 — bf16 MXU math with an f32
+    interface (on TPU the MXU accumulates bf16 products in f32 natively;
+    the result is rounded to bf16 at the node output, and the cast-back
+    keeps every downstream dtype unchanged, so the invariant checker's
+    interface contract still holds). Bias/residual operands (input 2+)
+    stay f32 — they join after the accumulator. Idempotent: once inputs
+    are bf16 there is nothing left to cast."""
+    import jax.numpy as jnp
+
+    bf16 = np.dtype(jnp.bfloat16)
+    f32 = np.dtype(np.float32)
+    if not any(n.op in _AUTOCAST_OPS and n.op not in local_ops
+               for n in nodes):
+        return nodes, False
+    avals, namer = _pass_workspace(nodes, const_vals, var_shapes,
+                                   seed_dtypes, input_avals, local_ops)
+    from deeplearning4j_tpu import analysis as _an
+
+    cast_cache: Dict[str, str] = {}
+    out_nodes, changed = [], False
+    for n in nodes:
+        if n.op not in _AUTOCAST_OPS or n.op in local_ops or \
+                len(n.outputs) != 1:
+            out_nodes.append(n)
+            continue
+        # only touch nodes whose ORIGINAL result is f32: the cast-back
+        # pins the interface to the inferred dtype, and hardcoding f32
+        # onto e.g. an f64-promoting matmul would change it (a mixed-f64
+        # node simply keeps full precision)
+        oa = avals.get(n.outputs[0])
+        if oa is None or oa.dtype != f32:
+            out_nodes.append(n)
+            continue
+        new_inputs = list(n.inputs)
+        n_cast = 0
+        for i, name in enumerate(n.inputs[:2]):
+            a = avals.get(name)
+            if a is None or a.dtype != f32:
+                continue
+            bf_name = cast_cache.get(name)
+            if bf_name is None:
+                bf_name = namer.fresh("autocast")
+                out_nodes.append(_Node_like(n, "cast", [name],
+                                            {"dtype": "bfloat16"},
+                                            [bf_name]))
+                avals[bf_name] = _an.AVal(a.shape, bf16)
+                cast_cache[name] = bf_name
+            new_inputs[i] = bf_name
+            n_cast += 1
+        if not n_cast:
+            out_nodes.append(n)
+            continue
+        out_name = n.outputs[0]
+        raw = namer.fresh("autocast_raw")
+        n.inputs = new_inputs
+        n.outputs = [raw]
+        out_nodes.append(n)
+        out_nodes.append(_Node_like(n, "cast", [raw], {"dtype": "float32"},
+                                    [out_name]))
+        oa = avals.get(out_name)
+        avals[raw] = _an.AVal(oa.shape if oa is not None else None, bf16)
+        stats.record_fusion("autocast_casts", n_cast)
+        changed = True
+    return out_nodes, changed
+
+
+# ---------------------------------------------------------------------------
 # pass-invariance checking (graftcheck — docs/ANALYSIS.md)
 # ---------------------------------------------------------------------------
 
@@ -507,8 +1261,11 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
 
     Pure with respect to the inputs: ``nodes`` entries are copied, and
     ``const_env`` is never mutated (folded values land in
-    ``GraphPlan.extra_consts``). ``passes=None`` enables all of
-    :data:`PASS_ORDER`; pass a subset for per-pass opt-out.
+    ``GraphPlan.extra_consts``). ``passes=None`` enables
+    :func:`default_passes` — all of :data:`PASS_ORDER` minus ``fusion``
+    under ``DL4J_TPU_FUSION=0``, plus ``autocast`` under
+    ``DL4J_TPU_AUTOCAST=bf16``; pass an explicit subset (which may include
+    :data:`OPTIONAL_PASSES` names) for per-pass control.
 
     ``check_invariants`` (default on; env opt-out
     ``DL4J_TPU_CHECK_PASSES=0``): after every pass application the
@@ -527,11 +1284,12 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
 
         def resolve_op(name, _lo=local_ops):
             return _sd.resolve_graph_op(name, _lo)
-    enabled = tuple(passes) if passes is not None else PASS_ORDER
-    unknown = [p for p in enabled if p not in PASS_ORDER]
+    enabled = tuple(passes) if passes is not None else default_passes()
+    valid = PASS_ORDER + OPTIONAL_PASSES
+    unknown = [p for p in enabled if p not in valid]
     if unknown:
         raise ValueError(f"unknown optimizer pass(es) {unknown}; "
-                         f"valid: {list(PASS_ORDER)}")
+                         f"valid: {list(valid)}")
 
     alias: Dict[str, str] = {}
     const_vals = dict(const_env)
@@ -551,7 +1309,7 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
 
     for _ in range(max_iters):
         changed = False
-        for p in PASS_ORDER:
+        for p in PASS_ORDER + OPTIONAL_PASSES:
             if p not in enabled:
                 continue
             before = len(work)
@@ -562,6 +1320,14 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
                                  fold_size_limit, precision_policy)
             elif p == "cse":
                 work, ch = _cse(work, alias, local_ops)
+            elif p == "fusion":
+                work, ch = _fusion(work, outputs, const_vals,
+                                   var_shapes or {}, seed_dtypes or {},
+                                   input_avals, alias, local_ops, stats)
+            elif p == "autocast":
+                work, ch = _autocast(work, const_vals, var_shapes or {},
+                                     seed_dtypes or {}, input_avals,
+                                     local_ops, stats)
             else:
                 work, ch = _algebraic(work, const_vals, var_shapes or {},
                                       seed_dtypes or {}, alias, local_ops)
@@ -591,6 +1357,10 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
     m.counter("dl4j_tpu_graph_optimizations_total").inc()
     m.histogram("dl4j_tpu_graph_optimize_seconds").observe(
         stats.optimize_seconds)
+    # fusion-tier hit counters (labelled family: kind=attention|epilogue|
+    # autocast_casts) — docs/OBSERVABILITY.md
+    for kind, hits in stats.fusions.items():
+        m.counter("dl4j_tpu_graph_fusions_total", kind=kind).inc(hits)
     observe.tracer().complete_between(
         "optimize_graph", t0, t1, category="compile",
         nodes_before=stats.nodes_before, nodes_after=stats.nodes_after)

@@ -362,14 +362,24 @@ def resolve_graph_op(name: str, local_ops: Optional[Dict[str, Callable]] = None
     SameDiff instances with the same counter names never collide), then the
     global catalog, then the declarable-op registry. A GRAPH_OPS key that
     duplicates a registry op must be on REGISTRY_SHADOW_WHITELIST (enforced
-    by graftlint GL006)."""
+    by graftlint GL006).
+
+    Registry ops WITH platform helpers resolve to the descriptor itself, so
+    graph execution dispatches through ``OpDescriptor.resolve`` per call —
+    this is how a fused ``dot_product_attention`` node lands on the Pallas
+    flash kernel on TPU (the whole point of the optimizer's fusion tier,
+    docs/OPTIMIZER.md). Helper-less ops return the raw impl: no resolve
+    cost on the trace hot path, and host-static numpy impls
+    (``shape_of``/``stack``) stay exactly the functions the shape-chain
+    contract documents."""
     if local_ops and name in local_ops:
         return local_ops[name]
     if name in GRAPH_OPS:
         return GRAPH_OPS[name]
     reg = op_registry()
     if name in reg:
-        return reg.get(name).fn
+        desc = reg.get(name)
+        return desc if desc.platform_impls else desc.fn
     raise KeyError(f"unknown graph op '{name}'")
 
 
@@ -965,6 +975,20 @@ class SameDiff:
         self.check(outputs=out_names).raise_on_errors()
         self._jit_cache[cache_key] = True
 
+    def _effective_passes(self) -> Optional[Tuple[str, ...]]:
+        """The pass tuple this compile will actually run: the explicit
+        ``optimize_passes`` or the env-resolved default. Cache keys use
+        THIS, not the raw attribute — otherwise toggling DL4J_TPU_FUSION /
+        DL4J_TPU_AUTOCAST between calls would silently serve a plan built
+        under the previous setting."""
+        if not self.optimize:
+            return None
+        if self.optimize_passes is not None:
+            return self.optimize_passes
+        from deeplearning4j_tpu.autodiff import optimize as _opt
+
+        return _opt.default_passes()
+
     def _graph_plan(self, out_names: Tuple[str, ...]):
         """Optimized execution plan for the given outputs, or None when the
         optimizer is off. Cached in ``_jit_cache`` so the exact paths that
@@ -974,7 +998,7 @@ class SameDiff:
             return None
         from deeplearning4j_tpu.autodiff import optimize as _opt
 
-        cache_key = ("plan", out_names, self.optimize_passes)
+        cache_key = ("plan", out_names, self._effective_passes())
         plan = self._jit_cache.get(cache_key)
         if plan is None:
             policy = self._precision_policy()
@@ -998,7 +1022,7 @@ class SameDiff:
                 var_shapes=var_shapes,
                 local_ops=self._local_ops,
                 resolve_op=lambda name: resolve_graph_op(name, self._local_ops),
-                passes=self.optimize_passes,
+                passes=self._effective_passes(),
                 precision_policy=policy,
                 input_avals=self._input_avals())
             self._jit_cache[cache_key] = plan
@@ -1046,7 +1070,7 @@ class SameDiff:
         from deeplearning4j_tpu.autodiff.optimize import CompiledGraph
 
         cache_key = ("exec", out_names, bool(self.optimize),
-                     self.optimize_passes)
+                     self._effective_passes())
         fn = self._jit_cache.get(cache_key)
         if fn is None:
             self._maybe_validate(out_names)
@@ -1107,7 +1131,7 @@ class SameDiff:
         wrt = list(wrt) if wrt is not None else [
             n for n, v in self._vars.items() if v.vtype == "VARIABLE"]
         cache_key = ("grad", loss_name, tuple(wrt), bool(self.optimize),
-                     self.optimize_passes)
+                     self._effective_passes())
         fn = self._jit_cache.get(cache_key)
         if fn is None:
             self._maybe_validate((loss_name,))
@@ -1190,7 +1214,7 @@ class SameDiff:
         if self._updater_state is None:
             self._updater_state = {n: tc.updater.init_state(self._arrays[n]) for n in trainable}
         step_key = ("train", loss_name, bool(self.optimize),
-                    self.optimize_passes)
+                    self._effective_passes())
         step_fn = self._jit_cache.get(step_key)
         if step_fn is None:
             step_fn = self._train_step_fn(loss_name)

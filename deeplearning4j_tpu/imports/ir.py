@@ -79,7 +79,10 @@ class IRImporter:
         # pre-trace graph optimizer (autodiff/optimize.py): imported graphs
         # carry the most redundancy (verbatim source nodes, per-layer
         # duplicated chains, no-op Identity/Dropout), so every frontend
-        # that lowers through this walker gets the optimizer by default
+        # that lowers through this walker gets the optimizer by default —
+        # including the fusion tier that routes attention/matmul-epilogue
+        # chains onto the registry fast kernels (docs/OPTIMIZER.md;
+        # DL4J_TPU_FUSION=0 opts fusion out without losing the rest)
         self.optimize = optimize
         # graftcheck (analysis/ — docs/ANALYSIS.md): imported graphs are
         # where shape/dtype bugs enter, so every frontend verifies the

@@ -61,6 +61,11 @@ class CompileEvent:
             out["optimize_seconds"] = getattr(st, "optimize_seconds", None)
             out["nodes_before"] = getattr(st, "nodes_before", None)
             out["nodes_after"] = getattr(st, "nodes_after", None)
+            # fusion-tier hits (docs/OPTIMIZER.md § Fusion tier) — lets
+            # `tools/obsreport.py --log` show fusion counts per compile
+            fusions = getattr(st, "fusions", None)
+            if fusions:
+                out["fusions"] = dict(fusions)
         return out
 
 
@@ -86,8 +91,15 @@ class RecompileLedger:
         m = default_registry()
         m.counter("dl4j_tpu_recompiles_total").inc()
         m.counter("dl4j_tpu_recompile_cause_total", cause=cause).inc()
-        log_event("recompile", graph=graph, key=key, signature=signature,
-                  cause=cause)
+        fields = {"graph": graph, "key": key, "signature": signature,
+                  "cause": cause}
+        fusions = getattr(stats, "fusions", None) if stats is not None \
+            else None
+        if fusions:
+            # fusion-tier hits join the JSONL event so obsreport --log can
+            # report them per compile (docs/OPTIMIZER.md § Fusion tier)
+            fields["fusions"] = dict(fusions)
+        log_event("recompile", **fields)
         return ev
 
     def events(self) -> Tuple[CompileEvent, ...]:

@@ -21,8 +21,10 @@ from deeplearning4j_tpu.ops.weight_init import init_weights
 # the reference registers platform overrides at library load — libnd4j
 # OpRegistrator static init). Deferred import keeps pallas optional.
 from deeplearning4j_tpu.ops.pallas_attention import register_platform_attention
+from deeplearning4j_tpu.ops.pallas_matmul import register_platform_fused_matmul
 
 register_platform_attention()
+register_platform_fused_matmul()
 
 __all__ = [
     "registry", "op", "exec_op", "OpRegistry",

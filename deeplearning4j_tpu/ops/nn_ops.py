@@ -503,6 +503,53 @@ def multi_head_dot_product_attention(q, k, v, wq, wk, wv, wo, mask=None, *,
     return out if bo is None else out + bo
 
 
+# Activation epilogues the fused matmul understands. "gelu" is the tanh
+# approximation (what the GRAPH_OPS/registry `gelu` op computes — jax.nn
+# default); "gelu_exact" is the erf formula the decomposed ONNX/TF exporter
+# chains (x·0.5·(1+erf(x/√2))) lower to. The optimizer's epilogue-fusion
+# matcher (autodiff/optimize.py) picks the variant that matches the
+# replaced subgraph bit-for-bit at f32.
+FUSED_MATMUL_ACTIVATIONS = ("none", "relu", "tanh", "gelu", "gelu_exact")
+
+
+def apply_fused_activation(y, activation: str):
+    if activation == "none":
+        return y
+    if activation == "relu":
+        return jax.nn.relu(y)
+    if activation == "tanh":
+        return jnp.tanh(y)
+    if activation == "gelu":
+        return jax.nn.gelu(y)
+    if activation == "gelu_exact":
+        return jax.nn.gelu(y, approximate=False)
+    raise ValueError(
+        f"fused_matmul_bias_act: unknown activation '{activation}'; "
+        f"valid: {list(FUSED_MATMUL_ACTIVATIONS)}")
+
+
+@op("fused_matmul_bias_act")
+def fused_matmul_bias_act(x, w, b=None, *, activation: str = "none",
+                          transpose_a: bool = False,
+                          transpose_b: bool = False):
+    """act(x @ w + b) — the matmul-epilogue fusion target.
+
+    x:[...,M,K] w:[K,N] b:[N] -> [...,M,N]. ``activation`` is one of
+    :data:`FUSED_MATMUL_ACTIVATIONS`. The generic impl is the exact op
+    chain it replaces (XLA fuses the epilogue into the dot); the Pallas
+    TPU platform helper (ops/pallas_matmul.py) runs one MXU kernel with
+    f32 accumulation and the bias+activation applied in VMEM before the
+    result is written to HBM."""
+    if transpose_a:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_b:
+        w = jnp.swapaxes(w, -1, -2)
+    y = jnp.matmul(x, w)
+    if b is not None:
+        y = y + b
+    return apply_fused_activation(y, activation)
+
+
 # --------------------------------------------------------------------------
 # Recurrent cells (reference: lstmLayer.cpp/.cu helpers, gruCell.cpp,
 # sruCell.cpp; cuDNN lstm helper). Full-sequence scan versions live in

@@ -181,6 +181,154 @@ class TestConstEnv:
 # ---------------------------------------------------------------------------
 
 
+class TestFusedOpRules:
+    """First-class rules for the optimizer's fusion-target registry ops
+    (docs/OPTIMIZER.md § Fusion tier): symbolic-batch graphs must infer
+    exact output shapes WITHOUT the jax.eval_shape probe (which cannot run
+    over symbolic dims), and provable mismatches must flag GC codes."""
+
+    def test_dot_product_attention_symbolic_batch(self):
+        sd = SameDiff()
+        q = sd.placeholder("q", (None, 4, 32, 16))
+        k = sd.placeholder("k", (None, 4, 32, 16))
+        v = sd.placeholder("v", (None, 4, 32, 16))
+        m = sd.placeholder("m", (None, 1, 1, 32))
+        sd.op("dot_product_attention", q, k, v, m, scaled=True).rename("o")
+        report = check_samediff(sd)
+        assert not report.findings
+        aval = report.avals["o"]
+        # a concrete trailing shape proves the RULE ran: the eval_shape
+        # probe cannot produce one over a symbolic batch dim
+        assert isinstance(aval.shape[0], Dim)
+        assert aval.shape[1:] == (4, 32, 16)
+        assert aval.dtype == np.dtype(np.float32)
+
+    def test_dot_product_attention_causal_kwarg(self):
+        sd = SameDiff()
+        q = sd.placeholder("q", (None, 4, 32, 16))
+        k = sd.placeholder("k", (None, 4, 32, 16))
+        v = sd.placeholder("v", (None, 4, 32, 16))
+        sd.op("dot_product_attention", q, k, v, causal=True).rename("o")
+        report = check_samediff(sd)
+        assert not report.findings
+        assert report.avals["o"].shape[1:] == (4, 32, 16)
+
+    def test_dot_product_attention_head_dim_mismatch(self):
+        sd = SameDiff()
+        q = sd.placeholder("q", (2, 4, 32, 16))
+        k = sd.placeholder("k", (2, 4, 32, 24))  # dk mismatch
+        v = sd.placeholder("v", (2, 4, 32, 16))
+        sd.op("dot_product_attention", q, k, v)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC002" and "head dims" in f.message
+                   for f in report.findings)
+
+    def test_dot_product_attention_zero_d_mask_flagged_not_crashed(self):
+        sd = SameDiff()
+        q = sd.placeholder("q", (2, 4, 32, 16))
+        k = sd.placeholder("k", (2, 4, 32, 16))
+        v = sd.placeholder("v", (2, 4, 32, 16))
+        m = sd.placeholder("m", ())  # 0-d mask: finding, not IndexError
+        sd.op("dot_product_attention", q, k, v, m)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC001" and "0-d" in f.message
+                   for f in report.findings)
+
+    def test_dot_product_attention_kv_length_mismatch(self):
+        sd = SameDiff()
+        q = sd.placeholder("q", (2, 4, 32, 16))
+        k = sd.placeholder("k", (2, 4, 32, 16))
+        v = sd.placeholder("v", (2, 4, 48, 16))  # Lk mismatch
+        sd.op("dot_product_attention", q, k, v)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC002" and "sequence lengths" in f.message
+                   for f in report.findings)
+
+    def test_paged_decode_attention_symbolic_slots(self):
+        sd = SameDiff()
+        q = sd.placeholder("q", (None, 4, 16))
+        kp = sd.var("kp", np.zeros((6, 8, 4, 16), np.float32))
+        vp = sd.var("vp", np.zeros((6, 8, 4, 16), np.float32))
+        pt = sd.placeholder("pt", (None, 3), dtype=np.int32)
+        sl = sd.placeholder("sl", (None,), dtype=np.int32)
+        sd.op("paged_decode_attention", q, kp, vp, pt, sl).rename("o")
+        report = check_samediff(sd)
+        assert not report.findings
+        aval = report.avals["o"]
+        assert isinstance(aval.shape[0], Dim) and aval.shape[1:] == (4, 16)
+
+    def test_paged_decode_attention_rank_and_dtype_findings(self):
+        sd = SameDiff()
+        q = sd.placeholder("q", (2, 4, 16))
+        kp = sd.var("kp", np.zeros((6, 8, 4, 16), np.float32))
+        vp = sd.var("vp", np.zeros((6, 8, 4, 16), np.float32))
+        pt = sd.placeholder("pt", (2, 3, 1), dtype=np.int32)  # rank 3
+        sl = sd.placeholder("sl", (2,), dtype=np.int32)
+        sd.op("paged_decode_attention", q, kp, vp, pt, sl)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC001" and "page_table" in f.message
+                   for f in report.findings)
+
+        sd = SameDiff()
+        pt_f = sd.placeholder("pt", (2, 3))  # float page table
+        sl = sd.placeholder("sl", (2,), dtype=np.int32)
+        q = sd.placeholder("q", (2, 4, 16))
+        kp = sd.var("kp", np.zeros((6, 8, 4, 16), np.float32))
+        vp = sd.var("vp", np.zeros((6, 8, 4, 16), np.float32))
+        sd.op("paged_decode_attention", q, kp, vp, pt_f, sl)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC003" and "not integral" in f.message
+                   for f in report.findings)
+
+    def test_fused_matmul_bias_act_symbolic_batch(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", (None, 32))
+        w = sd.var("w", np.zeros((32, 8), np.float32))
+        b = sd.var("b", np.zeros(8, np.float32))
+        sd.op("fused_matmul_bias_act", x, w, b,
+              activation="gelu_exact").rename("o")
+        report = check_samediff(sd)
+        assert not report.findings
+        aval = report.avals["o"]
+        assert isinstance(aval.shape[0], Dim) and aval.shape[1] == 8
+
+    def test_fused_matmul_bias_act_findings(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", (4, 32))
+        w = sd.var("w", np.zeros((16, 8), np.float32))  # contraction
+        sd.op("fused_matmul_bias_act", x, w)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC002" for f in report.findings)
+
+        sd = SameDiff()
+        x = sd.placeholder("x", (4, 32))
+        w = sd.var("w", np.zeros((32, 8), np.float32))
+        b = sd.var("b", np.zeros((3,), np.float32))  # bias won't broadcast
+        sd.op("fused_matmul_bias_act", x, w, b)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC002" and "bias" in f.message
+                   for f in report.findings)
+
+        sd = SameDiff()
+        x = sd.placeholder("x", (4, 32))
+        w = sd.var("w", np.zeros((32, 8), np.float32))
+        sd.op("fused_matmul_bias_act", x, w, activation="swish")
+        report = check_samediff(sd)
+        assert any(f.rule == "GC001" and "activation" in f.message
+                   for f in report.findings)
+
+    def test_zero_probe_fallbacks_on_fused_fixture(self):
+        # the acceptance criterion: the fused-graph fixture verifies with
+        # no GC006 opacity findings — i.e. every fused op resolved through
+        # a first-class rule, never the eval_shape probe (which is
+        # impossible here: the fixture's batch dims are symbolic)
+        report = check_samediff(fixtures.fused_graph_sym_batch(),
+                                graph_name="zoo/fused_graph_sym_batch")
+        assert not report.findings
+        for out in ("att", "causal_att", "h", "decoded"):
+            assert report.avals[out].shape is not None
+
+
 class TestSameDiffWiring:
     def test_check_populates_last_report(self):
         sd = SameDiff()

@@ -410,10 +410,16 @@ class TestJsonlEventLog:
     def test_obsreport_log_mode(self, tmp_path, monkeypatch, capsys):
         import sys
 
+        from deeplearning4j_tpu.autodiff.optimize import OptimizeStats
+
         path = str(tmp_path / "obs.jsonl")
         monkeypatch.setenv(observe.OBS_LOG_ENV, path)
+        st = OptimizeStats()
+        st.record_fusion("attention", 12)
+        st.record_fusion("epilogue", 72)
         observe.ledger().record(graph="mln", key="train_step",
-                                signature="s", cause="first_compile")
+                                signature="s", cause="first_compile",
+                                stats=st)
         observe.log_event("serving_batch", rows=6, requests=3,
                           batch_seconds=0.004)
         monkeypatch.delenv(observe.OBS_LOG_ENV)
@@ -429,6 +435,8 @@ class TestJsonlEventLog:
         assert out["by_kind"] == {"recompile": 1, "serving_batch": 1}
         assert out["recompile_causes"] == {"first_compile": 1}
         assert out["serving_rows"] == 6
+        # fusion hits ride the recompile event into the post-hoc summary
+        assert out["fusion_hits"] == {"attention": 12, "epilogue": 72}
 
 
 # ---------------------------------------------------------------------------

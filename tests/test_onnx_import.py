@@ -19,73 +19,14 @@ from deeplearning4j_tpu.imports.onnx_import import (
 
 
 # ---------------------------------------------------------------------------
-# ModelProto assembly helpers (public onnx.proto3 field numbers)
+# ModelProto assembly helpers — canonical home is
+# deeplearning4j_tpu/testing/onnx_builder.py (bench.py builds the
+# BENCH_MODEL=bert_import model with the same codec); re-exported here for
+# the golden-test files that import them from this module.
 # ---------------------------------------------------------------------------
 
-_NP_DT = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
-          np.dtype(np.int32): 6, np.dtype(np.float64): 11,
-          np.dtype(np.uint8): 2, np.dtype(np.int8): 3}
-
-
-def tensor_proto(name, arr):
-    arr = np.ascontiguousarray(arr)
-    out = pw.field_packed_varints(1, arr.shape) if arr.ndim else b""
-    out += pw.field_varint(2, _NP_DT[arr.dtype])
-    out += pw.field_string(8, name)
-    out += pw.field_bytes(9, arr.tobytes())
-    return out
-
-
-def attr_proto(name, val):
-    out = pw.field_string(1, name)
-    if isinstance(val, float):
-        out += pw.field_float(2, val) + pw.field_varint(20, 1)
-    elif isinstance(val, int):
-        out += pw.field_varint(3, val) + pw.field_varint(20, 2)
-    elif isinstance(val, str):
-        out += pw.field_bytes(4, val.encode()) + pw.field_varint(20, 3)
-    elif isinstance(val, np.ndarray):
-        out += pw.field_bytes(5, tensor_proto("", val)) + pw.field_varint(20, 4)
-    elif isinstance(val, (list, tuple)) and val and isinstance(val[0], float):
-        out += b"".join(pw.field_float(7, v) for v in val) + pw.field_varint(20, 6)
-    elif isinstance(val, (list, tuple)):
-        out += pw.field_packed_varints(8, val) + pw.field_varint(20, 7)
-    else:
-        raise TypeError(type(val))
-    return out
-
-
-def node_proto(op_type, inputs, outputs, name="", **attrs):
-    out = b"".join(pw.field_string(1, i) for i in inputs)
-    out += b"".join(pw.field_string(2, o) for o in outputs)
-    out += pw.field_string(3, name or outputs[0] + "_node")
-    out += pw.field_string(4, op_type)
-    out += b"".join(pw.field_bytes(5, attr_proto(k, v))
-                    for k, v in attrs.items())
-    return out
-
-
-def value_info(name, shape):
-    dims = b"".join(pw.field_bytes(1, pw.field_varint(1, d)) for d in shape)
-    shape_p = pw.field_bytes(2, dims)
-    tensor_t = pw.field_varint(1, 1) + shape_p  # elem_type=FLOAT
-    type_p = pw.field_bytes(1, tensor_t)
-    return pw.field_string(1, name) + pw.field_bytes(2, type_p)
-
-
-def build_model(nodes, inputs, outputs, initializers):
-    """nodes: list of node_proto bytes; inputs/outputs: [(name, shape)];
-    initializers: {name: array}."""
-    g = b"".join(pw.field_bytes(1, n) for n in nodes)
-    g += pw.field_string(2, "test_graph")
-    g += b"".join(pw.field_bytes(5, tensor_proto(n, a))
-                  for n, a in initializers.items())
-    g += b"".join(pw.field_bytes(11, value_info(n, s)) for n, s in inputs)
-    g += b"".join(pw.field_bytes(12, value_info(n, s)) for n, s in outputs)
-    m = pw.field_varint(1, 8)  # ir_version
-    m += pw.field_bytes(7, g)
-    m += pw.field_bytes(8, pw.field_string(1, "") + pw.field_varint(2, 13))
-    return m
+from deeplearning4j_tpu.testing.onnx_builder import (  # noqa: F401,E402
+    attr_proto, build_model, node_proto, tensor_proto, value_info)
 
 
 def _run(sd, feeds, out):

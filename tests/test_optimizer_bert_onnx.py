@@ -16,114 +16,22 @@ attacks) is structural, and this keeps the double compile CI-sane.
 
 import numpy as np
 
-from tests.test_onnx_import import build_model, node_proto
-
 from deeplearning4j_tpu.imports.onnx_import import import_onnx
+from deeplearning4j_tpu.testing.onnx_builder import bert_onnx_model
 
 B, T, D, HEADS, FF, LAYERS, VOCAB = 1, 16, 768, 12, 3072, 12, 512
 HD = D // HEADS
 
 
 def _bert_base_model():
-    r = np.random.RandomState(0)
-    nodes = []
-    init = {
-        "emb": (r.randn(VOCAB, D) * 0.02).astype(np.float32),
-        "pos": (r.randn(T, D) * 0.02).astype(np.float32),
-        "cls_w": (r.randn(D, 2) * 0.02).astype(np.float32),
-        "shape_split": np.asarray([B, T, HEADS, HD], np.int64),
-        "shape_merge": np.asarray([B, T, D], np.int64),
-        "one": np.float32(1.0),
-        "half": np.float32(0.5),
-        "two": np.float32(2.0),
-        "neg_big": np.float32(-10000.0),
-        "hd_f": np.float32(HD),
-        "eps": np.float32(1e-6),
-    }
-
-    def n(op, ins, outs, **attrs):
-        nodes.append(node_proto(op, ins, outs, **attrs))
-        return outs[0]
-
-    def layer_norm(p, x):
-        mu = n("ReduceMean", [x], [f"{p}_mu"], axes=[-1], keepdims=1)
-        d = n("Sub", [x, mu], [f"{p}_d"])
-        sq = n("Pow", [d, "two"], [f"{p}_sq"])
-        var = n("ReduceMean", [sq], [f"{p}_var"], axes=[-1], keepdims=1)
-        ve = n("Add", [var, "eps"], [f"{p}_ve"])
-        std = n("Sqrt", [ve], [f"{p}_std"])
-        norm = n("Div", [d, std], [f"{p}_norm"])
-        g = n("Mul", [norm, f"{p}_g"], [f"{p}_gn"])
-        return n("Add", [g, f"{p}_b"], [f"{p}_out"])
-
-    x = n("Gather", ["emb", "ids"], ["embedded"], axis=0)
-    x = n("Add", [x, "pos"], ["h0"])
-
-    for i in range(LAYERS):
-        p = f"l{i}"
-        for nm, shape in [("wq", (D, D)), ("wk", (D, D)), ("wv", (D, D)),
-                          ("wo", (D, D)), ("w1", (D, FF)), ("w2", (FF, D))]:
-            init[f"{p}_{nm}"] = (r.randn(*shape) * 0.02).astype(np.float32)
-        for nm, size in [("bq", D), ("bk", D), ("bv", D), ("bo", D),
-                         ("b1", FF), ("b2", D)]:
-            init[f"{p}_{nm}"] = np.zeros(size, np.float32)
-        for ln in ("ln1", "ln2"):
-            init[f"{p}_{ln}_g"] = np.ones(D, np.float32)
-            init[f"{p}_{ln}_b"] = np.zeros(D, np.float32)
-
-        # the attention-mask expansion chain, re-inlined per layer exactly
-        # as per-module tracing exporters do — the CSE target
-        mu = n("Unsqueeze", ["mask"], [f"{p}_mask_u"], axes=[1, 2])
-        mc = n("Cast", [mu], [f"{p}_mask_c"], to=1)
-        mi = n("Sub", ["one", mc], [f"{p}_mask_i"])
-        pen = n("Mul", [mi, "neg_big"], [f"{p}_mask_pen"])
-
-        heads = {}
-        for t in ("q", "k", "v"):
-            mm = n("MatMul", [x, f"{p}_w{t}"], [f"{p}_{t}mm"])
-            a = n("Add", [mm, f"{p}_b{t}"], [f"{p}_{t}"])
-            rs = n("Reshape", [a, "shape_split"], [f"{p}_{t}r"])
-            heads[t] = n("Transpose", [rs], [f"{p}_{t}h"], perm=[0, 2, 1, 3])
-        kt = n("Transpose", [heads["k"]], [f"{p}_kt"], perm=[0, 1, 3, 2])
-        scores = n("MatMul", [heads["q"], kt], [f"{p}_scores"])
-        scale = n("Sqrt", ["hd_f"], [f"{p}_scale"])  # foldable const chain
-        scaled = n("Div", [scores, scale], [f"{p}_scaled"])
-        masked = n("Add", [scaled, pen], [f"{p}_masked"])
-        probs = n("Softmax", [masked], [f"{p}_probs"], axis=-1)
-        probs = n("Dropout", [probs], [f"{p}_probs_d"])  # no-op at inference
-        ctx = n("MatMul", [probs, heads["v"]], [f"{p}_ctx"])
-        ctx = n("Transpose", [ctx], [f"{p}_ctxt"], perm=[0, 2, 1, 3])
-        ctx = n("Reshape", [ctx, "shape_merge"], [f"{p}_ctxm"])
-        proj = n("MatMul", [ctx, f"{p}_wo"], [f"{p}_projmm"])
-        proj = n("Add", [proj, f"{p}_bo"], [f"{p}_proj"])
-        proj = n("Dropout", [proj], [f"{p}_proj_d"])
-        res = n("Add", [x, proj], [f"{p}_res1"])
-        x1 = layer_norm(f"{p}_ln1", res)
-
-        # FF with the decomposed-gelu chain exporters emit
-        h1 = n("MatMul", [x1, f"{p}_w1"], [f"{p}_ffmm"])
-        h1 = n("Add", [h1, f"{p}_b1"], [f"{p}_ff1"])
-        s2 = n("Sqrt", ["two"], [f"{p}_sqrt2"])  # foldable const chain
-        e = n("Div", [h1, s2], [f"{p}_ge_div"])
-        e = n("Erf", [e], [f"{p}_ge_erf"])
-        e = n("Add", [e, "one"], [f"{p}_ge_add"])
-        e = n("Mul", [h1, e], [f"{p}_ge_mul"])
-        g = n("Mul", [e, "half"], [f"{p}_gelu"])
-        h2 = n("MatMul", [g, f"{p}_w2"], [f"{p}_ff2mm"])
-        h2 = n("Add", [h2, f"{p}_b2"], [f"{p}_ff2"])
-        h2 = n("Dropout", [h2], [f"{p}_ff2_d"])
-        res2 = n("Add", [x1, h2], [f"{p}_res2"])
-        x = layer_norm(f"{p}_ln2", res2)
-        x = n("Identity", [x], [f"{p}_out"])  # exporter block boundary
-
-    logits = n("MatMul", [x, "cls_w"], ["logits"])
-    n("Softmax", [logits], ["y"], axis=-1)
-    return build_model(nodes, [("ids", (B, T)), ("mask", (B, T))],
-                       [("y", (B, T, 2))], init)
+    return bert_onnx_model(layers=LAYERS, batch=B, seq=T, d=D, heads=HEADS,
+                           ff=FF, vocab=VOCAB)
 
 
 class TestBertBaseOnnxOptimizer:
     def test_node_reduction_and_equivalence(self):
+        from deeplearning4j_tpu.environment import environment
+
         model = _bert_base_model()
         r = np.random.RandomState(1)
         feeds = {
@@ -134,8 +42,17 @@ class TestBertBaseOnnxOptimizer:
         sd_ref = import_onnx(model, optimize=False)
         ref = sd_ref.output(feeds, ["y"])["y"]
 
-        sd = import_onnx(model)
-        got = sd.output(feeds, ["y"])["y"]
+        # helper_mode="xla" pins BOTH runs to the generic registry impls:
+        # the fused-vs-unfused comparison isolates the REWRITE, not the
+        # Pallas kernel (which tests/test_optimizer_fusion.py covers)
+        env = environment()
+        prev = env.helper_mode
+        env.helper_mode = "xla"
+        try:
+            sd = import_onnx(model)
+            got = sd.output(feeds, ["y"])["y"]
+        finally:
+            env.helper_mode = prev
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
 
         st = sd.last_compile_stats
@@ -144,9 +61,24 @@ class TestBertBaseOnnxOptimizer:
             f"only {reduction:.1%} of {st.nodes_before} nodes removed; "
             f"passes: { {k: v['removed'] for k, v in st.passes.items()} }")
         # per-pass node deltas are reported, and every pass contributed
-        for p in ("dce", "fold", "cse", "algebraic"):
+        for p in ("dce", "fold", "cse", "algebraic", "fusion"):
             assert st.passes[p]["removed"] > 0, f"pass '{p}' removed nothing"
-        # the win the instrumentation exists to prove: CSE collapsed the
-        # per-layer mask chains, algebraic killed Dropout/Identity no-ops
-        assert st.passes["cse"]["removed"] >= (LAYERS - 1) * 4
+        # the fusion-tier acceptance: ONE dot_product_attention per layer
+        # (so the shape-aware flash dispatch applies to the import path)
+        # and the six matmul+bias projections per layer fused — incl. the
+        # decomposed-erf-gelu FF1 epilogue
+        assert st.fusions["attention"] == LAYERS, st.fusions
+        assert st.fusions["epilogue"] >= 6 * LAYERS, st.fusions
+        plan_ops = [n.op for n in sd._jit_cache[
+            ("plan", ("y",), sd._effective_passes())].nodes]
+        assert plan_ops.count("dot_product_attention") == LAYERS
+        assert plan_ops.count("fused_matmul_bias_act") >= 6 * LAYERS
+        # the only surviving softmax is the classifier head — every
+        # attention softmax was swallowed by a fused node
+        assert plan_ops.count("softmax") == 1
+        # algebraic still kills the Dropout/Identity no-ops; the per-layer
+        # mask-expansion chains are now claimed by fusion+DCE (the fused
+        # node consumes the raw mask, orphaning the penalty arithmetic),
+        # so CSE's floor is the first-level dedup of the duplicated chains
         assert st.passes["algebraic"]["removed"] >= 4 * LAYERS
+        assert st.passes["cse"]["removed"] >= LAYERS - 1

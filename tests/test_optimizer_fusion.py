@@ -1,0 +1,500 @@
+"""Optimizer fusion tier (autodiff/optimize.py, docs/OPTIMIZER.md):
+attention-chain → dot_product_attention, matmul+bias(+act) →
+fused_matmul_bias_act, and the opt-in bf16 autocast pass.
+
+Positive matches assert the rewritten plan AND numeric equivalence against
+the unfused graph; negative fixtures (scale on the wrong side, non-softmax
+normalizer, mask dtype mismatch, shared intermediates) assert the matcher
+leaves the graph untouched; the Pallas flash/epilogue kernels are compared
+under forced helper modes on CPU (interpret mode — no TPU in CI).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.optimize import (
+    OPTIONAL_PASSES, PASS_ORDER, default_passes)
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.environment import environment
+
+B, H, T, HD = 2, 2, 8, 8
+
+
+def _plan(sd, outputs=("out",)):
+    # cache keys carry the EFFECTIVE pass tuple (env-resolved), so an env
+    # toggle between calls can never serve a stale plan
+    return sd._jit_cache[("plan", tuple(outputs), sd._effective_passes())]
+
+
+def _plan_ops(sd, outputs=("out",)):
+    return [n.op for n in _plan(sd, outputs).nodes]
+
+
+def _attention_graph(scale_variant="div_scores", normalizer="softmax",
+                     mask="float", share_probs=False, transpose_b=False,
+                     optimize=True):
+    """The ONNX/TF-importer-shaped attention chain, recorded directly."""
+    r = np.random.RandomState(0)
+    sd = SameDiff(optimize=optimize)
+    q = sd.placeholder("q", (B, H, T, HD))
+    k = sd.placeholder("k", (B, H, T, HD))
+    v = sd.placeholder("v", (B, H, T, HD))
+    m = sd.placeholder("m", (B, 1, 1, T),
+                       dtype=jnp.int32 if mask == "int" else jnp.float32)
+    one = sd.constant("one", np.float32(1.0))
+    neg = sd.constant("neg", np.float32(-10000.0))
+    scale = sd.constant("scale", np.float32(np.sqrt(HD)))
+    inv_scale = sd.constant("inv_scale", np.float32(1.0 / np.sqrt(HD)))
+
+    if transpose_b:
+        scores = sd._record("mmul", [q, k], {"transpose_b": True})
+    else:
+        kt = sd._record("transpose", [k], {"axes": (0, 1, 3, 2)})
+        scores = sd._record("mmul", [q, kt])
+    if scale_variant == "div_scores":
+        scaled = scores / scale
+    elif scale_variant == "mul_scores":
+        scaled = scores * inv_scale
+    elif scale_variant == "wrong_side":
+        scaled = scores * scale          # multiplies by sqrt(d): not 1/sqrt
+    elif scale_variant == "none":
+        scaled = scores
+    else:
+        raise AssertionError(scale_variant)
+    if mask != "off":
+        pen = (one - m) * neg
+        scaled = scaled + pen
+    if normalizer == "softmax":
+        probs = sd.nn.softmax(scaled, axis=-1)
+    else:
+        probs = sd.nn.sigmoid(scaled)    # non-softmax normalizer
+    if share_probs:
+        sd._record("reduce_sum", [probs]).rename("probs_sum")
+    sd._record("mmul", [probs, v]).rename("out")
+
+    feeds = {"q": r.randn(B, H, T, HD).astype(np.float32),
+             "k": r.randn(B, H, T, HD).astype(np.float32),
+             "v": r.randn(B, H, T, HD).astype(np.float32),
+             "m": (r.rand(B, 1, 1, T) > 0.2).astype(
+                 np.int32 if mask == "int" else np.float32)}
+    return sd, feeds
+
+
+def _ref(sd_kwargs, feeds_outputs=("out",)):
+    sd, feeds = _attention_graph(optimize=False, **sd_kwargs)
+    return sd.output(feeds, list(feeds_outputs)), feeds
+
+
+class TestAttentionFusion:
+    @pytest.mark.parametrize("variant", ["div_scores", "mul_scores", "none"])
+    def test_fused_matches_unfused(self, variant):
+        ref, feeds = _ref({"scale_variant": variant})
+        sd, _ = _attention_graph(scale_variant=variant)
+        got = sd.output(feeds, ["out"])
+        np.testing.assert_allclose(got["out"], ref["out"],
+                                   rtol=1e-5, atol=1e-5)
+        assert sd.last_compile_stats.fusions.get("attention") == 1
+        ops = _plan_ops(sd)
+        assert "dot_product_attention" in ops
+        assert "softmax" not in ops
+
+    def test_transpose_b_variant(self):
+        ref, feeds = _ref({"transpose_b": True})
+        sd, _ = _attention_graph(transpose_b=True)
+        got = sd.output(feeds, ["out"])
+        np.testing.assert_allclose(got["out"], ref["out"],
+                                   rtol=1e-5, atol=1e-5)
+        assert sd.last_compile_stats.fusions.get("attention") == 1
+
+    def test_no_mask_variant(self):
+        ref, feeds = _ref({"mask": "off"})
+        sd, _ = _attention_graph(mask="off")
+        got = sd.output(feeds, ["out"])
+        np.testing.assert_allclose(got["out"], ref["out"],
+                                   rtol=1e-5, atol=1e-5)
+        assert sd.last_compile_stats.fusions.get("attention") == 1
+
+    def test_causal_const_mask_fuses_to_causal_kwarg(self):
+        r = np.random.RandomState(3)
+        tri = np.where(np.tril(np.ones((T, T), bool)), 0.0, -1e9) \
+            .astype(np.float32)
+
+        def build(optimize):
+            sd = SameDiff(optimize=optimize)
+            q = sd.placeholder("q", (B, H, T, HD))
+            k = sd.placeholder("k", (B, H, T, HD))
+            v = sd.placeholder("v", (B, H, T, HD))
+            c = sd.constant("tri", tri)
+            scale = sd.constant("scale", np.float32(np.sqrt(HD)))
+            kt = sd._record("transpose", [k], {"axes": (0, 1, 3, 2)})
+            scores = sd._record("mmul", [q, kt]) / scale
+            probs = sd.nn.softmax(scores + c, axis=-1)
+            sd._record("mmul", [probs, v]).rename("out")
+            return sd
+
+        feeds = {n: r.randn(B, H, T, HD).astype(np.float32)
+                 for n in ("q", "k", "v")}
+        ref = build(False).output(feeds, ["out"])["out"]
+        sd = build(True)
+        got = sd.output(feeds, ["out"])["out"]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+        assert sd.last_compile_stats.fusions.get("attention") == 1
+        plan = _plan(sd)
+        fused = [n for n in plan.nodes if n.op == "dot_product_attention"]
+        assert fused and fused[0].kwargs.get("causal") is True
+        assert len(fused[0].inputs) == 3  # no mask operand
+
+    def test_flash_helper_path_on_cpu_interpret(self, monkeypatch):
+        # forced pallas + a floor-zero dispatch threshold: the fused node
+        # must route through the flash kernel (interpret mode off-TPU) and
+        # agree with the unfused graph at kernel tolerances (1e-2/1e-5)
+        monkeypatch.setenv("DL4J_TPU_FLASH_MIN_T", "1")
+        ref, feeds = _ref({})
+        sd, _ = _attention_graph()
+        env = environment()
+        prev = env.helper_mode
+        env.helper_mode = "pallas"
+        try:
+            got = sd.output(feeds, ["out"])
+        finally:
+            env.helper_mode = prev
+        assert sd.last_compile_stats.fusions.get("attention") == 1
+        np.testing.assert_allclose(got["out"], ref["out"],
+                                   rtol=1e-2, atol=1e-5)
+
+    def test_feed_violating_declared_head_dim_keeps_original_scale(self):
+        # declared placeholder shapes are NOT enforced at feed time; the
+        # rewrite re-applies the graph's original scale constant to q, so
+        # a feed with a different head dim still divides by sqrt(DECLARED
+        # dk) exactly like the unfused graph — never sqrt(actual dk)
+        ref_sd, _ = _attention_graph(optimize=False)
+        sd, _ = _attention_graph()
+        r = np.random.RandomState(9)
+        odd = {"q": r.randn(B, H, T, 4).astype(np.float32),
+               "k": r.randn(B, H, T, 4).astype(np.float32),
+               "v": r.randn(B, H, T, 4).astype(np.float32),
+               "m": (r.rand(B, 1, 1, T) > 0.2).astype(np.float32)}
+        ref = ref_sd.output(odd, ["out"])["out"]
+        got = sd.output(odd, ["out"])["out"]
+        assert sd.last_compile_stats.fusions.get("attention") == 1
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_gradients_flow_through_fused_node(self):
+        _, feeds = _attention_graph(optimize=False)
+        w = np.random.RandomState(7).randn(HD, HD).astype(np.float32) * 0.1
+
+        # gradient equivalence: loss over the fused vs unfused graph
+        def build(optimize):
+            sd, _ = _attention_graph(optimize=optimize)
+            out = sd.get_variable("out")
+            wv = sd.var("w", w)
+            (out @ wv).sum().rename("loss")
+            return sd
+
+        g_ref = build(False).calculate_gradients(feeds, "loss")
+        sd = build(True)
+        g_opt = sd.calculate_gradients(feeds, "loss")
+        assert sd.last_compile_stats.fusions.get("attention") == 1
+        assert set(g_ref) == set(g_opt)
+        for k in g_ref:
+            np.testing.assert_allclose(g_opt[k], g_ref[k],
+                                       rtol=1e-5, atol=1e-5)
+
+
+class TestAttentionPatternMisses:
+    """The negative fixtures: each must leave the graph UNFUSED (and the
+    outputs still correct)."""
+
+    def _assert_untouched(self, sd, feeds, ref, outputs=("out",)):
+        got = sd.output(feeds, list(outputs))
+        for o in outputs:
+            np.testing.assert_allclose(got[o], ref[o], rtol=1e-5, atol=1e-5)
+        assert sd.last_compile_stats.fusions.get("attention", 0) == 0
+        assert "dot_product_attention" not in _plan_ops(sd, outputs)
+
+    def test_scale_on_wrong_side(self):
+        ref, feeds = _ref({"scale_variant": "wrong_side"})
+        sd, _ = _attention_graph(scale_variant="wrong_side")
+        self._assert_untouched(sd, feeds, ref)
+
+    def test_non_softmax_normalizer(self):
+        ref, feeds = _ref({"normalizer": "sigmoid"})
+        sd, _ = _attention_graph(normalizer="sigmoid")
+        self._assert_untouched(sd, feeds, ref)
+
+    def test_mask_dtype_mismatch(self):
+        ref, feeds = _ref({"mask": "int"})
+        sd, _ = _attention_graph(mask="int")
+        self._assert_untouched(sd, feeds, ref)
+
+    def test_shared_intermediate_consumed_elsewhere(self):
+        ref, feeds = _ref({"share_probs": True},
+                          feeds_outputs=("out", "probs_sum"))
+        sd, _ = _attention_graph(share_probs=True)
+        self._assert_untouched(sd, feeds, ref, outputs=("out", "probs_sum"))
+
+    def test_non_binary_constant_mask_not_fused(self):
+        # the mask contract is BINARY 0/1; a provably fractional CONSTANT
+        # mask (where additive -5000 != where-masking) must stay verbatim
+        def build(optimize):
+            r = np.random.RandomState(11)
+            sd = SameDiff(optimize=optimize)
+            q = sd.placeholder("q", (B, H, T, HD))
+            k = sd.placeholder("k", (B, H, T, HD))
+            v = sd.placeholder("v", (B, H, T, HD))
+            m = sd.constant("m", np.full((B, 1, 1, T), 0.5, np.float32))
+            one = sd.constant("one", np.float32(1.0))
+            neg = sd.constant("neg", np.float32(-10000.0))
+            scale = sd.constant("scale", np.float32(np.sqrt(HD)))
+            kt = sd._record("transpose", [k], {"axes": (0, 1, 3, 2)})
+            scores = sd._record("mmul", [q, kt]) / scale
+            probs = sd.nn.softmax(scores + (one - m) * neg, axis=-1)
+            sd._record("mmul", [probs, v]).rename("out")
+            return sd
+
+        r = np.random.RandomState(12)
+        feeds = {n: r.randn(B, H, T, HD).astype(np.float32)
+                 for n in ("q", "k", "v")}
+        ref = build(False).output(feeds, ["out"])["out"]
+        sd = build(True)
+        got = sd.output(feeds, ["out"])["out"]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+        assert sd.last_compile_stats.fusions.get("attention", 0) == 0
+
+    def test_env_opt_out(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_FUSION", "0")
+        assert "fusion" not in default_passes()
+        ref, feeds = _ref({})
+        sd, _ = _attention_graph()
+        self._assert_untouched(sd, feeds, ref)
+
+    def test_env_toggle_after_compile_rebuilds_plan(self, monkeypatch):
+        # cache keys carry the env-RESOLVED pass tuple: flipping
+        # DL4J_TPU_FUSION between calls must not serve the stale plan
+        ref, feeds = _ref({})
+        sd, _ = _attention_graph()
+        got = sd.output(feeds, ["out"])
+        assert "dot_product_attention" in _plan_ops(sd)
+        monkeypatch.setenv("DL4J_TPU_FUSION", "0")
+        got_off = sd.output(feeds, ["out"])
+        assert "dot_product_attention" not in _plan_ops(sd)
+        np.testing.assert_allclose(got_off["out"], got["out"],
+                                   rtol=1e-5, atol=1e-5)
+        monkeypatch.delenv("DL4J_TPU_FUSION")
+        sd.output(feeds, ["out"])
+        assert "dot_product_attention" in _plan_ops(sd)
+
+
+def _epilogue_graph(act="none", optimize=True, share_mm=False, m=4, k=16,
+                    n=8):
+    r = np.random.RandomState(1)
+    sd = SameDiff(optimize=optimize)
+    x = sd.placeholder("x", (m, k))
+    w = sd.var("w", (r.randn(k, n) * 0.2).astype(np.float32))
+    b = sd.var("b", (r.randn(n) * 0.1).astype(np.float32))
+    h = x @ w + b
+    if share_mm:
+        # the matmul output feeds a second consumer: must NOT fuse
+        mm_name = sd._nodes[0].outputs[0]
+        sd._record("reduce_sum", [sd.get_variable(mm_name)]) \
+            .rename("mm_sum")
+    if act in ("relu", "tanh", "gelu"):
+        h = sd._record(act, [h])
+    h.rename("out")
+    feeds = {"x": r.randn(m, k).astype(np.float32)}
+    return sd, feeds
+
+
+class TestEpilogueFusion:
+    @pytest.mark.parametrize("act", ["none", "relu", "tanh", "gelu"])
+    def test_fused_matches_unfused_forced_xla(self, act):
+        # the acceptance contract: helper_mode="xla"-forced CPU
+        # equivalence for fused_matmul_bias_act (no-TPU container)
+        sd_ref, feeds = _epilogue_graph(act=act, optimize=False)
+        ref = sd_ref.output(feeds, ["out"])["out"]
+        sd, _ = _epilogue_graph(act=act)
+        env = environment()
+        prev = env.helper_mode
+        env.helper_mode = "xla"
+        try:
+            got = sd.output(feeds, ["out"])["out"]
+        finally:
+            env.helper_mode = prev
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+        assert sd.last_compile_stats.fusions.get("epilogue") == 1
+        plan = _plan(sd)
+        fused = [n for n in plan.nodes if n.op == "fused_matmul_bias_act"]
+        assert fused and fused[0].kwargs["activation"] == act
+
+    def test_erf_gelu_chain_fuses_exact(self):
+        def build(optimize):
+            r = np.random.RandomState(1)
+            sd = SameDiff(optimize=optimize)
+            x = sd.placeholder("x", (4, 16))
+            w = sd.var("w", (r.randn(16, 8) * 0.2).astype(np.float32))
+            b = sd.var("b", (r.randn(8) * 0.1).astype(np.float32))
+            s2 = sd.constant("s2", np.float32(np.sqrt(np.float32(2.0))))
+            one = sd.constant("one", np.float32(1.0))
+            half = sd.constant("half", np.float32(0.5))
+            h = x @ w + b
+            e = sd.math.erf(h / s2)
+            g = (h * (e + one)) * half
+            g.rename("out")
+            return sd
+
+        r = np.random.RandomState(2)
+        feeds = {"x": r.randn(4, 16).astype(np.float32)}
+        ref = build(False).output(feeds, ["out"])["out"]
+        sd = build(True)
+        got = sd.output(feeds, ["out"])["out"]
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+        assert sd.last_compile_stats.fusions.get("epilogue") == 1
+        plan = _plan(sd)
+        fused = [n for n in plan.nodes if n.op == "fused_matmul_bias_act"]
+        assert fused and fused[0].kwargs["activation"] == "gelu_exact"
+        assert "erf" not in [n.op for n in plan.nodes]
+
+    def test_integer_matmul_bias_relu_fuses_with_integer_dtype(self):
+        # relu is dtype-preserving: fusing an int chain must neither trip
+        # the pass invariant checker (rule would claim float) nor change
+        # the result dtype (review-round regression)
+        def build(optimize):
+            sd = SameDiff(optimize=optimize)
+            x = sd.placeholder("x", (4, 8), dtype=jnp.int32)
+            w = sd.var("w", np.arange(32, dtype=np.int32).reshape(8, 4) - 16)
+            b = sd.var("b", np.ones(4, np.int32))
+            sd._record("relu", [x @ w + b]).rename("out")
+            return sd
+
+        feeds = {"x": np.arange(32, dtype=np.int32).reshape(4, 8)}
+        ref = build(False).output(feeds, ["out"])["out"]
+        sd = build(True)
+        got = sd.output(feeds, ["out"])["out"]
+        assert got.dtype == ref.dtype == np.int32
+        np.testing.assert_array_equal(got, ref)
+        assert sd.last_compile_stats.fusions.get("epilogue") == 1
+
+    def test_shared_matmul_not_fused(self):
+        sd_ref, feeds = _epilogue_graph(optimize=False, share_mm=True)
+        ref = sd_ref.output(feeds, ["out", "mm_sum"])
+        sd, _ = _epilogue_graph(share_mm=True)
+        got = sd.output(feeds, ["out", "mm_sum"])
+        for o in ("out", "mm_sum"):
+            np.testing.assert_allclose(got[o], ref[o], rtol=1e-6, atol=1e-6)
+        assert sd.last_compile_stats.fusions.get("epilogue", 0) == 0
+
+    def test_gradients_match_through_fused_epilogue(self):
+        def build(optimize):
+            sd, feeds = _epilogue_graph(act="gelu", optimize=optimize)
+            out = sd.get_variable("out")
+            (out * out).mean().rename("loss")
+            return sd, feeds
+
+        sd_ref, feeds = build(False)
+        g_ref = sd_ref.calculate_gradients(feeds, "loss")
+        sd, _ = build(True)
+        g_opt = sd.calculate_gradients(feeds, "loss")
+        assert set(g_ref) == set(g_opt)
+        for kk in g_ref:
+            np.testing.assert_allclose(g_opt[kk], g_ref[kk],
+                                       rtol=1e-5, atol=1e-6)
+
+
+class TestAutocast:
+    def _mlp(self, optimize=True, passes=None):
+        r = np.random.RandomState(4)
+        sd = SameDiff(optimize=optimize, optimize_passes=passes)
+        x = sd.placeholder("x", (8, 32))
+        w1 = sd.var("w1", (r.randn(32, 32) * 0.2).astype(np.float32))
+        w2 = sd.var("w2", (r.randn(32, 4) * 0.2).astype(np.float32))
+        h = sd.math.tanh(x @ w1)
+        sd.nn.softmax(h @ w2, axis=-1).rename("out")
+        feeds = {"x": r.randn(8, 32).astype(np.float32)}
+        return sd, feeds
+
+    def test_off_by_default(self):
+        sd, feeds = self._mlp()
+        sd.output(feeds, ["out"])
+        assert "autocast" not in default_passes()
+        assert "autocast" not in sd.last_compile_stats.passes
+        assert sd.last_compile_stats.fusions.get("autocast_casts", 0) == 0
+
+    def test_env_opt_in_bf16_tolerance(self, monkeypatch):
+        ref_sd, feeds = self._mlp(optimize=False)
+        ref = ref_sd.output(feeds, ["out"])["out"]
+        monkeypatch.setenv("DL4J_TPU_AUTOCAST", "bf16")
+        assert "autocast" in default_passes()
+        sd, _ = self._mlp()
+        got = sd.output(feeds, ["out"])["out"]
+        st = sd.last_compile_stats
+        assert st.fusions.get("autocast_casts", 0) >= 2
+        assert "autocast" in st.passes
+        # bf16 matmul math, f32 interface: dtype preserved, values within
+        # bf16 tolerance but NOT bit-identical
+        assert got.dtype == np.float32
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=1e-2)
+
+    def test_explicit_pass_list(self):
+        ref_sd, feeds = self._mlp(optimize=False)
+        ref = ref_sd.output(feeds, ["out"])["out"]
+        sd, _ = self._mlp(passes=PASS_ORDER + OPTIONAL_PASSES)
+        got = sd.output(feeds, ["out"])["out"]
+        assert sd.last_compile_stats.fusions.get("autocast_casts", 0) >= 2
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=1e-2)
+
+    def test_softmax_inputs_stay_f32(self, monkeypatch):
+        # the dtype policy: matmuls go bf16, the normalizer consumes the
+        # f32 cast-back — no softmax may read a bf16-producing node
+        monkeypatch.setenv("DL4J_TPU_AUTOCAST", "bf16")
+        sd, feeds = self._mlp()
+        sd.output(feeds, ["out"])
+        plan = _plan(sd)
+        producer = {o: n for n in plan.nodes for o in n.outputs}
+        softmaxes = [n for n in plan.nodes if n.op == "softmax"]
+        assert softmaxes
+        for n in softmaxes:
+            p = producer.get(n.inputs[0])
+            assert p is not None and p.op == "cast" \
+                and p.kwargs["dtype"] == "float32"
+
+    def test_invariant_checker_accepts_autocast(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_AUTOCAST", "bf16")
+        sd, feeds = self._mlp()
+        sd.output(feeds, ["out"])  # would raise PassInvariantError on a
+        assert sd.last_compile_stats.invariant_checks > 0  # dtype break
+
+
+class TestStatsAndObserve:
+    def test_fusions_in_to_dict_and_counter_family(self):
+        from deeplearning4j_tpu import observe
+
+        before = observe.metrics().counter(
+            "dl4j_tpu_graph_fusions_total", kind="attention").value
+        sd, feeds = _attention_graph()
+        sd.output(feeds, ["out"])
+        d = sd.last_compile_stats.to_dict()
+        assert d["fusions"].get("attention") == 1
+        after = observe.metrics().counter(
+            "dl4j_tpu_graph_fusions_total", kind="attention").value
+        assert after == before + 1
+
+    def test_compile_event_carries_fusions(self):
+        from deeplearning4j_tpu import observe
+
+        sd, feeds = _attention_graph()
+        sd.output(feeds, ["out"])
+        evs = [ev for ev in observe.ledger().events()
+               if ev.stats is sd.last_compile_stats]
+        assert evs
+        assert evs[-1].to_dict()["fusions"].get("attention") == 1
+
+    def test_fusion_pass_idempotent_at_fixpoint(self):
+        # the fixpoint loop re-runs fusion on its own output: node count
+        # and fusion hit counts must be stable (each chain fused once)
+        sd, feeds = _attention_graph()
+        sd.output(feeds, ["out"])
+        st = sd.last_compile_stats
+        assert st.fusions.get("attention") == 1
+        assert _plan_ops(sd).count("dot_product_attention") == 1

@@ -1,0 +1,127 @@
+"""Pallas fused matmul epilogue (ops/pallas_matmul.py): interpret-mode
+kernel vs the XLA generic and a numpy oracle, the platform-helper usable()
+gate, the custom-vjp backward, and the registry wiring.
+
+No TPU in CI: the kernel runs in interpret mode (same code path, Mosaic
+lowering unverified here — covered by the on-chip consistency suite)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deeplearning4j_tpu  # noqa: F401 — registry + platform registration
+from deeplearning4j_tpu.environment import environment
+from deeplearning4j_tpu.ops.nn_ops import fused_matmul_bias_act
+from deeplearning4j_tpu.ops.pallas_matmul import (
+    _usable, fused_matmul_bias_act_pallas, fused_matmul_helper)
+from deeplearning4j_tpu.ops.registry import registry
+
+M, K, N = 16, 128, 128
+
+
+def _data(seed=0):
+    r = np.random.RandomState(seed)
+    return (r.randn(M, K).astype(np.float32),
+            (r.randn(K, N) * 0.1).astype(np.float32),
+            r.randn(N).astype(np.float32))
+
+
+class TestKernelEquivalence:
+    @pytest.mark.parametrize("act", ["none", "relu", "tanh", "gelu",
+                                     "gelu_exact"])
+    def test_interpret_matches_generic(self, act):
+        x, w, b = _data()
+        want = np.asarray(fused_matmul_bias_act(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), activation=act))
+        got = np.asarray(fused_matmul_bias_act_pallas(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), activation=act,
+            interpret=True))
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-5)
+
+    def test_3d_batch_fold(self):
+        r = np.random.RandomState(1)
+        x = r.randn(2, 8, K).astype(np.float32)
+        _, w, b = _data()
+        want = np.asarray(fused_matmul_bias_act(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+            activation="relu"))
+        got = np.asarray(fused_matmul_bias_act_pallas(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+            activation="relu", interpret=True))
+        assert got.shape == (2, 8, N)
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-5)
+
+    def test_no_bias(self):
+        x, w, _ = _data()
+        want = x @ w
+        got = np.asarray(fused_matmul_bias_act_pallas(
+            jnp.asarray(x), jnp.asarray(w), None, interpret=True))
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-4)
+
+    def test_f32_accumulation_bf16_inputs(self):
+        # bf16 operands, f32 accumulator: the kernel's dot must not lose
+        # more than bf16-input precision over a K=128 reduction
+        x, w, b = _data(2)
+        xb, wb = jnp.asarray(x, jnp.bfloat16), jnp.asarray(w, jnp.bfloat16)
+        want = np.asarray(
+            jnp.matmul(xb, wb, preferred_element_type=jnp.float32)
+            + jnp.asarray(b))
+        got = np.asarray(fused_matmul_bias_act_pallas(
+            xb, wb, jnp.asarray(b), interpret=True)).astype(np.float32)
+        np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+class TestBackward:
+    def test_custom_vjp_matches_generic_grads(self):
+        x, w, b = _data(3)
+
+        def loss_fused(x_, w_, b_):
+            return jnp.sum(fused_matmul_helper(
+                x_, w_, b_, activation="gelu_exact") ** 2)
+
+        def loss_ref(x_, w_, b_):
+            return jnp.sum(fused_matmul_bias_act(
+                x_, w_, b_, activation="gelu_exact") ** 2)
+
+        args = (jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        g_f = jax.grad(loss_fused, argnums=(0, 1, 2))(*args)
+        g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(*args)
+        for gf, gr in zip(g_f, g_r):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                       rtol=1e-2, atol=1e-3)
+
+
+class TestDispatch:
+    def test_usable_gate(self):
+        x, w, b = (jnp.zeros((M, K)), jnp.zeros((K, N)), jnp.zeros(N))
+        assert _usable(x, w, b)
+        assert not _usable(x, w, b, transpose_b=True)
+        assert not _usable(jnp.zeros((7, K)), w, b)          # M % 8
+        assert not _usable(jnp.zeros((M, 100)), jnp.zeros((100, N)), b)
+        assert not _usable(x, w, jnp.zeros((1, N)))           # bias rank
+        assert not _usable(x, w, b, activation="swish")
+
+    def test_registered_as_tpu_platform_helper(self):
+        desc = registry().get("fused_matmul_bias_act")
+        assert "tpu" in desc.platform_impls
+
+    def test_forced_pallas_resolves_to_kernel_on_cpu(self):
+        desc = registry().get("fused_matmul_bias_act")
+        x, w, b = _data(4)
+        env = environment()
+        prev = env.helper_mode
+        env.helper_mode = "pallas"
+        try:
+            impl = desc.resolve(jnp.asarray(x), jnp.asarray(w),
+                                jnp.asarray(b))
+        finally:
+            env.helper_mode = prev
+        assert impl is fused_matmul_helper
+
+    def test_generic_on_cpu_by_default(self):
+        desc = registry().get("fused_matmul_bias_act")
+        x, w, b = _data(5)
+        impl = desc.resolve(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        assert impl is desc.fn

@@ -132,6 +132,7 @@ def _summarize_log(path: str, json_mode: bool) -> int:
     """Post-hoc summary of a DL4J_TPU_OBS_LOG JSONL file."""
     kinds: "_Counter[str]" = _Counter()
     causes: "_Counter[str]" = _Counter()
+    fusion_hits: "_Counter[str]" = _Counter()
     train_steps = 0
     serving_rows = 0
     bad = 0
@@ -149,12 +150,17 @@ def _summarize_log(path: str, json_mode: bool) -> int:
             kinds[kind] += 1
             if kind == "recompile":
                 causes[rec.get("cause", "?")] += 1
+                # fusion-tier hits ride the recompile event (CompileEvent
+                # carries the live OptimizeStats.fusions section)
+                for fk, fv in (rec.get("fusions") or {}).items():
+                    fusion_hits[fk] += int(fv)
             elif kind == "train_epoch":
                 train_steps += int(rec.get("steps", 0))
             elif kind == "serving_batch":
                 serving_rows += int(rec.get("rows", 0))
     out = {"tool": "obsreport", "log": path, "events": sum(kinds.values()),
            "by_kind": dict(kinds), "recompile_causes": dict(causes),
+           "fusion_hits": dict(fusion_hits),
            "train_steps": train_steps, "serving_rows": serving_rows,
            "unparsable_lines": bad}
     if json_mode:
@@ -166,6 +172,10 @@ def _summarize_log(path: str, json_mode: bool) -> int:
         if causes:
             print("  recompile causes: "
                   + ", ".join(f"{k}={v}" for k, v in sorted(causes.items())))
+        if fusion_hits:
+            print("  fusion hits: "
+                  + ", ".join(f"{k}={v}"
+                              for k, v in sorted(fusion_hits.items())))
         print(f"  train steps: {train_steps}; serving rows: {serving_rows}")
         if bad:
             print(f"  WARNING: {bad} unparsable lines")
