@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -27,6 +27,24 @@ check-baseline:
 # recompile-ledger events, and serving percentiles all came out nonzero.
 obs-smoke:
 	JAX_PLATFORMS=cpu python tools/obsreport.py --json
+
+# kernel-autotuner smoke (docs/KERNELS.md): tiny-shape tune on CPU — must
+# exit 0 anywhere, produce a valid tuning table in the (throwaway by
+# default) cache dir, and PROVE via the dispatch counters that resolve
+# honors the tuned flash_min_t (XLA below, Pallas above). ONE JSON line
+# like lint/check. The throwaway dir matters: smoke thresholds are
+# interpret-mode noise and must never clobber a real measured table in
+# ~/.cache (set DL4J_TPU_TUNING_DIR yourself to keep the smoke table).
+tune-smoke:
+	JAX_PLATFORMS=cpu \
+	DL4J_TPU_TUNING_DIR=$${DL4J_TPU_TUNING_DIR:-$$(mktemp -d -t dl4j_tune_smoke.XXXXXX)} \
+	python tools/tune.py --smoke --json
+
+# full-ladder autotune — run ON THE TARGET CHIP; writes the measured table
+# for this device kind to DL4J_TPU_TUNING_DIR (commit a copy under
+# deeplearning4j_tpu/ops/tuning_tables/<kind>.json to ship it as default)
+tune:
+	python tools/tune.py
 
 # generative-serving smoke (docs/SERVING.md): continuous-batching
 # generation, smoke-sized, CPU-pinned — ONE JSON line with tokens/sec,

@@ -150,6 +150,40 @@ def fused_graph_sym_batch(seq: int = 32, d: int = 64, heads: int = 4,
     return sd
 
 
+def tuned_kernels_sym_batch(d: int = 128) -> SameDiff:
+    """The PR-9 kernel set as a symbolic-batch graph: ``fused_layer_norm``
+    (+gelu epilogue), the int8 serving matmul (``quantize_int8`` →
+    ``matmul_int8``) and a ``fused_updater_step`` leaf. Verifying this with
+    ZERO findings proves the first-class rules cover the new registry ops
+    natively — no ``jax.eval_shape`` probe fallback (which cannot run over
+    the symbolic batch dim)."""
+    r = np.random.RandomState(9)
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(None, d))
+    g = sd.var("ln_g", np.ones(d, np.float32))
+    b = sd.var("ln_b", np.zeros(d, np.float32))
+    h = sd.op("fused_layer_norm", x, g, b, axis=-1, eps=1e-5,
+              activation="gelu")
+    w = sd.var("w", (r.randn(d, d) * d ** -0.5).astype(np.float32))
+    # the keepdims (1, N) scale straight out of quantize: the matmul_int8
+    # rule and impls both accept it — no reshape glue needed
+    wq, ws = sd.op("quantize_int8", w, axis=0, n_out=2)
+    sd.op("matmul_int8", h, wq, ws).rename("y")
+    # one fused optimizer leaf (concrete shapes — updater state has no
+    # batch dim); Adam: state rides sorted as (m, v)
+    p = sd.var("p", (r.randn(d) * 0.1).astype(np.float32))
+    gr = sd.var("grad", (r.randn(d) * 0.01).astype(np.float32))
+    m0 = sd.var("m0", np.zeros(d, np.float32))
+    v0 = sd.var("v0", np.zeros(d, np.float32))
+    lr = sd.constant(np.float32(1e-3))
+    step = sd.constant(np.float32(0.0))
+    new_p, _m1, _v1 = sd.op("fused_updater_step", p, gr, lr, step, m0, v0,
+                            kind="Adam", n_out=3)
+    new_p.rename("new_p")
+    sd.graph_inputs, sd.graph_outputs = ["x"], ["y", "new_p"]
+    return sd
+
+
 def shape_chain() -> SameDiff:
     """numpy-static shape arithmetic: shape_of → unstack → stack →
     reshape_dynamic — the constant-env surface."""
@@ -214,6 +248,7 @@ def clean_fixtures() -> List[Tuple[str, Any]]:
         ("zoo/cnn_sym_batch", cnn_sym_batch()),
         ("zoo/bert_encoder_sym_batch", bert_encoder_sym_batch()),
         ("zoo/fused_graph_sym_batch", fused_graph_sym_batch()),
+        ("zoo/tuned_kernels_sym_batch", tuned_kernels_sym_batch()),
         ("zoo/shape_chain", shape_chain()),
         ("onnx/mini_mlp", onnx_mini_import()),
     ]

@@ -884,6 +884,135 @@ def _fused_matmul_bias_act(node, ins, emit):
     return [AVal(shape, dt)]    # and "relu" keep integer inputs integral
 
 
+@op_rule("fused_layer_norm")
+def _fused_layer_norm(node, ins, emit):
+    from deeplearning4j_tpu.ops.nn_ops import FUSED_MATMUL_ACTIVATIONS
+
+    x = ins[0]
+    act = node.kwargs.get("activation", "none")
+    if act not in FUSED_MATMUL_ACTIVATIONS:
+        emit("GC001", f"'fused_layer_norm': unknown activation '{act}'; "
+                      f"valid: {list(FUSED_MATMUL_ACTIVATIONS)}")
+    axis = node.kwargs.get("axis", -1)
+    if x.rank is not None and axis not in (-1, x.rank - 1):
+        emit("GC001", f"'fused_layer_norm': trailing-axis only (the impl "
+                      f"raises for axis={axis} at rank {x.rank}); use the "
+                      f"catalog layer_norm for other axes")
+    for what, a in [("gain", ins[1])] + \
+            ([("bias", ins[2])] if len(ins) > 2 else []):
+        if a.rank is not None and a.rank != 1:
+            emit("GC001", f"'fused_layer_norm': {what} must be rank 1, "
+                          f"got {fmt_shape(a.shape)}")
+        elif x.shape is not None and a.shape is not None and \
+                dims_provably_unequal(a.shape[0], x.shape[-1]):
+            emit("GC002", f"'fused_layer_norm': {what} "
+                          f"{fmt_shape(a.shape)} does not match the "
+                          f"normalized dim of x {fmt_shape(x.shape)}")
+    return [AVal(x.shape, _float_result(x.dtype))]
+
+
+@op_rule("fused_updater_step")
+def _fused_updater_step(node, ins, emit):
+    # (param, grad, lr, step, *state) -> (new_param, *new_state): every
+    # array leaf keeps the param's shape/dtype; lr/step are traced scalars
+    p = ins[0]
+    state = ins[4:]
+    kind = node.kwargs.get("kind", "Sgd")
+    from deeplearning4j_tpu.nn.updater import UPDATERS
+
+    if kind not in UPDATERS:
+        emit("GC001", f"'fused_updater_step': unknown updater kind "
+                      f"'{kind}'; valid: {sorted(UPDATERS)}")
+    else:
+        from deeplearning4j_tpu.ops.pallas_updater import _updater_and_keys
+
+        try:
+            _, keys = _updater_and_keys(
+                kind, tuple(sorted((k, v) for k, v in node.kwargs.items()
+                                   if k != "kind")))
+        except (ValueError, TypeError):
+            keys = None  # bad hyperparams: the impl raises its own error
+        if keys is not None and len(state) != len(keys):
+            emit("GC001", f"'fused_updater_step[{kind}]': expected "
+                          f"{len(keys)} state arrays {list(keys)}, got "
+                          f"{len(state)} — the trace will raise")
+    for what, a in [("grad", ins[1])] + \
+            [(f"state[{i}]", s) for i, s in enumerate(state)]:
+        if p.shape is None or a.shape is None:
+            continue
+        # rank first — zip would silently truncate a rank mismatch
+        if len(a.shape) != len(p.shape) or any(
+                dims_provably_unequal(d1, d2)
+                for d1, d2 in zip(p.shape, a.shape)):
+            emit("GC002", f"'fused_updater_step': {what} "
+                          f"{fmt_shape(a.shape)} does not match param "
+                          f"{fmt_shape(p.shape)}")
+    for what, a in (("lr", ins[2]), ("step", ins[3])):
+        if a.rank is not None and a.rank != 0:
+            emit("GC001", f"'fused_updater_step': {what} must be a scalar, "
+                          f"got {fmt_shape(a.shape)}")
+    return [AVal(p.shape, p.dtype)] + \
+        [AVal(s.shape if s.shape is not None else p.shape,
+              s.dtype if s.dtype is not None else p.dtype) for s in state]
+
+
+@op_rule("quantize_int8")
+def _quantize_int8(node, ins, emit):
+    x = ins[0]
+    axis = node.kwargs.get("axis")
+    if axis is None:
+        scale_shape: Optional[Shape] = ()
+    elif x.shape is not None:
+        # the impl accepts an int or a tuple of axes (jnp.max semantics)
+        axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+        normed = [_norm_axis(int(a), len(x.shape)) for a in axes]
+        if any(a is None for a in normed):
+            emit("GC001", f"'quantize_int8': axis {axis} out of range for "
+                          f"{fmt_shape(x.shape)}")
+            scale_shape = None
+        else:
+            keep = set(normed)
+            scale_shape = tuple(1 if i in keep else d
+                                for i, d in enumerate(x.shape))
+    else:
+        scale_shape = None
+    return [AVal(x.shape, np.dtype(np.int8)), AVal(scale_shape, _F32)]
+
+
+@op_rule("dequantize_int8")
+def _dequantize_int8(node, ins, emit):
+    q, scale = ins[0], ins[1]
+    shape = q.shape
+    if q.shape is not None and scale.shape is not None:
+        try:
+            shape = broadcast_shapes([q.shape, scale.shape])
+        except BroadcastError as e:
+            emit("GC002", f"'dequantize_int8': scale "
+                          f"{fmt_shape(scale.shape)} does not broadcast "
+                          f"onto q {fmt_shape(q.shape)} ({e.detail})")
+            shape = None
+    return [AVal(shape, _F32)]
+
+
+@op_rule("matmul_int8")
+def _matmul_int8(node, ins, emit):
+    x, wq = ins[0], ins[1]
+    if wq.dtype is not None and wq.dtype != np.dtype(np.int8):
+        emit("GC003", f"'matmul_int8': weights must be int8, got {wq.dtype}")
+    if len(ins) > 2 and ins[2].rank is not None:
+        ws = ins[2]
+        # (N,) or the keepdims (1, N) that quantize_int8(axis=0) emits —
+        # the impl reshapes to (1, N) either way
+        ok = ws.rank == 1 or (
+            ws.rank == 2 and ws.shape is not None
+            and not dims_provably_unequal(ws.shape[0], 1))
+        if not ok:
+            emit("GC001", f"'matmul_int8': w_scale must be (N,) or (1, N), "
+                          f"got {fmt_shape(ws.shape)}")
+    shape = _matmul_shape(x.shape, wq.shape, emit, "'matmul_int8'")
+    return [AVal(shape, x.dtype)]  # de-scale casts back to x's dtype
+
+
 # ---------------------------------------------------------------------------
 # conv / pool (NHWC, matching ops/nn_ops.py)
 # ---------------------------------------------------------------------------

@@ -997,6 +997,52 @@ def _try_epilogue(gv: _GraphView, add_idx: int, add):
     return None
 
 
+_LN_OPS = ("layer_norm", "layer_norm_graph")
+
+
+def _try_layernorm(gv: _GraphView, ln_idx: int, ln):
+    """Match ``gelu(layer_norm(x, gain[, bias]))`` — a trailing-axis
+    layer_norm whose single consumer is a gelu node (or the decomposed
+    erf-gelu chain exporters emit) becomes ONE ``fused_layer_norm`` node:
+    the Pallas one-HBM-pass LN(+activation) kernel on TPU
+    (ops/pallas_layernorm.py), the exact same op chain via XLA elsewhere.
+    Plain layer_norm without an activation is left verbatim — there is no
+    epilogue to fuse.
+
+    Returns ``(removed_idxs, fused_node)`` or None."""
+    if not gv.is_op(ln, *_LN_OPS) or len(ln.inputs) not in (2, 3):
+        return None
+    xa = gv.aval(ln.inputs[0])
+    if xa is None or xa.rank is None:
+        return None
+    axis = ln.kwargs.get("axis", -1)
+    if axis not in (-1, xa.rank - 1):
+        return None  # only trailing-axis norms map onto the fused kernel
+    h_name = ln.outputs[0]
+    removed = {ln_idx}
+    # single_consumer enforces interior for the plain-gelu form;
+    # _match_erf_gelu enforces its own exactly-two-consumers + non-output
+    # contract for the decomposed chain (both branches of h feed the chain)
+    act = gv.single_consumer(h_name)
+    if act is not None and gv.is_op(act[1], "gelu") and \
+            len(act[1].inputs) == 1 and not act[1].kwargs:
+        activation = "gelu"
+        removed.add(act[0])
+        out_node = act[1]
+    else:
+        gelu = _match_erf_gelu(gv, h_name)
+        if gelu is None:
+            return None
+        activation = "gelu_exact"
+        removed |= gelu[0]
+        out_node = gelu[1]
+    fused = _Node_like(ln, "fused_layer_norm", list(ln.inputs),
+                       {"axis": -1, "eps": ln.kwargs.get("eps", 1e-5),
+                        "activation": activation},
+                       list(out_node.outputs))
+    return removed, fused
+
+
 def _pass_workspace(nodes, const_vals, var_shapes, seed_dtypes,
                     input_avals, local_ops):
     """(avals, namer) for one fusion/autocast pass application: the
@@ -1014,21 +1060,25 @@ def _pass_workspace(nodes, const_vals, var_shapes, seed_dtypes,
 def _fusion(nodes, outputs, const_vals, var_shapes, seed_dtypes,
             input_avals, alias, local_ops, stats):
     """The fusion tier: attention first (its chain contains matmuls the
-    epilogue matcher must not claim), then matmul epilogues, one linear
-    scan each. Rewrites splice in place: removed nodes drop out, synthesized
-    nodes land immediately before the fused node, output names are
-    preserved so downstream consumers (and the alias map) never move."""
-    # every pattern anchors on a catalog mmul; graphs without one (conv
-    # nets, elementwise chains, most train steps) skip the abstract
-    # interpretation entirely — fusion is on the default compile path
-    if not any(n.op == "mmul" and n.op not in local_ops for n in nodes):
+    epilogue matcher must not claim), then matmul epilogues, then
+    layer_norm(+gelu) chains, one linear scan each. Rewrites splice in
+    place: removed nodes drop out, synthesized nodes land immediately
+    before the fused node, output names are preserved so downstream
+    consumers (and the alias map) never move."""
+    # every pattern anchors on a catalog mmul or layer_norm; graphs with
+    # neither (conv nets, elementwise chains, most train steps) skip the
+    # abstract interpretation entirely — fusion is on the default compile
+    # path
+    if not any(n.op not in local_ops and (n.op == "mmul" or n.op in _LN_OPS)
+               for n in nodes):
         return nodes, False
     avals, namer = _pass_workspace(nodes, const_vals, var_shapes,
                                    seed_dtypes, input_avals, local_ops)
     changed = False
 
     for matcher, kind in ((_try_attention, "attention"),
-                          (_try_epilogue, "epilogue")):
+                          (_try_epilogue, "epilogue"),
+                          (_try_layernorm, "layernorm")):
         gv = _GraphView(nodes, outputs, alias, const_vals, avals, local_ops)
         mask_cache: Dict[Any, str] = {}
         rewrites = {}   # anchor idx -> (removed, synth, fused)
@@ -1358,7 +1408,7 @@ def optimize_graph(nodes, outputs: Sequence[str], *,
     m.histogram("dl4j_tpu_graph_optimize_seconds").observe(
         stats.optimize_seconds)
     # fusion-tier hit counters (labelled family: kind=attention|epilogue|
-    # autocast_casts) — docs/OBSERVABILITY.md
+    # layernorm|autocast_casts) — docs/OBSERVABILITY.md
     for kind, hits in stats.fusions.items():
         m.counter("dl4j_tpu_graph_fusions_total", kind=kind).inc(hits)
     observe.tracer().complete_between(

@@ -1190,10 +1190,12 @@ class SameDiff:
                     g = g + tc.l2 * w
                 if tc.l1:
                     g = g + tc.l1 * jnp.sign(w)
-                u, s = upd.apply(g, upd_state[n], lr, step)
+                # fused updater step (ops/pallas_updater.py): one kernel
+                # pass per leaf on TPU, the identical apply() math elsewhere
+                nw, s = upd.apply_fused(w, g, upd_state[n], lr, step)
                 if tc.weight_decay:
-                    u = u + lr * tc.weight_decay * w
-                new_vars[n] = w - u
+                    nw = nw - lr * tc.weight_decay * w
+                new_vars[n] = nw
                 new_state[n] = s
             return new_vars, new_state, loss
 

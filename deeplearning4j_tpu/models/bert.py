@@ -216,8 +216,11 @@ class BertModel:
             flat_s = treedef.flatten_up_to(opt_state)
             new_p, new_s = [], []
             for pw, gw, sw in zip(flat_p, flat_g, flat_s):
-                u, ns = upd.apply(gw, sw, lr, step)
-                new_p.append((pw - u).astype(pw.dtype))
+                # fused step (ops/pallas_updater.py): one kernel pass per
+                # leaf on TPU, identical apply() math elsewhere; astype
+                # pins bf16 params against f32 update promotion
+                npw, ns = upd.apply_fused(pw, gw, sw, lr, step)
+                new_p.append(npw.astype(pw.dtype))
                 new_s.append(ns)
             return treedef.unflatten(new_p), treedef.unflatten(new_s), loss
 
@@ -258,8 +261,11 @@ class BertModel:
             flat_s = treedef.flatten_up_to(opt_state)
             new_p, new_s = [], []
             for pw, gw, sw in zip(flat_p, flat_g, flat_s):
-                u, ns = upd.apply(gw, sw, lr, step)
-                new_p.append((pw - u).astype(pw.dtype))
+                # fused step (ops/pallas_updater.py): one kernel pass per
+                # leaf on TPU, identical apply() math elsewhere; astype
+                # pins bf16 params against f32 update promotion
+                npw, ns = upd.apply_fused(pw, gw, sw, lr, step)
+                new_p.append(npw.astype(pw.dtype))
                 new_s.append(ns)
             return treedef.unflatten(new_p), treedef.unflatten(new_s), loss
 

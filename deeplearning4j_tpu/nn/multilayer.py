@@ -101,12 +101,14 @@ def apply_layer_updates(conf, items, step, normalize_fn):
         flat_p, treedef = jax.tree.flatten(p)
         flat_g = treedef.flatten_up_to(g)
         flat_s = treedef.flatten_up_to(s)
-        ups, news = [], []
+        new_p, news = [], []
         for pw, gw, sw in zip(flat_p, flat_g, flat_s):
-            u, ns = upd.apply(gw, sw, lr, step)
-            ups.append(u)
+            # fused step: the registry op's TPU helper runs the whole
+            # updater chain as ONE kernel pass per leaf when the tuning
+            # table says it wins; generic impl = the identical apply() math
+            npw, ns = upd.apply_fused(pw, gw, sw, lr, step)
+            new_p.append(npw)
             news.append(ns)
-        new_p = [pw - u for pw, u in zip(flat_p, ups)]
         if wd:
             rebuilt = _map_weights(lambda w, w0: w - lr * wd * w0,
                                    treedef.unflatten(new_p),

@@ -190,6 +190,15 @@ _SCHEDULES["MapSchedule"] = MapSchedule._from  # type: ignore[assignment]
 # ---------------------------------------------------------------------------
 
 
+def _fused_updater_enabled() -> bool:
+    """``DL4J_TPU_FUSED_UPDATER`` opt-out, read at trace time (train steps
+    re-read it only on recompile — same contract as the fusion passes)."""
+    import os
+
+    v = os.environ.get("DL4J_TPU_FUSED_UPDATER", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
 @dataclasses.dataclass(frozen=True)
 class Updater:
     """Base updater config. Subclasses define the exact reference math.
@@ -213,6 +222,40 @@ class Updater:
     def apply(self, grad, state, lr, step):
         """Return (update, new_state); params -= update downstream."""
         raise NotImplementedError
+
+    # -- fused step (ops/pallas_updater.py) ---------------------------------
+    def _fusable(self) -> bool:
+        """Only the exact catalog classes route through the registry op: a
+        user subclass overriding ``apply`` must keep its override."""
+        return UPDATERS.get(type(self).__name__) is type(self)
+
+    def fused_hyper(self) -> Dict[str, float]:
+        """Constructor fields as static kwargs for the fused registry op
+        (``learning_rate`` excluded — the scheduled lr rides as a traced
+        scalar)."""
+        return {f.name: float(getattr(self, f.name))
+                for f in dataclasses.fields(self)
+                if f.name != "learning_rate"}
+
+    def apply_fused(self, param, grad, state, lr, step):
+        """One fused optimizer step: ``(new_param, new_state)``.
+
+        Routes through the ``fused_updater_step`` registry op so the TPU
+        platform helper (one Pallas kernel reading param/grad/state once)
+        can take the leaf when the tuning table says it wins; the generic
+        impl calls this class's own ``apply``, so trajectories are
+        bit-identical to the unfused path everywhere. Opt-out:
+        ``DL4J_TPU_FUSED_UPDATER=0`` (falls back to ``apply`` inline)."""
+        if _fused_updater_enabled() and self._fusable():
+            from deeplearning4j_tpu.ops.registry import registry
+
+            keys = sorted(state)
+            out = registry().get("fused_updater_step")(
+                param, grad, lr, step, *(state[k] for k in keys),
+                kind=type(self).__name__, **self.fused_hyper())
+            return out[0], dict(zip(keys, out[1:]))
+        u, new_state = self.apply(grad, state, lr, step)
+        return param - u, new_state
 
     def to_dict(self) -> Dict[str, Any]:
         d = {}

@@ -64,16 +64,37 @@ def _ms(seconds) -> Any:
     return None if seconds is None else round(seconds * 1e3, 3)
 
 
+def dispatch_summary() -> Dict[str, int]:
+    """Helper-dispatch decisions (``dl4j_tpu_helper_dispatch_total``) as a
+    compact ``op/impl/reason -> count`` map — how many times resolve picked
+    the Pallas helper vs the XLA generic, and why. A routing regression
+    (e.g. flash silently deferring everywhere after a threshold change)
+    shows up here instead of only as a throughput delta."""
+    out: Dict[str, int] = {}
+    for inst in metrics().instruments():
+        if inst.name != "dl4j_tpu_helper_dispatch_total":
+            continue
+        lbl = dict(inst.labels)
+        key = f"{lbl.get('op')}/{lbl.get('impl')}/{lbl.get('reason')}"
+        out[key] = out.get(key, 0) + int(inst.value)
+    return dict(sorted(out.items()))
+
+
 def summary() -> Dict[str, Any]:
     """Compact cross-layer snapshot: recompiles, train-step latency
-    percentiles, serving latency percentiles. Empty sections are omitted —
-    the bench JSON line only carries what the run actually exercised."""
+    percentiles, serving latency percentiles, helper-dispatch decisions.
+    Empty sections are omitted — the bench JSON line only carries what the
+    run actually exercised."""
     m = metrics()
     out: Dict[str, Any] = {}
 
     led = ledger().summary()
     if led["total"]:
         out["recompiles"] = led
+
+    disp = dispatch_summary()
+    if disp:
+        out["dispatch"] = disp
 
     steps = m.family_total("dl4j_tpu_train_steps_total")
     if steps:
@@ -128,5 +149,5 @@ __all__ = [
     "CompileEvent", "RecompileLedger", "OBS_LOG_ENV",
     "metrics", "tracer", "ledger", "default_registry", "default_tracer",
     "default_ledger", "log_event", "note_jit_signature", "signature_of",
-    "summary", "reset",
+    "summary", "dispatch_summary", "reset",
 ]

@@ -20,14 +20,23 @@ from deeplearning4j_tpu.ops.weight_init import init_weights
 # Install the Pallas platform helpers (the cuDNN-helper-registration analog:
 # the reference registers platform overrides at library load — libnd4j
 # OpRegistrator static init). Deferred import keeps pallas optional.
+from deeplearning4j_tpu.ops import tuning
 from deeplearning4j_tpu.ops.pallas_attention import register_platform_attention
 from deeplearning4j_tpu.ops.pallas_matmul import register_platform_fused_matmul
+from deeplearning4j_tpu.ops.pallas_layernorm import (
+    register_platform_fused_layernorm)
+from deeplearning4j_tpu.ops.pallas_updater import (
+    register_platform_fused_updater)
+from deeplearning4j_tpu.ops.quantized import register_platform_quantized
 
 register_platform_attention()
 register_platform_fused_matmul()
+register_platform_fused_layernorm()
+register_platform_fused_updater()
+register_platform_quantized()
 
 __all__ = [
-    "registry", "op", "exec_op", "OpRegistry",
+    "registry", "op", "exec_op", "OpRegistry", "tuning",
     "nn_ops", "activations", "losses", "random", "compression", "weight_init",
     "get_activation", "ACTIVATIONS", "get_loss", "LOSSES", "init_weights",
 ]

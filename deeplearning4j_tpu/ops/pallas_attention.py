@@ -44,6 +44,7 @@ Runs in interpret mode off-TPU so CPU tests exercise the same code path.
 from __future__ import annotations
 
 import functools
+import logging
 import math
 from typing import Optional
 
@@ -55,27 +56,72 @@ from jax.experimental import pallas as pl
 # (VMEM scratch allocations); a build without it cannot run these kernels.
 from jax.experimental.pallas import tpu as pltpu
 
+logger = logging.getLogger(__name__)
+
 # Shortest kv length at which the Pallas kernel beats the XLA fused /
 # generic materialized paths on-chip. BENCH_HISTORY.json 'attention_sweep'
 # shows flash at 0.65-0.99x vs XLA below t=4096 (grid overhead dominates),
-# so the default crossover is 4096; override per device class with
-# DL4J_TPU_FLASH_MIN_T after re-running tools/bench_attention_sweep.py.
+# so the fallback crossover is 4096; the measured per-device value lives in
+# the tuning table (ops/tuning.py, refreshed by tools/tune.py or
+# tools/bench_attention_sweep.py) and DL4J_TPU_FLASH_MIN_T still wins.
 FLASH_MIN_T_DEFAULT = 4096
+
+# parse-once cache: (raw env string, device kind, resolved threshold).
+# Re-parsing (and re-warning) on every resolve call was the round-9 bugfix
+# target; the raw string keys the cache so env re-pointing and
+# monkeypatching stay live, and the device kind keys it because the tuned
+# fallback is per-device — a CPU-scoped resolve (the consistency suite
+# runs under jax.default_device(cpu)) must not pin the CPU table's
+# threshold for subsequent TPU resolves.
+_FLASH_MIN_T_CACHE: "Optional[tuple]" = None
+
+
+def reset_flash_min_t_cache() -> None:
+    """Test seam + tuning-table invalidation hook."""
+    global _FLASH_MIN_T_CACHE
+    _FLASH_MIN_T_CACHE = None
+
+
+def _tuned_flash_min_t() -> int:
+    from deeplearning4j_tpu.ops import tuning
+
+    return int(tuning.tuned("dot_product_attention", "flash_min_t",
+                            FLASH_MIN_T_DEFAULT))
 
 
 def flash_min_t() -> int:
     """Live dispatch threshold: kv lengths below this use the XLA path.
 
-    Read from the environment at resolve time (not import time) so a
-    serving process can be re-pointed at a re-measured crossover without
-    code changes, and tests can cover both sides of the boundary."""
+    Resolution order: ``DL4J_TPU_FLASH_MIN_T`` env override, then the
+    measured tuning table for the target device kind, then the checked-in
+    default. The parsed value is cached against the raw env string, so a
+    serving process can still be re-pointed without code changes but the
+    parse (and the invalid-value warning) happen once per distinct value,
+    not once per resolve call."""
     import os
 
-    v = os.environ.get("DL4J_TPU_FLASH_MIN_T")
-    try:
-        return int(v) if v else FLASH_MIN_T_DEFAULT
-    except ValueError:
-        return FLASH_MIN_T_DEFAULT
+    from deeplearning4j_tpu.ops import tuning
+
+    global _FLASH_MIN_T_CACHE
+    raw = os.environ.get("DL4J_TPU_FLASH_MIN_T")
+    # kind participates even with the env set: the invalid-raw fallback is
+    # the tuned (per-device) value too. jax memoizes the devices() probe.
+    kind = tuning.current_device_kind()
+    if _FLASH_MIN_T_CACHE is not None and _FLASH_MIN_T_CACHE[:2] == (raw,
+                                                                    kind):
+        return _FLASH_MIN_T_CACHE[2]
+    if raw:
+        try:
+            val = int(raw)
+        except ValueError:
+            val = _tuned_flash_min_t()
+            logger.warning(
+                "invalid DL4J_TPU_FLASH_MIN_T=%r — falling back to the "
+                "tuned/default threshold %d", raw, val)
+    else:
+        val = _tuned_flash_min_t()
+    _FLASH_MIN_T_CACHE = (raw, kind, val)
+    return val
 
 
 def _keep_mask(seed, bh, q0, k0, *, block_q: int, block_k: int, rate: float):
@@ -316,16 +362,26 @@ def _pad_to_blocks(q, k, v, kv_mask, block_q, block_k):
     return q, k, v, m, block_q, block_k, pad_q, pad_k
 
 
-def _default_blocks(block_q, block_k):
+def _default_blocks(block_q, block_k, t_kv: Optional[int] = None):
     """Default tile size 512 (capped to T by _pad_to_blocks): fewer, fatter
     grid steps amortize per-step overhead — measured 14.8 ms vs 26 ms
     (block 128) for a T=8192 d=64 forward on a v5e. The lane-1 mask/lse
     layouts were what made wide blocks OOM scoped VMEM before; with 128-lane
-    buffers every probed shape (T=512…8192, fwd+bwd) compiles at 512."""
-    if block_q is None:
-        block_q = 512
-    if block_k is None:
-        block_k = 512
+    buffers every probed shape (T=512…8192, fwd+bwd) compiles at 512.
+
+    When the caller passed no explicit block, the measured tuning table
+    (ops/tuning.py, keyed on device kind + kv-length bucket) overrides the
+    512 fallback — the autotuner's winners feed real dispatch."""
+    if block_q is None or block_k is None:
+        from deeplearning4j_tpu.ops import tuning
+
+        bucket = tuning.bucket_t(t_kv) if t_kv else None
+        if block_q is None:
+            block_q = int(tuning.tuned("dot_product_attention", "block_q",
+                                       512, bucket=bucket))
+        if block_k is None:
+            block_k = int(tuning.tuned("dot_product_attention", "block_k",
+                                       512, bucket=bucket))
     return block_q, block_k
 
 
@@ -514,7 +570,7 @@ def _flash_call(q, k, v, kv_mask, dropout_seed, scale, causal, block_q,
             f"causal flash attention requires t_q == t_kv, got "
             f"{q.shape[1]} vs {k.shape[1]}")
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _default_blocks(block_q, block_k)
+    block_q, block_k = _default_blocks(block_q, block_k, k.shape[1])
     seed = _norm_seed(dropout_seed, dropout_rate)
     return _flash_fwd(q, k, v, kv_mask, seed, scale=scale, causal=causal,
                       block_q=block_q, block_k=block_k,
@@ -532,7 +588,7 @@ def _fwd(q, k, v, kv_mask, dropout_seed, scale, causal, block_q, block_k,
 def _bwd(scale, causal, block_q, block_k, interpret, dropout_rate, res, g):
     q, k, v, kv_mask, dropout_seed, out, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _default_blocks(block_q, block_k)
+    block_q, block_k = _default_blocks(block_q, block_k, k.shape[1])
     seed = _norm_seed(dropout_seed, dropout_rate)
     dq, dk, dv = _flash_bwd(q, k, v, kv_mask, seed, out, lse, g, scale=s,
                             causal=causal, block_q=block_q, block_k=block_k,
@@ -703,10 +759,17 @@ def _paged_decode_call(q, k_pages, v_pages, page_table, seq_lens, *,
 
 def _paged_usable(q, k_pages, v_pages, page_table, seq_lens, **kw):
     """PlatformHelper::isUsable for the Pallas paged path: shapes must be
-    the documented ranks and the page/head-dim tiles Mosaic-aligned."""
+    the documented ranks, the page/head-dim tiles Mosaic-aligned, and the
+    page walk long enough to beat the XLA gather (measured min_pages from
+    the tuning table; default 1 = always, matching pre-tuning behavior)."""
     if getattr(q, "ndim", 0) != 3 or getattr(k_pages, "ndim", 0) != 4:
         return False
     if getattr(page_table, "ndim", 0) != 2 or getattr(seq_lens, "ndim", 0) != 1:
+        return False
+    from deeplearning4j_tpu.ops import tuning
+
+    if page_table.shape[1] < int(tuning.tuned("paged_decode_attention",
+                                              "min_pages", 1)):
         return False
     return q.shape[-1] % 8 == 0 and k_pages.shape[1] % 8 == 0
 
@@ -826,3 +889,10 @@ def register_platform_attention() -> None:
 
     if "dot_product_attention" in reg:
         reg.register_platform("dot_product_attention", "tpu", flash_dpa, usable)
+
+
+# tuned-value invalidation: a fresh tuning table (autotune save, test
+# reset) must drop the memoized flash_min_t parse along with the tables.
+from deeplearning4j_tpu.ops import tuning as _tuning
+
+_tuning.on_reset(reset_flash_min_t_cache)

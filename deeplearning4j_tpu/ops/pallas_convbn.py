@@ -90,9 +90,17 @@ def fused_bn_matmul_stats(x, scale, shift, w, stat_shift, *, relu: bool = True,
     """
     m, k_dim = x.shape
     n = w.shape[1]
-    bm = block_m or _pick_block(m)
-    bn = block_n or _pick_block(n, (256, 128, 64))
-    bk = block_k or _pick_block(k_dim, (512, 256, 128, 64))
+    from deeplearning4j_tpu.ops import tuning
+
+    bucket = tuning.bucket_mkn(m, k_dim, n)
+    bm = block_m or tuning.tuned_block("fused_bn_matmul_stats", "block_m",
+                                       m, bucket, _pick_block)
+    bn = block_n or tuning.tuned_block(
+        "fused_bn_matmul_stats", "block_n", n, bucket,
+        lambda s: _pick_block(s, (256, 128, 64)))
+    bk = block_k or tuning.tuned_block(
+        "fused_bn_matmul_stats", "block_k", k_dim, bucket,
+        lambda s: _pick_block(s, (512, 256, 128, 64)))
     if m % bm or n % bn or k_dim % bk:
         raise ValueError(f"shape ({m},{k_dim})x({k_dim},{n}) not divisible by "
                          f"blocks ({bm},{bk},{bn})")

@@ -89,9 +89,20 @@ def fused_matmul_bias_act_pallas(x, w, b=None, *, activation: str = "none",
     k_dim = x.shape[-1]
     n = w.shape[1]
     x2 = x.reshape(m, k_dim)
-    bm = block_m or _pick_block(m, (256, 128, 64, 32, 16, 8))
-    bn = block_n or _pick_block(n, (256, 128))
-    bk = block_k or _pick_block(k_dim, (512, 256, 128))
+    # measured block sizes (ops/tuning.py) when the caller passed none —
+    # validated against the real dims, falling back to the static pick
+    from deeplearning4j_tpu.ops import tuning
+
+    bucket = tuning.bucket_mkn(m, k_dim, n)
+    bm = block_m or tuning.tuned_block(
+        "fused_matmul_bias_act", "block_m", m, bucket,
+        lambda s: _pick_block(s, (256, 128, 64, 32, 16, 8)))
+    bn = block_n or tuning.tuned_block(
+        "fused_matmul_bias_act", "block_n", n, bucket,
+        lambda s: _pick_block(s, (256, 128)))
+    bk = block_k or tuning.tuned_block(
+        "fused_matmul_bias_act", "block_k", k_dim, bucket,
+        lambda s: _pick_block(s, (512, 256, 128)))
     if m % bm or n % bn or k_dim % bk:
         raise ValueError(f"shape ({m},{k_dim})x({k_dim},{n}) not divisible "
                          f"by blocks ({bm},{bk},{bn})")
@@ -199,6 +210,10 @@ def _usable(x, w, b=None, **kw):
     for d in x.shape[:-1]:
         m *= d
     k_dim, n = w.shape
+    from deeplearning4j_tpu.ops import tuning
+
+    if m < int(tuning.tuned("fused_matmul_bias_act", "pallas_min_m", 8)):
+        return False  # measured crossover: tiny row counts stay on XLA
     return m % 8 == 0 and k_dim % 128 == 0 and n % 128 == 0
 
 

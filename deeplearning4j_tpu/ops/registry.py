@@ -49,6 +49,18 @@ def current_platform() -> str:
     return jax.default_backend()
 
 
+def _note_dispatch(op: str, impl: str, reason: str) -> None:
+    """Dispatch-decision counter (dl4j_tpu_helper_dispatch_total) — only
+    helper-carrying ops call this, so the family stays small. Resolve runs
+    at trace time, so the increment costs nothing per executed step; a
+    pallas-vs-XLA routing regression shows up in /metrics, obsreport and
+    the bench JSON line instead of silently flipping throughput."""
+    from deeplearning4j_tpu import observe
+
+    observe.metrics().counter("dl4j_tpu_helper_dispatch_total",
+                              op=op, impl=impl, reason=reason).inc()
+
+
 @dataclasses.dataclass
 class OpDescriptor:
     """One declarable op: generic impl + optional platform (Pallas) overrides."""
@@ -62,8 +74,11 @@ class OpDescriptor:
 
     def resolve(self, *args: Any, **kwargs: Any) -> Callable[..., Any]:
         """Pick the implementation — the PlatformHelper::isUsable analog."""
+        if not self.platform_impls:
+            return self.fn  # helper-less op: no decision to make or count
         env = environment()
         if env.helper_mode == "xla":
+            _note_dispatch(self.name, "generic", "forced_xla")
             return self.fn
         backend = current_platform()
         impl_key = backend
@@ -71,19 +86,25 @@ class OpDescriptor:
         if impl is None and env.helper_mode == "pallas":
             impl_key = "tpu"
             impl = self.platform_impls.get("tpu")
-        if impl is not None:
-            # the usable() gate must come from the SAME table entry as the
-            # impl — looking it up under the current backend would silently
-            # skip the gate for the forced-pallas fallback path
-            usable = self.platform_usable.get(impl_key, lambda *a, **k: True)
-            try:
-                ok = usable(*args, **kwargs)
-            except Exception:  # pragma: no cover - defensive
-                ok = False
-            if ok:
-                if env.log_helper_selection:
-                    logger.info("op %s: selected %s platform helper", self.name, backend)
-                return impl
+        if impl is None:
+            _note_dispatch(self.name, "generic", "no_helper")
+            return self.fn
+        # the usable() gate must come from the SAME table entry as the
+        # impl — looking it up under the current backend would silently
+        # skip the gate for the forced-pallas fallback path
+        usable = self.platform_usable.get(impl_key, lambda *a, **k: True)
+        try:
+            ok = usable(*args, **kwargs)
+            reason = "usable" if ok else "not_usable"
+        except Exception:  # pragma: no cover - defensive
+            ok = False
+            reason = "usable_error"
+        if ok:
+            if env.log_helper_selection:
+                logger.info("op %s: selected %s platform helper", self.name, backend)
+            _note_dispatch(self.name, impl_key, reason)
+            return impl
+        _note_dispatch(self.name, "generic", reason)
         return self.fn
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:

@@ -18,6 +18,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Tests read tuning from a throwaway cache dir: a measured table left in the
+# user cache by `make tune-smoke` must not change dispatch thresholds under
+# test (the checked-in default table keeps untuned hosts deterministic).
+import tempfile
+
+os.environ["DL4J_TPU_TUNING_DIR"] = tempfile.mkdtemp(prefix="dl4j_tuning_test_")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,213 @@
+"""Fused updater step (ops/pallas_updater.py + nn/updater.py wiring).
+
+The generic registry op must be BIT-identical to the unfused
+``Updater.apply`` chain (it calls it); the Pallas interpret kernel must
+match at f32 1e-5 or better; the MLN / SameDiff train steps route through
+``apply_fused`` without changing trajectories."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deeplearning4j_tpu.ops  # noqa: F401 - registers catalog + helpers
+from deeplearning4j_tpu.nn.updater import UPDATERS, Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.ops.pallas_updater import (
+    fused_updater_helper, fused_updater_step)
+from deeplearning4j_tpu.ops.registry import registry
+
+
+def _leaf(kind, n=67, seed=0):
+    r = np.random.RandomState(seed)
+    upd = UPDATERS[kind]()
+    p = jnp.asarray(r.randn(n).astype(np.float32))
+    g = jnp.asarray((r.randn(n) * 0.01).astype(np.float32))
+    state = upd.init_state(p)
+    # a non-trivial state point: zeros hide asymmetric-state bugs
+    state = {k: jnp.asarray(np.abs(r.randn(n)).astype(np.float32)) * 0.1
+             for k in state}
+    return upd, p, g, state
+
+
+class TestAllKindsEquivalence:
+    @pytest.mark.parametrize("kind", sorted(UPDATERS))
+    def test_generic_matches_apply_exactly(self, kind):
+        upd, p, g, state = _leaf(kind)
+        keys = sorted(state)
+        lr, step = jnp.float32(1e-2), jnp.float32(3.0)
+        u, new = upd.apply(g, state, lr, step)
+        got = fused_updater_step.fn(p, g, lr, step,
+                                    *(state[k] for k in keys), kind=kind)
+        np.testing.assert_array_equal(np.asarray(got[0]),
+                                      np.asarray(p - u))
+        for k, a in zip(keys, got[1:]):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(new[k]))
+
+    @pytest.mark.parametrize("kind", sorted(UPDATERS))
+    def test_pallas_interpret_matches_generic(self, kind):
+        upd, p, g, state = _leaf(kind, seed=1)
+        keys = sorted(state)
+        lr, step = jnp.float32(1e-2), jnp.float32(3.0)
+        want = fused_updater_step.fn(p, g, lr, step,
+                                     *(state[k] for k in keys), kind=kind)
+        got = fused_updater_helper(p, g, lr, step,
+                                   *(state[k] for k in keys), kind=kind,
+                                   block_rows=8, interpret=True)
+        for w, a in zip(want, got):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_bf16_leaf_through_pallas_kernel(self):
+        """bf16 params/states must not crash the kernel (the f32 lr/step
+        promote the chain; stores cast back to the ref dtype)."""
+        r = np.random.RandomState(9)
+        p = jnp.asarray(r.randn(64).astype(np.float32)).astype(jnp.bfloat16)
+        g = jnp.asarray((r.randn(64) * 0.01).astype(np.float32)) \
+            .astype(jnp.bfloat16)
+        z = jnp.zeros((64,), jnp.bfloat16)
+        lr, step = jnp.float32(1e-2), jnp.float32(0.0)
+        got = fused_updater_helper(p, g, lr, step, z, z, kind="Adam",
+                                   block_rows=8, interpret=True)
+        want = fused_updater_step.fn(p, g, lr, step, z, z, kind="Adam")
+        assert got[0].dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got[0], np.float32),
+            np.asarray(want[0], np.float32), rtol=1e-2, atol=1e-3)
+
+    def test_hyperparams_thread_through(self):
+        _, p, g, state = _leaf("Adam", seed=2)
+        lr, step = jnp.float32(1e-3), jnp.float32(7.0)
+        upd = Adam(beta1=0.5, beta2=0.9, epsilon=1e-6)
+        u, _ = upd.apply(g, state, lr, step)
+        got = fused_updater_step.fn(p, g, lr, step, state["m"], state["v"],
+                                    kind="Adam", beta1=0.5, beta2=0.9,
+                                    epsilon=1e-6)
+        np.testing.assert_array_equal(np.asarray(got[0]),
+                                      np.asarray(p - u))
+
+    def test_grad_flows_through_generic(self):
+        """The op is differentiable wrt grad (the train step never needs
+        it, but the graph surface must not be a grad sink)."""
+        upd, p, g, state = _leaf("Adam", seed=3)
+        lr, step = jnp.float32(1e-2), jnp.float32(0.0)
+
+        def via_op(g_):
+            return jnp.sum(fused_updater_step.fn(
+                p, g_, lr, step, state["m"], state["v"], kind="Adam")[0])
+
+        def via_apply(g_):
+            u, _ = Adam().apply(g_, state, lr, step)
+            return jnp.sum(p - u)
+
+        np.testing.assert_allclose(np.asarray(jax.grad(via_op)(g)),
+                                   np.asarray(jax.grad(via_apply)(g)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_unknown_kind_and_bad_state_count(self):
+        p = jnp.zeros((8,), jnp.float32)
+        lr = jnp.float32(1e-2)
+        with pytest.raises(ValueError, match="unknown updater kind"):
+            fused_updater_step.fn(p, p, lr, lr, kind="Adamish")
+        with pytest.raises(ValueError, match="expected 2 state"):
+            fused_updater_step.fn(p, p, lr, lr, p, kind="Adam")
+
+
+class TestApplyFusedWiring:
+    def test_apply_fused_matches_apply(self):
+        upd, p, g, state = _leaf("RmsProp", seed=4)
+        lr, step = jnp.float32(5e-3), jnp.float32(2.0)
+        u, new = upd.apply(g, state, lr, step)
+        np_, ns = upd.apply_fused(p, g, state, lr, step)
+        np.testing.assert_array_equal(np.asarray(np_), np.asarray(p - u))
+        for k in new:
+            np.testing.assert_array_equal(np.asarray(ns[k]),
+                                          np.asarray(new[k]))
+
+    def test_opt_out_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_FUSED_UPDATER", "0")
+        calls = []
+        orig = registry().get("fused_updater_step").__call__
+
+        upd, p, g, state = _leaf("Sgd", seed=5)
+        lr, step = jnp.float32(0.1), jnp.float32(0.0)
+        # with the opt-out the registry op must not be involved at all
+        desc = registry().get("fused_updater_step")
+        monkeypatch.setattr(
+            type(desc), "__call__",
+            lambda self, *a, **k: calls.append(1) or orig(self, *a, **k))
+        np_, _ = upd.apply_fused(p, g, state, lr, step)
+        assert not calls
+        u, _ = upd.apply(g, state, lr, step)
+        np.testing.assert_array_equal(np.asarray(np_), np.asarray(p - u))
+
+    def test_subclass_keeps_override(self):
+        class Doubler(Sgd):
+            def apply(self, grad, state, lr, step):
+                return 2 * lr * grad, state
+
+        upd = Doubler(learning_rate=0.1)
+        assert not upd._fusable()
+        p = jnp.ones((8,), jnp.float32)
+        g = jnp.ones((8,), jnp.float32)
+        np_, _ = upd.apply_fused(p, g, {}, jnp.float32(0.1),
+                                 jnp.float32(0.0))
+        np.testing.assert_allclose(np.asarray(np_), 0.8, rtol=1e-6)
+
+    def test_fused_hyper_excludes_lr(self):
+        assert "learning_rate" not in Nesterovs(momentum=0.8).fused_hyper()
+        assert Nesterovs(momentum=0.8).fused_hyper()["momentum"] == 0.8
+
+
+class TestTrainStepTrajectories:
+    def _fit_mln(self):
+        from deeplearning4j_tpu import nn
+
+        rng = np.random.RandomState(7)
+        net = nn.MultiLayerNetwork(
+            nn.builder().seed(12345).updater(nn.Adam(learning_rate=1e-2))
+            .list()
+            .layer(nn.DenseLayer(n_out=16, activation="tanh"))
+            .layer(nn.OutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(nn.InputType.feed_forward(8)).build()
+        ).init()
+        x = rng.randn(32, 8).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+        net.fit(x, y, epochs=2, batch_size=32)
+        return [np.asarray(l) for l in jax.tree.leaves(net.params)]
+
+    def test_mln_trajectory_identical_fused_vs_not(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_FUSED_UPDATER", "1")
+        fused = self._fit_mln()
+        monkeypatch.setenv("DL4J_TPU_FUSED_UPDATER", "0")
+        unfused = self._fit_mln()
+        assert len(fused) == len(unfused)
+        for a, b in zip(fused, unfused):
+            np.testing.assert_array_equal(a, b)
+
+    def test_samediff_fit_runs_fused(self, monkeypatch):
+        from deeplearning4j_tpu.autodiff.samediff import (
+            SameDiff, TrainingConfig)
+        from deeplearning4j_tpu.datasets import (
+            DataSet, ListDataSetIterator)
+
+        monkeypatch.setenv("DL4J_TPU_FUSED_UPDATER", "1")
+        r = np.random.RandomState(11)
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(None, 4))
+        labels = sd.placeholder("labels", shape=(None, 2))
+        w = sd.var("w", (r.randn(4, 2) * 0.1).astype(np.float32))
+        logits = x.mmul(w)
+        sd.loss.softmax_cross_entropy(logits, labels).rename("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(learning_rate=5e-2),
+            data_set_feature_mapping=["x"],
+            data_set_label_mapping=["labels"],
+            loss_variables=["loss"]))
+        xs = r.randn(64, 4).astype(np.float32)
+        yl = (xs[:, 0] > 0).astype(int)
+        ys = np.eye(2, dtype=np.float32)[yl]
+        hist = sd.fit(ListDataSetIterator(DataSet(xs, ys), batch_size=64),
+                      epochs=10)
+        assert hist[-1] < hist[0]

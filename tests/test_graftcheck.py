@@ -329,6 +329,134 @@ class TestFusedOpRules:
             assert report.avals[out].shape is not None
 
 
+class TestTunedKernelRules:
+    """First-class rules for the PR-9 kernel set (fused_layer_norm,
+    fused_updater_step, quantize/dequantize_int8, matmul_int8): the
+    symbolic-batch fixture must infer exact shapes with ZERO eval_shape
+    probe fallbacks, and provable mismatches must flag GC codes."""
+
+    def test_rules_registered(self):
+        from deeplearning4j_tpu.analysis.rules import RULES
+
+        for op in ("fused_layer_norm", "fused_updater_step",
+                   "quantize_int8", "dequantize_int8", "matmul_int8"):
+            assert op in RULES, op
+
+    def test_zero_probe_fallbacks_on_tuned_fixture(self):
+        report = check_samediff(fixtures.tuned_kernels_sym_batch(),
+                                graph_name="zoo/tuned_kernels_sym_batch")
+        assert not report.findings
+        y = report.avals["y"]
+        assert isinstance(y.shape[0], Dim)  # rule ran: probe cannot do this
+        assert y.shape[1] == 128
+        assert report.avals["new_p"].shape == (128,)
+
+    def test_fused_layer_norm_gain_mismatch(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", (None, 128))
+        g = sd.var("g", np.ones(64, np.float32))
+        sd.op("fused_layer_norm", x, g, activation="gelu")
+        report = check_samediff(sd)
+        assert any(f.rule == "GC002" and "gain" in f.message
+                   for f in report.findings)
+
+    def test_fused_layer_norm_bad_activation(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", (4, 128))
+        g = sd.var("g", np.ones(128, np.float32))
+        sd.op("fused_layer_norm", x, g, activation="swish")
+        report = check_samediff(sd)
+        assert any(f.rule == "GC001" and "activation" in f.message
+                   for f in report.findings)
+
+    def test_fused_updater_step_state_shape_mismatch(self):
+        sd = SameDiff()
+        p = sd.var("p", np.zeros(8, np.float32))
+        g = sd.var("g", np.zeros(8, np.float32))
+        m = sd.var("m", np.zeros(4, np.float32))  # wrong leaf shape
+        lr = sd.constant(np.float32(1e-3))
+        step = sd.constant(np.float32(0.0))
+        sd.op("fused_updater_step", p, g, lr, step, m, kind="Nesterovs",
+              n_out=2)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC002" and "state[0]" in f.message
+                   for f in report.findings)
+
+    def test_matmul_int8_non_int8_weights(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", (None, 128))
+        w = sd.var("w", np.zeros((128, 64), np.float32))
+        ws = sd.var("ws", np.ones(64, np.float32))
+        sd.op("matmul_int8", x, w, ws)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC003" and "int8" in f.message
+                   for f in report.findings)
+
+    def test_quantize_int8_axis_out_of_range(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", (4, 8))
+        sd.op("quantize_int8", x, axis=5, n_out=2)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC001" and "axis" in f.message
+                   for f in report.findings)
+
+    def test_quantize_int8_tuple_axis_checks_clean(self):
+        # the impl accepts jnp.max-style axis tuples; the rule must not
+        # crash on them and derives the keepdims scale shape
+        sd = SameDiff()
+        x = sd.placeholder("x", (4, 8))
+        q, s = sd.op("quantize_int8", x, axis=(0, 1), n_out=2)
+        q.rename("q")
+        s.rename("s")
+        report = check_samediff(sd)
+        assert not report.findings
+        assert report.avals["s"].shape == (1, 1)
+
+    def test_fused_updater_step_kind_and_arity_flagged(self):
+        # unknown kind and wrong state count both raise at trace time —
+        # the rule must flag them pre-trace
+        def graph(kind, n_state):
+            sd = SameDiff()
+            p = sd.var("p", np.zeros(8, np.float32))
+            g = sd.var("g", np.zeros(8, np.float32))
+            lr = sd.constant(np.float32(1e-3))
+            step = sd.constant(np.float32(0.0))
+            st = [sd.var(f"s{i}", np.zeros(8, np.float32))
+                  for i in range(n_state)]
+            sd.op("fused_updater_step", p, g, lr, step, *st, kind=kind,
+                  n_out=1 + n_state)
+            return sd
+
+        report = check_samediff(graph("Adm", 0))
+        assert any(f.rule == "GC001" and "unknown updater kind"
+                   in f.message for f in report.findings)
+        report = check_samediff(graph("Adam", 1))
+        assert any(f.rule == "GC001" and "expected 2 state" in f.message
+                   for f in report.findings)
+        assert not check_samediff(graph("Adam", 2)).findings
+
+    def test_fused_updater_step_rank_mismatch_flagged(self):
+        # zip() truncation must not hide a rank mismatch
+        sd = SameDiff()
+        p = sd.var("p", np.zeros(4, np.float32))
+        g = sd.var("g", np.zeros((4, 5), np.float32))
+        lr = sd.constant(np.float32(1e-3))
+        step = sd.constant(np.float32(0.0))
+        sd.op("fused_updater_step", p, g, lr, step, kind="Sgd")
+        report = check_samediff(sd)
+        assert any(f.rule == "GC002" and "grad" in f.message
+                   for f in report.findings)
+
+    def test_fused_layer_norm_non_trailing_axis_flagged(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", (4, 128))
+        g = sd.var("g", np.ones(128, np.float32))
+        sd.op("fused_layer_norm", x, g, axis=0)
+        report = check_samediff(sd)
+        assert any(f.rule == "GC001" and "trailing" in f.message
+                   for f in report.findings)
+
+
 class TestSameDiffWiring:
     def test_check_populates_last_report(self):
         sd = SameDiff()

@@ -1,0 +1,215 @@
+"""Fused LayerNorm(+activation) (ops/pallas_layernorm.py) and the
+optimizer's layernorm fusion rule (autodiff/optimize.py).
+
+Interpret-mode Pallas vs the XLA generic at f32 1e-5, gradient equivalence
+through the custom_vjp, the tuned usable() gate, and the graph rewrite:
+layer_norm→gelu (node and decomposed-erf forms) → ONE fused_layer_norm
+node, with negative fixtures left verbatim."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deeplearning4j_tpu.ops  # noqa: F401 - registers catalog + helpers
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.ops.pallas_layernorm import (
+    fused_layer_norm, fused_layer_norm_helper, fused_layer_norm_pallas)
+
+
+def _data(rows=16, d=128, seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(rows, d).astype(np.float32))
+    g = jnp.asarray((r.rand(d) + 0.5).astype(np.float32))
+    b = jnp.asarray(r.randn(d).astype(np.float32))
+    return x, g, b
+
+
+class TestKernelEquivalence:
+    @pytest.mark.parametrize("act", ["none", "relu", "gelu", "gelu_exact"])
+    def test_interpret_matches_generic(self, act):
+        x, g, b = _data()
+        want = fused_layer_norm.fn(x, g, b, activation=act)
+        got = fused_layer_norm_pallas(x, g, b, activation=act,
+                                      block_rows=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_no_bias_and_3d(self):
+        r = np.random.RandomState(1)
+        x = jnp.asarray(r.randn(2, 8, 128).astype(np.float32))
+        g = jnp.asarray((r.rand(128) + 0.5).astype(np.float32))
+        want = fused_layer_norm.fn(x, g, activation="gelu")
+        got = fused_layer_norm_pallas(x, g, activation="gelu",
+                                      block_rows=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_matches_catalog_layer_norm_plus_gelu(self):
+        from deeplearning4j_tpu.ops.nn_ops import layer_norm
+
+        x, g, b = _data(seed=2)
+        want = jax.nn.gelu(layer_norm.fn(x, g, b))
+        got = fused_layer_norm.fn(x, g, b, activation="gelu")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_non_trailing_axis_rejected(self):
+        """gain/bias broadcast along the last axis, so a non-trailing axis
+        would silently scale the wrong dim — must raise, not mis-normalize."""
+        x, g, b = _data()
+        with pytest.raises(ValueError, match="trailing axis"):
+            fused_layer_norm.fn(x, g, b, axis=0)
+        # trailing axis spelled positively is fine
+        got = fused_layer_norm.fn(x, g, b, axis=x.ndim - 1)
+        want = fused_layer_norm.fn(x, g, b)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("act", ["none", "gelu"])
+    def test_gradients_match(self, act):
+        x, g, b = _data(rows=8, seed=3)
+
+        def loss(fn):
+            return lambda x, g, b: jnp.sum(
+                fn(x, g, b, activation=act) ** 2)
+
+        want = jax.grad(loss(fused_layer_norm.fn), argnums=(0, 1, 2))(
+            x, g, b)
+        got = jax.grad(loss(fused_layer_norm_helper), argnums=(0, 1, 2))(
+            x, g, b)
+        for w, a in zip(want, got):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-4, atol=1e-4)
+
+
+class TestUsableGate:
+    def _usable(self, *args, **kw):
+        from deeplearning4j_tpu.ops.pallas_layernorm import _usable
+
+        return _usable(*args, **kw)
+
+    def test_alignment_and_axis(self):
+        g128 = jnp.ones((128,), jnp.float32)
+        assert self._usable(jnp.zeros((16, 128)), g128)
+        assert not self._usable(jnp.zeros((16, 64)), jnp.ones((64,)))
+        assert not self._usable(jnp.zeros((15, 128)), g128)  # rows % 8
+        assert not self._usable(jnp.zeros((16, 128)), g128, axis=0)
+        assert not self._usable(jnp.zeros((128,)), g128)  # rank 1
+        assert not self._usable(jnp.zeros((16, 128)), g128,
+                                activation="exp")
+        assert not self._usable(jnp.zeros((16, 128), jnp.int32), g128)
+
+
+class TestLayerNormFusionPass:
+    def _ln_gelu_graph(self, form="node", optimize=True, axis=-1,
+                       extra_consumer=False):
+        r = np.random.RandomState(0)
+        sd = SameDiff(optimize=optimize)
+        x = sd.placeholder("x", (8, 128))
+        g = sd.var("g", (r.rand(128).astype(np.float32) + 0.5))
+        b = sd.var("b", r.randn(128).astype(np.float32))
+        h = sd.nn.layer_norm(x, g, b, axis=axis)
+        if form == "node":
+            out = sd._record("gelu", [h])
+        elif form == "erf":
+            sqrt2 = sd.constant("sqrt2", np.float32(np.sqrt(2.0)))
+            onec = sd.constant("onec", np.float32(1.0))
+            halfc = sd.constant("halfc", np.float32(0.5))
+            e = sd._record("erf", [h / sqrt2])
+            out = h * (e + onec) * halfc
+        else:
+            raise AssertionError(form)
+        if extra_consumer:
+            (h + out).rename("out")
+        else:
+            out.rename("out")
+        return sd
+
+    def _plan_ops(self, sd):
+        key = ("plan", ("out",), sd._effective_passes())
+        return [n.op for n in sd._jit_cache[key].nodes]
+
+    def _feed(self):
+        return {"x": np.random.RandomState(5).randn(8, 128)
+                .astype(np.float32)}
+
+    def test_gelu_node_fuses_and_matches(self):
+        sd = self._ln_gelu_graph("node")
+        feed = self._feed()
+        got = sd.exec(feed, "out")["out"]
+        ops = self._plan_ops(sd)
+        assert "fused_layer_norm" in ops
+        assert "layer_norm_graph" not in ops and "gelu" not in ops
+        assert sd.last_compile_stats.fusions.get("layernorm") == 1
+        want = self._ln_gelu_graph("node", optimize=False).exec(
+            feed, "out")["out"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_erf_gelu_chain_fuses_exact(self):
+        sd = self._ln_gelu_graph("erf")
+        feed = self._feed()
+        got = sd.exec(feed, "out")["out"]
+        ops = self._plan_ops(sd)
+        assert "fused_layer_norm" in ops
+        assert "erf" not in ops
+        plan_nodes = [n for n in sd._jit_cache[
+            ("plan", ("out",), sd._effective_passes())].nodes
+            if n.op == "fused_layer_norm"]
+        assert plan_nodes[0].kwargs["activation"] == "gelu_exact"
+        want = self._ln_gelu_graph("erf", optimize=False).exec(
+            feed, "out")["out"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_shared_ln_output_not_fused(self):
+        sd = self._ln_gelu_graph("node", extra_consumer=True)
+        sd.exec(self._feed(), "out")
+        ops = self._plan_ops(sd)
+        assert "fused_layer_norm" not in ops
+        assert not sd.last_compile_stats.fusions.get("layernorm")
+
+    def test_plain_layer_norm_left_verbatim(self):
+        r = np.random.RandomState(0)
+        sd = SameDiff(optimize=True)
+        x = sd.placeholder("x", (8, 128))
+        g = sd.var("g", (r.rand(128).astype(np.float32) + 0.5))
+        sd.nn.layer_norm(x, g).rename("out")
+        sd.exec(self._feed(), "out")
+        assert "fused_layer_norm" not in self._plan_ops(sd)
+
+    def _with_loss(self, form="node", optimize=True):
+        sd = self._ln_gelu_graph(form, optimize=optimize)
+        out = sd._vars["out"]
+        (out * out).sum().rename("loss")
+        return sd
+
+    def test_gradients_flow_through_fused_node(self):
+        feed = self._feed()
+        grads = self._with_loss().calculate_gradients(
+            feed, "loss", ["g", "b"])
+        want = self._with_loss(optimize=False).calculate_gradients(
+            feed, "loss", ["g", "b"])
+        for k in ("g", "b"):
+            np.testing.assert_allclose(np.asarray(grads[k]),
+                                       np.asarray(want[k]),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_pallas_helper_under_forced_mode(self):
+        """The fused node dispatches onto the Pallas interpret kernel under
+        helper_mode=pallas on CPU and stays numerically equivalent."""
+        from deeplearning4j_tpu.environment import environment
+
+        env = environment()
+        old = env.helper_mode
+        feed = self._feed()
+        want = self._ln_gelu_graph("node", optimize=False).exec(
+            feed, "out")["out"]
+        env.helper_mode = "pallas"
+        try:
+            got = self._ln_gelu_graph("node").exec(feed, "out")["out"]
+        finally:
+            env.helper_mode = old
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)

@@ -7,11 +7,16 @@ T x {causal, full}, fwd+bwd in bf16:
   * our own generic composition (_reference_attention) — the historical
     baseline the 1.95x claim was measured against.
 
-Records the full table in BENCH_HISTORY.json under 'attention_sweep' and
-prints one row per shape. The platform-helper usable gate auto-defers to
-XLA wherever this table shows Pallas losing — set DL4J_TPU_FLASH_MIN_T to
-the re-measured crossover (ops/pallas_attention.py flash_min_t(), default
-4096).
+Records the full table in BENCH_HISTORY.json under 'attention_sweep',
+prints one row per shape, and emits a TUNING-TABLE FRAGMENT (the
+ops/tuning.py dl4j_tpu_tuning_v1 schema) with the measured flash-vs-XLA
+crossover for this device kind. Fragments are NOT loaded automatically:
+merge one into the committed default table
+(deeplearning4j_tpu/ops/tuning_tables/<kind>.json) or into the cache
+table the loader actually reads (<cache dir>/<device_kind>.json) via
+``TuningTable.merge`` — docs/KERNELS.md § Re-tuning. DL4J_TPU_FLASH_MIN_T
+still overrides everything. Fragment path: SWEEP_TABLE_OUT env, default
+<cache dir>/fragment_attention_<device_kind>.json.
 """
 
 from __future__ import annotations
@@ -19,9 +24,12 @@ from __future__ import annotations
 import json
 import math
 import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def bench_shape(t: int, causal: bool, iters: int = None):
@@ -140,6 +148,26 @@ def main() -> None:
         "rows": rows}
     json.dump(hist, open(hist_path, "w"), indent=1)
     print(f"recorded {len(rows)} rows to {hist_path}")
+
+    # tuning-table fragment (ops/tuning.py schema): the measured crossover
+    # is the smallest swept T where flash beats XLA in BOTH causal modes;
+    # if flash never wins, 2x the largest point (pessimistic, re-measurable)
+    from deeplearning4j_tpu.ops import tuning
+
+    # justified: runs after the whole sweep already exercised the backend
+    kind = tuning.normalize_device_kind(jax.devices()[0].device_kind)  # graftlint: disable=GL002
+    frag = tuning.TuningTable(device_kind=kind)
+    wins = {}
+    for row in rows:
+        wins.setdefault(row["t"], True)
+        wins[row["t"]] &= row["speedup_vs_xla"] >= 1.0
+    crossover = next((t for t in sorted(wins) if wins[t]), 2 * max(seqs))
+    frag.set("dot_product_attention", "flash_min_t", int(crossover))
+    out_path = os.environ.get(
+        "SWEEP_TABLE_OUT",
+        os.path.join(tuning.tuning_dir(), f"fragment_attention_{kind}.json"))
+    frag.save(out_path)
+    print(f"tuning fragment (flash_min_t={crossover}) -> {out_path}")
 
 
 if __name__ == "__main__":

@@ -12,16 +12,29 @@ on the real chip (device-side lax.scan loop; wall timing of single
 dispatches through the axon tunnel is noise), and prints XLA
 cost-analysis bytes for both so the traffic delta is explicit.
 
-Usage: python tools/bench_convbn_fusion.py [--iters 50]
+Besides the human table, the tool emits a TUNING-TABLE FRAGMENT (the
+ops/tuning.py dl4j_tpu_tuning_v1 schema): the best-measured Pallas block_m
+per shape bucket. Fragments are NOT loaded automatically — merge one into
+the committed default table or into <cache dir>/<device_kind>.json (the
+file the loader reads) via ``TuningTable.merge`` so the kernel's block
+picker uses the measured winners (docs/KERNELS.md § Re-tuning).
+Fragment path: SWEEP_TABLE_OUT env, default
+<cache dir>/fragment_convbn_<device_kind>.json.
+
+Usage: python tools/bench_convbn_fusion.py [--iters 50] [--blocks 256,512]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 # (label, M, K, N) — every distinct 1×1 conv+BN shape in ResNet-50 @ b128
@@ -42,6 +55,9 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--shapes", default=None,
                     help="comma-separated labels to run (default: all)")
+    ap.add_argument("--blocks", default="0,256,512",
+                    help="comma-separated block_m candidates for the Pallas "
+                         "kernel (0 = the kernel's own pick)")
     args = ap.parse_args()
 
     import jax
@@ -95,13 +111,30 @@ def main() -> None:
         import functools
         ref = functools.partial(reference_bn_matmul_stats, materialize=True)
         t_ref = run(make(ref))
-        t_fused = run(make(fused_bn_matmul_stats))
+        # block-candidate sweep for the Pallas kernel: the best block_m per
+        # shape bucket lands in the tuning fragment. Candidates that do not
+        # divide this shape's m are skipped; if none survive, fall back to
+        # the kernel's own pick (0) so one ragged shape cannot kill the run
+        t_fused, best_bm = None, 0
+        cands = [int(b) for b in args.blocks.split(",")]
+        if not any(not bm or m % bm == 0 for bm in cands):
+            cands = [0]
+        for bm in cands:
+            if bm and m % bm:
+                continue
+            t = run(make(functools.partial(fused_bn_matmul_stats,
+                                           block_m=bm)))
+            if t_fused is None or t < t_fused:
+                t_fused, best_bm = t, bm
         by_ref = cost_bytes(ref)
-        by_fused = cost_bytes(fused_bn_matmul_stats)
+        # cost analysis must describe the SAME configuration that was timed
+        by_fused = cost_bytes(functools.partial(fused_bn_matmul_stats,
+                                                block_m=best_bm))
         # one-pass ideal traffic: read x + w, write z (+ stats, negligible)
         ideal = (m * k + k * n + m * n) * 2
         row = {"shape": label, "m": m, "k": k, "n": n,
                "xla_ms": round(t_ref, 3), "pallas_ms": round(t_fused, 3),
+               "best_block_m": best_bm,
                "speedup": round(t_ref / t_fused, 3),
                "xla_bytes_mb": round(by_ref / 1e6, 1),
                "pallas_bytes_mb": round(by_fused / 1e6, 1),
@@ -115,6 +148,25 @@ def main() -> None:
         print(json.dumps({"total_xla_ms": round(tot_x, 2),
                           "total_pallas_ms": round(tot_p, 2),
                           "speedup": round(tot_x / tot_p, 3)}))
+
+        # tuning-table fragment (ops/tuning.py schema): measured block_m
+        # winners per shape bucket for this device kind
+        from deeplearning4j_tpu.ops import tuning
+
+        # justified: runs after the sweep already exercised the backend
+        kind = tuning.normalize_device_kind(jax.devices()[0].device_kind)  # graftlint: disable=GL002
+        frag = tuning.TuningTable(device_kind=kind)
+        for r in results:
+            if r["best_block_m"]:
+                frag.set_block("fused_bn_matmul_stats",
+                               tuning.bucket_mkn(r["m"], r["k"], r["n"]),
+                               "block_m", r["best_block_m"])
+        out_path = os.environ.get(
+            "SWEEP_TABLE_OUT",
+            os.path.join(tuning.tuning_dir(),
+                         f"fragment_convbn_{kind}.json"))
+        frag.save(out_path)
+        print(f"tuning fragment -> {out_path}")
 
 
 if __name__ == "__main__":

@@ -23,6 +23,9 @@ Stages:
      recompile-ledger events, and serving p50/p99 (docs/OBSERVABILITY.md)
   7. serve smoke: BENCH_MODEL=generate continuous-batching generation must
      produce tokens with a finite decode p99 (docs/SERVING.md)
+  8. tune smoke: tiny-shape autotune into a throwaway cache dir must
+     produce a loadable tuning table and prove measured dispatch via the
+     helper-dispatch counters (docs/KERNELS.md)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -222,6 +225,46 @@ def serve_stage() -> bool:
     return ok
 
 
+def tune_stage() -> bool:
+    """Autotuner smoke (docs/KERNELS.md): tiny-shape tune into a THROWAWAY
+    cache dir must produce a loadable table and prove — via the
+    dl4j_tpu_helper_dispatch_total counters — that small-shape attention
+    dispatches to the XLA generic below the tuned threshold and to the
+    Pallas helper above it. One JSON line, like lint/check/obs."""
+    import tempfile
+
+    print("== gate: tune-smoke (autotuner + measured dispatch) ==",
+          flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DL4J_TPU_TUNING_DIR=tempfile.mkdtemp(prefix="gate_tune_"))
+    env.pop("DL4J_TPU_FLASH_MIN_T", None)  # env override would mask the
+    try:                                   # tuned-table dispatch proof
+        proc = subprocess.run(
+            [sys.executable, "tools/tune.py", "--smoke", "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (tune-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (tune-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    verify = rec.get("verify") or {}
+    ok = (bool(rec.get("ok")) and rec.get("table_path")
+          and verify.get("below_dispatch") == "xla"
+          and verify.get("above_dispatch") == "pallas")
+    print(f"   {'ok' if ok else 'FAIL'} (tune-smoke: "
+          f"{rec.get('measurements')} candidates, flash_min_t="
+          f"{verify.get('flash_min_t')}, below->{verify.get('below_dispatch')}"
+          f", above->{verify.get('above_dispatch')})")
+    return bool(ok)
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -289,6 +332,7 @@ def main() -> int:
                   "from this state) ==")
         results["obs"] = obs_stage()
         results["serve"] = serve_stage()
+        results["tune"] = tune_stage()
         results["multichip"] = multichip_stage()
 
     failed = [k for k, v in results.items() if not v]

@@ -124,7 +124,12 @@ def _print_human(rep: dict) -> None:
               f"batches; latency p50 {_fmt_ms(sv['p50_ms'])}, "
               f"p95 {_fmt_ms(sv['p95_ms'])}, p99 {_fmt_ms(sv['p99_ms'])}; "
               f"batch occupancy mean {sv['batch_occupancy_mean']}")
-    if not (tr or rec or sv):
+    disp = s.get("dispatch")
+    if disp:
+        print("helper dispatch (op/impl/reason):")
+        for key, count in disp.items():
+            print(f"  {key}: {count}")
+    if not (tr or rec or sv or disp):
         print("no telemetry recorded (did the workload run?)")
 
 
