@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -45,6 +45,15 @@ tune-smoke:
 # deeplearning4j_tpu/ops/tuning_tables/<kind>.json to ship it as default)
 tune:
 	python tools/tune.py
+
+# chaos smoke (docs/ROBUSTNESS.md): generative serving + checkpoints under
+# an injected fault schedule (page OOM, decode crash, worker death, torn
+# checkpoint write) — every request must reach a terminal finish reason,
+# the supervisor must restart within its cap with ZERO new_shape ledger
+# events, and restore() must fall back to the last intact checkpoint.
+# ONE JSON line like lint/check/obs.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/chaos.py --json
 
 # generative-serving smoke (docs/SERVING.md): continuous-batching
 # generation, smoke-sized, CPU-pinned — ONE JSON line with tokens/sec,

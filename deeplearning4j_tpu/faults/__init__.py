@@ -1,0 +1,32 @@
+"""Process-wide fault injection + the vocabulary of supervised recovery.
+
+See :mod:`deeplearning4j_tpu.faults.injection` for the design and
+docs/ROBUSTNESS.md for the fault-point catalog, the engine supervisor
+state machine this layer exists to exercise, and the ``chaos`` gate
+stage that runs a full fault schedule before every snapshot.
+
+Imports neither jax nor the model runtimes (only ``observe``) — safe to
+import from any layer, including ``parallel/checkpoint.py`` and the
+serving hot loops.
+"""
+
+from deeplearning4j_tpu.faults.injection import (
+    FAULT_POINTS,
+    FAULTS_ENV,
+    FaultSpec,
+    InjectedFault,
+    active,
+    arm,
+    disarm,
+    fire_counts,
+    maybe_fail,
+    maybe_sleep,
+    reset,
+    should_fire,
+)
+
+__all__ = [
+    "FAULT_POINTS", "FAULTS_ENV", "FaultSpec", "InjectedFault",
+    "active", "arm", "disarm", "fire_counts", "maybe_fail", "maybe_sleep",
+    "reset", "should_fire",
+]

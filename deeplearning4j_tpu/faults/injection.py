@@ -1,0 +1,234 @@
+"""Deterministic fault injection — the chaos layer (docs/ROBUSTNESS.md).
+
+A production serving system is defined less by its fast path than by what
+happens when that path breaks: a decode step throwing, the page pool
+running dry, a checkpoint torn mid-write, a worker thread dying. This
+module makes those failures *injectable on demand* at a fixed catalog of
+named points (:data:`FAULT_POINTS`) so the supervision/recovery machinery
+(engine restarts, retry re-admission, checkpoint fallback) can be proven
+under test and in the ``chaos`` gate stage instead of trusted.
+
+Design constraints, in order:
+
+* **Off means off.** With ``DL4J_TPU_FAULTS`` unset and nothing armed
+  programmatically, :func:`should_fire` is one module-bool read — the
+  hooks compile away to a predictable-branch no-op in every hot loop they
+  sit in (the generate bench shows no measurable delta).
+* **Deterministic.** Every armed point draws from its own seeded
+  ``random.Random`` stream keyed on (seed, point name) — a fault schedule
+  replays identically across runs, which is what makes a chaos failure
+  debuggable.
+* **Observable.** Every fired fault increments
+  ``dl4j_tpu_faults_injected_total{point=...}`` and writes a
+  ``fault_injected`` JSONL event, so a chaos run's injected failures are
+  first-class telemetry next to the recoveries they caused.
+
+Arming::
+
+    DL4J_TPU_FAULTS=decode_step_error:1:4,page_oom:0.2   # env schedule
+    faults.arm("worker_death", prob=1.0, after_n=10, max_fires=1)  # tests
+
+Env syntax is ``point:prob[:after_n]`` comma-separated; programmatic
+:func:`arm` adds ``max_fires`` and ``seed``. Call sites use
+:func:`should_fire` (branch), :func:`maybe_fail` (raise
+:class:`InjectedFault`), or :func:`maybe_sleep` (latency injection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Dict, Optional
+
+from deeplearning4j_tpu import observe
+
+logger = logging.getLogger(__name__)
+
+FAULTS_ENV = "DL4J_TPU_FAULTS"
+
+#: The injection-point catalog. Each name is hooked at ONE class of real
+#: call site (docs/ROBUSTNESS.md has the full table):
+#:   page_oom              serving/cache.py  ensure_capacity -> forced "oom"
+#:   decode_step_error     serving/engine.py step            -> raise
+#:   slow_decode           serving/engine.py step            -> sleep
+#:   worker_death          serving/engine.py _serve_loop     -> raise
+#:   checkpoint_torn_write parallel/checkpoint.py save       -> truncate file
+#:   backend_init_fail     parallel/mesh.py  ParallelInference -> raise
+FAULT_POINTS = (
+    "page_oom",
+    "decode_step_error",
+    "slow_decode",
+    "worker_death",
+    "checkpoint_torn_write",
+    "backend_init_fail",
+)
+
+
+class InjectedFault(RuntimeError):
+    """The exception raised by raising fault points. Carries the point
+    name so recovery paths (and tests) can attribute the failure."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected fault: {point}")
+        self.point = point
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed injection point and its firing schedule."""
+
+    point: str
+    prob: float = 1.0            # per-eligible-call fire probability
+    after_n: int = 0             # skip the first N eligible calls
+    max_fires: Optional[int] = None   # stop firing after this many
+    seed: int = 0
+    calls: int = 0               # bookkeeping (under the module lock)
+    fires: int = 0
+
+    def __post_init__(self):
+        if self.point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {self.point!r}; known: {FAULT_POINTS}")
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+        if self.after_n < 0:
+            raise ValueError(f"after_n must be >= 0, got {self.after_n}")
+        # per-(seed, point) stream: deterministic replay, independent points
+        self._rng = random.Random(
+            (self.seed << 32) ^ zlib.crc32(self.point.encode()))
+
+
+# one lock guards the armed-spec table and the env-parse cache; fault
+# checks are cheap and rare enough (host-side scheduler boundaries, never
+# under jit) that a single lock is not a contention concern
+_LOCK = threading.Lock()
+_ARMED: Dict[str, FaultSpec] = {}
+_ANY_ARMED = False          # the fast-path gate: one bool read when idle
+_ENV_CACHE: tuple = ("", ())  # (raw env value, parsed specs)
+
+
+def _parse_env(raw: str):
+    """``point:prob[:after_n]`` comma-separated -> FaultSpec list. A
+    malformed entry disables itself with ONE warning instead of taking
+    down the process that exported it."""
+    specs = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        try:
+            spec = FaultSpec(
+                point=parts[0],
+                prob=float(parts[1]) if len(parts) > 1 else 1.0,
+                after_n=int(parts[2]) if len(parts) > 2 else 0)
+        except (ValueError, IndexError) as e:
+            logger.warning("%s: ignoring malformed entry %r (%s)",
+                           FAULTS_ENV, entry, e)
+            continue
+        specs.append(spec)
+    return tuple(specs)
+
+
+def _lookup(point: str) -> Optional[FaultSpec]:
+    """The armed spec for ``point``: programmatic arms win over env."""
+    global _ENV_CACHE
+    spec = _ARMED.get(point)
+    if spec is not None:
+        return spec
+    raw = os.environ.get(FAULTS_ENV, "")
+    if not raw:
+        return None
+    if _ENV_CACHE[0] != raw:
+        _ENV_CACHE = (raw, _parse_env(raw))
+    for s in _ENV_CACHE[1]:
+        if s.point == point:
+            return s
+    return None
+
+
+def arm(point: str, prob: float = 1.0, after_n: int = 0,
+        max_fires: Optional[int] = None, seed: int = 0) -> FaultSpec:
+    """Arm ``point`` programmatically (tests, the chaos harness). Wins
+    over any env schedule for the same point."""
+    global _ANY_ARMED
+    spec = FaultSpec(point=point, prob=prob, after_n=after_n,
+                     max_fires=max_fires, seed=seed)
+    with _LOCK:
+        _ARMED[point] = spec
+        _ANY_ARMED = True
+    return spec
+
+
+def disarm(point: str) -> None:
+    global _ANY_ARMED
+    with _LOCK:
+        _ARMED.pop(point, None)
+        _ANY_ARMED = bool(_ARMED)
+
+
+def reset() -> None:
+    """Disarm every programmatic point and drop the env-parse cache (so a
+    changed ``DL4J_TPU_FAULTS`` re-parses with fresh call counters)."""
+    global _ANY_ARMED, _ENV_CACHE
+    with _LOCK:
+        _ARMED.clear()
+        _ANY_ARMED = False
+        _ENV_CACHE = ("", ())
+
+
+def active() -> bool:
+    """Anything armed (programmatically or via env)?"""
+    return _ANY_ARMED or bool(os.environ.get(FAULTS_ENV))
+
+
+def fire_counts() -> Dict[str, int]:
+    """point -> times fired, across programmatic AND env arms."""
+    with _LOCK:
+        out = {s.point: s.fires for s in _ENV_CACHE[1] if s.fires}
+        for s in _ARMED.values():
+            if s.fires:
+                out[s.point] = out.get(s.point, 0) + s.fires
+    return out
+
+
+def should_fire(point: str) -> bool:
+    """ONE call-site check: does the armed schedule for ``point`` fire
+    now? The unarmed fast path is a bool read + (when env is also unset)
+    one dict lookup — safe in any loop this framework has."""
+    if not _ANY_ARMED and not os.environ.get(FAULTS_ENV):
+        return False
+    with _LOCK:
+        spec = _lookup(point)
+        if spec is None:
+            return False
+        spec.calls += 1
+        if spec.calls <= spec.after_n:
+            return False
+        if spec.max_fires is not None and spec.fires >= spec.max_fires:
+            return False
+        if spec.prob < 1.0 and spec._rng.random() >= spec.prob:
+            return False
+        spec.fires += 1
+    observe.metrics().counter(
+        "dl4j_tpu_faults_injected_total", point=point).inc()
+    observe.log_event("fault_injected", point=point)
+    logger.warning("fault injected: %s (fire %d)", point, spec.fires)
+    return True
+
+
+def maybe_fail(point: str) -> None:
+    """Raise :class:`InjectedFault` when the schedule fires."""
+    if should_fire(point):
+        raise InjectedFault(point)
+
+
+def maybe_sleep(point: str, seconds: float) -> None:
+    """Inject latency when the schedule fires (e.g. ``slow_decode``)."""
+    if should_fire(point):
+        time.sleep(seconds)

@@ -32,6 +32,7 @@ from deeplearning4j_tpu.observe.registry import (
     default_registry,
     log_event,
     reset_default_registry,
+    reset_log_state,
 )
 from deeplearning4j_tpu.observe.tracing import (
     SpanTracer,
@@ -58,6 +59,7 @@ def reset() -> None:
     reset_default_registry()
     reset_default_tracer()
     reset_default_ledger()
+    reset_log_state()
 
 
 def _ms(seconds) -> Any:
@@ -128,6 +130,24 @@ def summary() -> Dict[str, Any]:
             "intertoken_p99_ms": _ms(itl["p99"]),
         }
 
+    robustness = {
+        "faults_injected": int(
+            m.family_total("dl4j_tpu_faults_injected_total")),
+        "engine_restarts": int(
+            m.counter("dl4j_tpu_serving_engine_restarts_total").value),
+        "retries": int(m.counter("dl4j_tpu_serving_retries_total").value),
+        "shed": int(m.counter("dl4j_tpu_serving_evicted_total",
+                              reason="shed").value),
+        "checkpoint_corrupt": int(
+            m.counter("dl4j_tpu_checkpoint_corrupt_total").value),
+        "checkpoint_fallbacks": int(
+            m.counter("dl4j_tpu_checkpoint_fallback_total").value),
+    }
+    if any(robustness.values()):
+        # reported when ANY of it happened — a real (un-injected) torn
+        # checkpoint or shed burst must be as visible as a chaos run
+        out["robustness"] = robustness
+
     reqs = m.counter("dl4j_tpu_serving_requests_total").value
     if reqs:
         h = m.histogram("dl4j_tpu_serving_request_seconds")
@@ -149,5 +169,5 @@ __all__ = [
     "CompileEvent", "RecompileLedger", "OBS_LOG_ENV",
     "metrics", "tracer", "ledger", "default_registry", "default_tracer",
     "default_ledger", "log_event", "note_jit_signature", "signature_of",
-    "summary", "dispatch_summary", "reset",
+    "summary", "dispatch_summary", "reset", "reset_log_state",
 ]

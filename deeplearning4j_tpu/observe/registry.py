@@ -26,11 +26,14 @@ Naming follows the Prometheus convention: ``dl4j_tpu_<what>_<unit>`` with
 from __future__ import annotations
 
 import json
+import logging
 import math
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
 
 # Default latency bucket bounds (seconds): log-spaced from 100µs to ~56min
 # (26 power-of-2 buckets, ~3.3 per decade) — honest p99s on sub-ms serving
@@ -315,6 +318,16 @@ _CORE_METRICS: Tuple[Tuple[str, str], ...] = (
     ("histogram", "dl4j_tpu_serving_decode_step_seconds"),
     ("histogram", "dl4j_tpu_serving_ttft_seconds"),
     ("histogram", "dl4j_tpu_serving_intertoken_seconds"),
+    # robustness tier (faults/ + the engine supervisor + durable
+    # checkpoints — docs/ROBUSTNESS.md). faults_injected_total grows
+    # point-labelled children next to this eagerly-registered base.
+    ("counter", "dl4j_tpu_faults_injected_total"),
+    ("counter", "dl4j_tpu_serving_engine_restarts_total"),
+    ("counter", "dl4j_tpu_serving_retries_total"),
+    ("gauge", "dl4j_tpu_serving_stopped_cleanly"),
+    ("counter", "dl4j_tpu_checkpoint_saves_total"),
+    ("counter", "dl4j_tpu_checkpoint_corrupt_total"),
+    ("counter", "dl4j_tpu_checkpoint_fallback_total"),
 )
 
 
@@ -339,15 +352,31 @@ def reset_default_registry() -> MetricsRegistry:
 OBS_LOG_ENV = "DL4J_TPU_OBS_LOG"
 
 _LOG_LOCK = threading.Lock()
+# paths whose writes failed: logging to them is DISABLED (with one warning
+# per path) — an unwritable log or a full disk must cost one syscall per
+# event forever after, not an exception inside a training/serving loop
+_LOG_FAILED_PATHS: set = set()
+
+
+def reset_log_state() -> None:
+    """Forget failed JSONL log paths (tests; or after freeing disk)."""
+    with _LOG_LOCK:
+        _LOG_FAILED_PATHS.clear()
 
 
 def log_event(kind: str, **fields: Any) -> None:
     """Append one JSONL event to the ``DL4J_TPU_OBS_LOG`` file (no-op when
     the env var is unset). Schema: every line is a JSON object with ``ts``
     (epoch seconds — a timestamp, not a duration), ``kind``, plus the
-    kind-specific fields (docs/OBSERVABILITY.md)."""
+    kind-specific fields (docs/OBSERVABILITY.md).
+
+    Failure policy: a path that cannot be written (bad path, permissions,
+    disk full mid-run) warns ONCE and disables logging to that path for
+    the rest of the process — observability must never take down the
+    training/serving loop it observes. Pointing the env var at a fresh
+    path (or :func:`reset_log_state`) re-enables logging."""
     path = os.environ.get(OBS_LOG_ENV)
-    if not path:
+    if not path or path in _LOG_FAILED_PATHS:
         return
     rec = {"ts": round(time.time(), 6), "kind": kind}
     rec.update(fields)
@@ -359,5 +388,12 @@ def log_event(kind: str, **fields: Any) -> None:
     try:
         with _LOG_LOCK, open(path, "a", encoding="utf-8") as fh:
             fh.write(line + "\n")
-    except OSError:
-        pass  # observability must never take down the training loop
+    except OSError as e:
+        with _LOG_LOCK:
+            first = path not in _LOG_FAILED_PATHS
+            _LOG_FAILED_PATHS.add(path)
+        if first:
+            logger.warning(
+                "%s: cannot write %s (%s) — JSONL event logging DISABLED "
+                "for this path for the rest of the process", OBS_LOG_ENV,
+                path, e)

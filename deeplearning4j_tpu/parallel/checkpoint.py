@@ -10,17 +10,34 @@ checkpoints of the full training state (params + updater state + net state +
 step + RNG key). Falls back to a .npz scheme when orbax is unavailable. The
 user-facing ModelSerializer zip (nn/serde.py) remains the parity surface for
 single-host models; this module is the pod-scale path.
+
+Durability (docs/ROBUSTNESS.md): the .npz path writes ATOMICALLY — temp
+file + fsync + rename — so a crash mid-save can never leave a torn file
+under the final name, and the ``latest.json`` marker records a sha256
+content checksum per checkpoint. ``restore`` verifies the checksum before
+loading and FALLS BACK to the newest intact checkpoint on corruption
+(counted in ``dl4j_tpu_checkpoint_corrupt_total`` /
+``dl4j_tpu_checkpoint_fallback_total``) instead of raising mid-``fit`` —
+a relaunched elastic job loses at most one save interval, never the run.
+The ``checkpoint_torn_write`` fault point (deeplearning4j_tpu/faults/)
+corrupts the just-written file to prove that path under test.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from deeplearning4j_tpu import faults, observe
+
+logger = logging.getLogger(__name__)
 
 
 def _try_orbax():
@@ -37,7 +54,9 @@ class TrainingCheckpointer:
 
     save(step, net) / restore(net) -> step. Directory layout:
     <dir>/step_<N>/ (orbax) or <dir>/step_<N>.npz (fallback), plus
-    latest.json marker. keep_last retention mirrors CheckpointListener.
+    latest.json marker (now carrying a sha256 per .npz checkpoint).
+    keep_last retention mirrors CheckpointListener. Saves are atomic and
+    restores verify + fall back — see the module docstring.
     """
 
     def __init__(self, directory: str, keep_last: Optional[int] = 3,
@@ -66,8 +85,17 @@ class TrainingCheckpointer:
             state["rng_key"] = np.asarray(jax.random.key_data(key))
         return state
 
+    @staticmethod
+    def _sha256_of(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
     def save(self, step: int, net) -> str:
         state = self._state_of(net)
+        checksum = None
         if self._ocp is not None:
             path = os.path.join(self.dir, f"step_{step}")
             ckptr = self._ocp.StandardCheckpointer()
@@ -80,19 +108,47 @@ class TrainingCheckpointer:
             for kp, leaf in leaves:
                 key = jax.tree_util.keystr(kp)
                 flat[key] = np.asarray(leaf)
-            np.savez(path, **flat)
-        self._saved.append((step, path))
-        with open(os.path.join(self.dir, "latest.json"), "w") as f:
-            json.dump({"step": step, "path": path,
-                       "saved": [[s, p] for s, p in self._saved]}, f)
+            # atomic: all bytes land (and reach disk — fsync) under a temp
+            # name; the rename publishes a complete file or nothing. The
+            # checksum is taken pre-publish so the marker always describes
+            # the bytes the save INTENDED — later corruption (torn device,
+            # the injected fault below) is caught by restore's verify.
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **flat)
+                f.flush()
+                os.fsync(f.fileno())
+            checksum = self._sha256_of(tmp)
+            os.replace(tmp, path)
+            if faults.should_fire("checkpoint_torn_write"):
+                # chaos (docs/ROBUSTNESS.md): simulate on-disk corruption
+                # AFTER the atomic publish — exactly the case the marker
+                # checksum + restore fallback exist for
+                with open(path, "r+b") as f:
+                    f.truncate(max(1, os.path.getsize(path) // 2))
+        self._saved.append((step, path, checksum))
+        self._write_marker(step, path)
         self._retain()
+        observe.metrics().counter("dl4j_tpu_checkpoint_saves_total").inc()
         return path
+
+    def _write_marker(self, step: int, path: str) -> None:
+        """Atomic marker update — a crash between checkpoint publish and
+        marker write loses the newest entry, never the marker itself."""
+        marker = os.path.join(self.dir, "latest.json")
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "path": path,
+                       "saved": [[s, p, c] for s, p, c in self._saved]}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, marker)
 
     def _retain(self):
         if self.keep_last is None:
             return
         while len(self._saved) > self.keep_last:
-            _, old = self._saved.pop(0)
+            _, old, _ = self._saved.pop(0)
             if os.path.isdir(old):
                 import shutil
 
@@ -105,37 +161,100 @@ class TrainingCheckpointer:
         if os.path.exists(marker):
             with open(marker) as f:
                 d = json.load(f)
-            self._saved = [(s, p) for s, p in d.get("saved", []) if os.path.exists(p)]
+            self._saved = [
+                # pre-robustness markers carry [step, path] pairs: keep
+                # loading them (checksum None -> restore skips the verify)
+                (e[0], e[1], e[2] if len(e) > 2 else None)
+                for e in d.get("saved", []) if os.path.exists(e[1])]
 
     # --------------------------------------------------------------- restore
     def latest_step(self) -> Optional[int]:
         return self._saved[-1][0] if self._saved else None
 
+    def _verify(self, path: str, checksum: Optional[str]) -> bool:
+        """Content integrity: sha256 vs the marker (skip when the entry
+        predates checksums or is an orbax directory)."""
+        if checksum is None or os.path.isdir(path):
+            return True
+        try:
+            return self._sha256_of(path) == checksum
+        except OSError:
+            return False
+
     def restore(self, net, step: Optional[int] = None) -> Optional[int]:
         """Restore into the net (its init() must already have built the
-        matching pytree structure). Returns the restored step or None."""
+        matching pytree structure). Returns the restored step or None.
+
+        With ``step=None`` candidates are tried NEWEST-FIRST: a checkpoint
+        whose checksum mismatches (torn write, disk corruption) or whose
+        load raises is skipped with a warning and the next-newest intact
+        one is used — corruption costs one save interval, not the run.
+        An explicitly requested ``step`` that is corrupt raises (the
+        caller asked for those exact bytes)."""
         if not self._saved:
             return None
-        step, path = self._saved[-1] if step is None else next(
-            (s, p) for s, p in self._saved if s == step)
+        if step is None:
+            candidates = list(reversed(self._saved))
+        else:
+            candidates = [next((s, p, c) for s, p, c in self._saved
+                               if s == step)]
+        newest = candidates[0][0]
+        for cand_step, path, checksum in candidates:
+            if not self._verify(path, checksum):
+                observe.metrics().counter(
+                    "dl4j_tpu_checkpoint_corrupt_total").inc()
+                if step is not None:
+                    raise IOError(
+                        f"checkpoint step {cand_step} at {path} failed its "
+                        f"integrity check (torn write?)")
+                logger.warning(
+                    "checkpoint step %d at %s failed its integrity check — "
+                    "falling back to the next-newest intact checkpoint",
+                    cand_step, path)
+                continue
+            try:
+                restored = self._load_state(net, path)
+            except Exception as e:
+                observe.metrics().counter(
+                    "dl4j_tpu_checkpoint_corrupt_total").inc()
+                if step is not None:
+                    raise
+                logger.warning(
+                    "checkpoint step %d at %s failed to load (%r) — "
+                    "falling back", cand_step, path, e)
+                continue
+            if cand_step != newest:
+                observe.metrics().counter(
+                    "dl4j_tpu_checkpoint_fallback_total").inc()
+                observe.log_event("checkpoint_fallback",
+                                  wanted=newest, used=cand_step)
+            self._apply_state(net, restored)
+            return cand_step
+        logger.warning(
+            "no intact checkpoint found under %s — restore skipped "
+            "(training resumes from the net's current state)", self.dir)
+        return None
+
+    def _load_state(self, net, path: str) -> Dict[str, Any]:
         target = self._state_of(net)
         if self._ocp is not None and os.path.isdir(path):
             ckptr = self._ocp.StandardCheckpointer()
-            restored = ckptr.restore(path, target=jax.device_get(target))
-        else:
-            data = np.load(path)
-            leaves_p = jax.tree_util.tree_leaves_with_path(target)
-            restored_leaves = []
-            for kp, leaf in leaves_p:
-                key = jax.tree_util.keystr(kp)
-                if key not in data and key.startswith("['rng_key']"):
-                    # pre-round-4 checkpoint without the RNG stream: keep
-                    # the net's current key rather than failing the restore
-                    restored_leaves.append(np.asarray(leaf))
-                    continue
-                restored_leaves.append(data[key])
-            treedef = jax.tree_util.tree_structure(target)
-            restored = jax.tree_util.tree_unflatten(treedef, restored_leaves)
+            return ckptr.restore(path, target=jax.device_get(target))
+        data = np.load(path)
+        leaves_p = jax.tree_util.tree_leaves_with_path(target)
+        restored_leaves = []
+        for kp, leaf in leaves_p:
+            key = jax.tree_util.keystr(kp)
+            if key not in data and key.startswith("['rng_key']"):
+                # pre-round-4 checkpoint without the RNG stream: keep
+                # the net's current key rather than failing the restore
+                restored_leaves.append(np.asarray(leaf))
+                continue
+            restored_leaves.append(data[key])
+        treedef = jax.tree_util.tree_structure(target)
+        return jax.tree_util.tree_unflatten(treedef, restored_leaves)
+
+    def _apply_state(self, net, restored: Dict[str, Any]) -> None:
         net.params = jax.tree.map(jnp.asarray, restored["params"])
         net.opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
         net.net_state = jax.tree.map(jnp.asarray, restored["net_state"])
@@ -145,7 +264,6 @@ class TrainingCheckpointer:
             net._key = jax.random.wrap_key_data(
                 jnp.asarray(restored["rng_key"]),
                 impl=jax.random.key_impl(net._key))
-        return step
 
 
 class CheckpointTrainingListener:

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu import observe
+from deeplearning4j_tpu import faults, observe
 from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
 
 logger = logging.getLogger(__name__)
@@ -433,6 +433,10 @@ class ParallelInference:
         }
         self._queue = _queue.Queue()
         self._stop = False
+        # chaos hook (docs/ROBUSTNESS.md): an injected backend failure at
+        # server start must surface HERE, synchronously, not as a hung
+        # serving loop the first predict() blocks on forever
+        faults.maybe_fail("backend_init_fail")
         repl = NamedSharding(self.mesh, P())
         with self.mesh:
             self._placed = (jax.device_put(self.net.params, repl),
@@ -536,6 +540,11 @@ class ParallelInference:
         import time as _time
 
         try:
+            # chaos hook: a backend worker dying mid-batch — the existing
+            # contract (every future in the batch gets the exception,
+            # the loop survives for the next batch) is what
+            # tests/test_robustness.py asserts through this injection
+            faults.maybe_fail("backend_init_fail")
             t_dispatch = _time.perf_counter()
             obs = self._obs
             xs, futs, sizes = [], [], []

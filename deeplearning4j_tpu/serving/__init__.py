@@ -11,7 +11,12 @@ transformer (``models/gpt.py``) served through
 * :class:`GenerativeEngine` — the compiled prefill/decode/write functions
   whose jit signatures depend only on server configuration (compile once,
   serve any mix of sequences) plus temperature/top-k/top-p sampling with
-  per-slot split PRNG keys (``serving/sampling.py``).
+  per-slot split PRNG keys (``serving/sampling.py``); SUPERVISED since the
+  robustness tier (docs/ROBUSTNESS.md): worker crashes restart under
+  capped backoff with retry re-admission, per-request deadlines, and a
+  bounded-queue admission gate that sheds overload as a terminal ``shed``
+  reason — exercised by ``make chaos-smoke`` over the
+  ``deeplearning4j_tpu/faults/`` injection points.
 
 Serve it directly or through the ``ParallelInference.generative`` facade
 (``parallel/mesh.py``). ``BENCH_MODEL=generate`` (bench.py) measures

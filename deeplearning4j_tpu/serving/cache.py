@@ -35,6 +35,8 @@ from typing import List
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import faults
+
 
 class PagedKVCache:
     """Fixed-pool paged KV storage + free-list allocator (host-side
@@ -56,8 +58,10 @@ class PagedKVCache:
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.trash_page = self.num_pages
         # +1: the trash page — see module docstring
-        self.kv = jnp.zeros((layers, 2, self.num_pages + 1, self.page_size,
-                             heads, head_dim), dtype)
+        self._kv_shape = (layers, 2, self.num_pages + 1, self.page_size,
+                          heads, head_dim)
+        self._kv_dtype = dtype
+        self.kv = jnp.zeros(self._kv_shape, self._kv_dtype)
         self.free: List[int] = list(range(self.num_pages))
         self.page_table = np.full((self.max_slots, self.max_pages_per_seq),
                                   self.trash_page, np.int32)
@@ -93,6 +97,10 @@ class PagedKVCache:
         have = len(self.owned[slot])
         if need <= have:
             return "ok"
+        if faults.should_fire("page_oom"):
+            # injected pool pressure: report exhaustion WITHOUT touching
+            # the slot's pages — identical contract to the real oom arm
+            return "oom"
         if need > self.max_pages_per_seq:
             return "overflow"
         if need - have > len(self.free):
@@ -112,6 +120,15 @@ class PagedKVCache:
         self.page_table[slot, :] = self.trash_page
         self.seq_lens[slot] = 0
         return released
+
+    def reset_kv(self) -> None:
+        """Reallocate the device page pool (supervised crash recovery): a
+        decode step that died mid-call may have consumed the DONATED kv
+        buffer, leaving ``self.kv`` pointing at deleted device memory.
+        Shape and dtype are unchanged, so the engine's cached jit
+        signatures stay valid — recovery never recompiles. Host-side page
+        accounting is untouched; the caller frees/retries slots."""
+        self.kv = jnp.zeros(self._kv_shape, self._kv_dtype)
 
     def check_invariants(self) -> None:
         """Allocator soundness (test hook): partition property + table/owned

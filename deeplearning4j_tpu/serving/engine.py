@@ -23,6 +23,19 @@ Observability (docs/OBSERVABILITY.md catalog additions): admitted/evicted/
 generated-token counters, slot-occupancy gauge, decode-step latency
 histogram, TTFT + inter-token histograms, ``serving_prefill``/
 ``serving_decode`` spans, and ledger notes on both compiled functions.
+
+**Supervision** (docs/ROBUSTNESS.md): a decode-step exception or worker
+death no longer kills the engine. The supervisor frees every slot,
+re-queues requests with retry budget left (front of the queue, original
+submit time), completes the rest terminally as ``error``, reallocates the
+possibly-donated KV buffer (same shape — the cached jit functions survive,
+so recovery shows ZERO ``new_shape`` ledger events), and restarts the
+worker under capped exponential backoff up to ``max_restarts``. Per-request
+deadlines retire overdue work as ``deadline`` whether queued or mid-decode,
+and a bounded pending queue (``max_queue``) sheds over-capacity
+submissions immediately as ``shed`` — every submitted request reaches a
+terminal finish reason, which is the property the ``chaos`` gate stage
+asserts under an injected fault schedule (deeplearning4j_tpu/faults/).
 """
 
 from __future__ import annotations
@@ -39,7 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu import observe
+from deeplearning4j_tpu import faults, observe
 from deeplearning4j_tpu.models.gpt import GptModel, gpt_decode_step, gpt_prefill
 from deeplearning4j_tpu.serving.cache import PagedKVCache
 from deeplearning4j_tpu.serving.sampling import sample_tokens
@@ -68,7 +81,10 @@ class GenerativeEngine:
     def __init__(self, model: GptModel, *, max_slots: int = 4,
                  page_size: int = 16, num_pages: Optional[int] = None,
                  max_pages_per_seq: int = 8, max_prompt: int = 32,
-                 seed: int = 0):
+                 seed: int = 0, supervise: bool = True,
+                 max_restarts: int = 3, restart_backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0, max_queue: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None):
         cfg = model.cfg
         if cfg.hidden % cfg.heads:
             raise ValueError("hidden must be divisible by heads")
@@ -107,6 +123,16 @@ class GenerativeEngine:
         self._worker: Optional[threading.Thread] = None
         self._stop_flag = False
         self._error: Optional[Exception] = None
+        # ------------------------------------------ supervisor configuration
+        self.supervise = bool(supervise)
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.default_deadline_s = default_deadline_s
+        self.restarts = 0            # lifetime crash recoveries (<= cap)
+        self.stopped_cleanly = True  # last stop() joined its worker in time
+        self._lifecycle = threading.Lock()  # guards _worker hand-off
         m = observe.metrics()
         self._obs = {
             "admitted": m.counter("dl4j_tpu_serving_admitted_total"),
@@ -115,6 +141,12 @@ class GenerativeEngine:
             "decode_h": m.histogram("dl4j_tpu_serving_decode_step_seconds"),
             "ttft_h": m.histogram("dl4j_tpu_serving_ttft_seconds"),
             "itl_h": m.histogram("dl4j_tpu_serving_intertoken_seconds"),
+            "restarts": m.counter("dl4j_tpu_serving_engine_restarts_total"),
+            "retries": m.counter("dl4j_tpu_serving_retries_total"),
+            # written ONLY by stop(): the gauge is process-global, and a
+            # constructor write here would clobber a previous engine's
+            # hung-stop indication while that engine is still wedged
+            "stopped_g": m.gauge("dl4j_tpu_serving_stopped_cleanly"),
         }
 
     # ------------------------------------------------------------------ keys
@@ -178,18 +210,29 @@ class GenerativeEngine:
     # ------------------------------------------------------------------- api
     def submit(self, prompt, *, max_new_tokens: int = 16,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-               eos_token: Optional[int] = None
+               eos_token: Optional[int] = None,
+               deadline_s: Optional[float] = None, max_retries: int = 1
                ) -> "Future[GenerationResult]":
         """Queue one generation; returns a Future (thread-safe). A stopped
-        engine rejects new work — build a fresh one."""
+        engine rejects new work — build a fresh one.
+
+        ``deadline_s`` bounds submit->terminal wall time (engine default
+        when None); ``max_retries`` is this request's crash re-admission
+        budget (docs/ROBUSTNESS.md). When the pending queue is at
+        ``max_queue``, the request is SHED: the future completes
+        immediately with the terminal reason ``"shed"`` — callers always
+        get a terminal state, never a hang."""
         if self._error is not None:
             raise RuntimeError("engine loop died") from self._error
-        if self._stop_flag and self._worker is None:
+        if self._stop_flag:
             raise RuntimeError("engine stopped — submit rejected")
         eos = self.cfg.eos_token if eos_token is None else eos_token
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
         req = GenerationRequest(
             prompt=prompt, max_new_tokens=max_new_tokens,
-            temperature=temperature, top_k=top_k, top_p=top_p, eos_token=eos)
+            temperature=temperature, top_k=top_k, top_p=top_p, eos_token=eos,
+            deadline_s=deadline_s, max_retries=max_retries)
         if req.prompt.size > self.max_prompt:
             raise ValueError(
                 f"prompt length {req.prompt.size} exceeds the engine's "
@@ -201,50 +244,99 @@ class GenerativeEngine:
             raise ValueError(
                 f"prompt token ids must be in [0, {self.cfg.vocab_size}), "
                 f"got range [{lo}, {hi}]")
+        if (self.max_queue is not None
+                and len(self.scheduler.pending) >= self.max_queue):
+            # admission gate: shedding is a TERMINAL result, not an
+            # exception — overload is an expected state the SLO frontend
+            # steers by, and every caller still gets a definitive answer
+            fut: "Future[GenerationResult]" = Future()
+            self._finish_unslotted(req, fut, "shed")
+            return fut
         fut = self.scheduler.submit(req)
-        if self._error is not None or (self._stop_flag
-                                       and self._worker is None):
-            # the loop died or stop() completed between the checks above
-            # and our enqueue — its fail_all may have drained pending
-            # before we appended; fail everything (incl. this future) so
-            # result() can never hang
-            self.scheduler.fail_all(
-                RuntimeError("engine stopped" if self._error is None
-                             else "engine loop died"))
+        if self._error is not None:
+            # the loop died between the checks above and our enqueue — its
+            # fail_all may have drained pending before we appended; fail
+            # everything (incl. this future) so result() can never hang
+            self.scheduler.fail_all(RuntimeError("engine loop died"))
+        elif self._stop_flag:
+            # stop() started concurrently and may still be JOINING a live
+            # worker: rescue only the queued (never-admitted) futures —
+            # touching active slots here would race the worker's step,
+            # corrupt page accounting, and burn a restart on a KeyError.
+            # stop() itself retires the active slots after the join.
+            self.scheduler.fail_pending(RuntimeError("engine stopped"))
         return fut
 
     def generate(self, prompts: Sequence, **kw) -> List[GenerationResult]:
         """Synchronous batch generation: submit everything, run the
-        scheduler loop inline until drained."""
+        scheduler loop inline until drained. Crash recovery applies here
+        too (same supervisor, no worker thread): a step that dies inside
+        the retry budget re-admits and continues; past the budget the
+        original exception propagates to the caller."""
         if self._worker is not None:
             raise RuntimeError("generate() is the inline mode — the engine "
                                "is already running a serving loop; use "
                                "submit()")
         futs = [self.submit(p, **kw) for p in prompts]
         while self.scheduler.has_work():
-            self.step()
+            try:
+                self.step()
+            except Exception as e:
+                if not self._recover(e):
+                    self._error = e
+                    self.scheduler.fail_all(e)
+                    raise
         return [f.result() for f in futs]
 
     def start(self) -> "GenerativeEngine":
-        if self._worker is not None:
-            return self
-        self._stop_flag = False
-        self._worker = threading.Thread(target=self._serve_loop, daemon=True)
-        self._worker.start()
+        with self._lifecycle:
+            if self._worker is not None:
+                return self
+            self._stop_flag = False
+            self._worker = threading.Thread(target=self._serve_loop,
+                                            daemon=True)
+            self._worker.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop the serving loop. In-flight sequences retire with their
+        partial output and the documented ``"stopped"`` reason; queued
+        requests fail. A worker that does not join within ``timeout``
+        (a stuck decode step) is detected and reported — logged ONCE,
+        ``stopped_cleanly`` False, ``dl4j_tpu_serving_stopped_cleanly``
+        gauge 0 — instead of silently abandoning the thread; the engine
+        is left not restartable and active-slot futures stay with the
+        stuck worker (completing them here would race it)."""
         self._stop_flag = True
-        if self._worker is not None:
-            self._worker.join(timeout=30)
-            if self._worker.is_alive():
+        while True:
+            with self._lifecycle:
+                w = self._worker
+            if w is None or w is threading.current_thread():
+                break
+            w.join(timeout=timeout)
+            if w.is_alive():
                 # do NOT null _worker: a restart would race the stuck
                 # thread over the same cache/scheduler (double page frees,
                 # double-donated kv buffer)
-                raise RuntimeError(
-                    "serving loop still running after 30s (a decode step "
-                    "is stuck); engine left stopping, not restartable")
-            self._worker = None
+                self.stopped_cleanly = False
+                self._obs["stopped_g"].set(0.0)
+                logger.error(
+                    "serving loop still running after %.0fs (a decode step "
+                    "is stuck); engine left stopping, not restartable — "
+                    "failing queued requests only", timeout)
+                observe.log_event("engine_stop_hung", timeout_s=timeout)
+                self.scheduler.fail_pending(
+                    RuntimeError("GenerativeEngine stop timed out with the "
+                                 "worker hung; queued request failed"))
+                return
+            with self._lifecycle:
+                if self._worker is w:
+                    self._worker = None
+                    break
+                # a crash-recovery respawn won the hand-off before we set
+                # the flag — loop again and join the replacement too
+        self.stopped_cleanly = True
+        self._obs["stopped_g"].set(1.0)
         # in-flight sequences retire with their partial output and the
         # documented "stopped" reason (the worker is joined — no race);
         # queued-but-never-admitted requests fail
@@ -260,12 +352,82 @@ class GenerativeEngine:
                 time.sleep(1e-3)
                 continue
             try:
+                faults.maybe_fail("worker_death")
                 self.step()
-            except Exception as e:  # pragma: no cover - defensive
-                logger.exception("serving loop died")
+            except Exception as e:
+                if self._recover(e):
+                    # this worker retires; a REPLACEMENT thread owns the
+                    # loop from here (observable restart: new thread, new
+                    # ident, engine_restarts_total incremented) — unless
+                    # stop() raced us, in which case it joins this thread
+                    # and finds no work to hand over
+                    with self._lifecycle:
+                        if self._stop_flag:
+                            return
+                        self._worker = threading.Thread(
+                            target=self._serve_loop, daemon=True)
+                        self._worker.start()
+                    return
+                logger.exception("serving loop died (unrecoverable)")
                 self._error = e
                 self.scheduler.fail_all(e)
                 return
+
+    # ------------------------------------------------------------ supervisor
+    def _finish_unslotted(self, req, fut, reason: str) -> None:
+        """Complete a future that never held (or no longer holds) a slot
+        with a terminal result: shed at admission, deadline in queue,
+        error past the retry budget."""
+        if not fut.done():
+            fut.set_result(GenerationResult(
+                tokens=np.zeros((0,), np.int32), finish_reason=reason,
+                prompt_len=int(req.prompt.size), ttft_s=None,
+                intertoken_s=[]))
+        observe.metrics().counter(
+            "dl4j_tpu_serving_evicted_total", reason=reason).inc()
+        observe.log_event("serving_terminal", reason=reason)
+
+    def _recover(self, exc: Exception) -> bool:
+        """Crash recovery (docs/ROBUSTNESS.md state machine): free every
+        slot, re-queue requests with retry budget left (front of queue,
+        original submit time), fail the rest terminally as ``error``,
+        reallocate the possibly-donated KV buffer, and back off
+        exponentially (capped). Returns False when unsupervised or the
+        restart budget is spent — the caller escalates to fail_all."""
+        if not self.supervise or self.restarts >= self.max_restarts:
+            return False
+        self.restarts += 1
+        self._obs["restarts"].inc()
+        logger.warning("engine worker died (%r) — restart %d/%d",
+                       exc, self.restarts, self.max_restarts)
+        sched, cache = self.scheduler, self.cache
+        # reversed: appendleft re-queues LAST-iterated first, and slots are
+        # assigned lowest-free-first, so reverse slot order restores the
+        # requests' original arrival order at the front of the queue
+        for slot in reversed(sched.active_slots()):
+            st = sched.slots.pop(slot)
+            cache.free_slot(slot)
+            req = st.request
+            if req.retries_used < req.max_retries:
+                # retryable: back to the FRONT of the queue with its
+                # original submit time (deadline keeps counting across
+                # the crash) — generation restarts from the prompt
+                req.retries_used += 1
+                self._obs["retries"].inc()
+                sched.pending.appendleft((req, st.future, st.submit_t))
+            else:
+                self._finish_unslotted(req, st.future, "error")
+        # the crash may have killed a decode step AFTER the donation of
+        # cache.kv; same-shape reallocation keeps the cached jit fns (and
+        # therefore the ledger's zero-new_shape property) intact
+        cache.reset_kv()
+        observe.log_event("engine_restart", restart=self.restarts,
+                          error=repr(exc))
+        delay = min(self.max_backoff_s,
+                    self.restart_backoff_s * (2 ** (self.restarts - 1)))
+        if delay > 0:
+            time.sleep(delay)
+        return True
 
     # ------------------------------------------------------------ scheduling
     def _retire(self, slot: int, reason: str) -> None:
@@ -288,6 +450,21 @@ class GenerativeEngine:
             reason = sched.should_finish(slot)
             if reason:
                 self._retire(slot, reason)
+
+        # 1b. deadlines — AFTER completion so a finished sequence keeps its
+        #     honest eos/length reason; overdue work retires as "deadline"
+        #     (active: partial tokens; queued: empty result, no slot taken)
+        now = time.perf_counter()
+        for slot in sched.active_slots():
+            dl = sched.slots[slot].request.deadline_s
+            if dl is not None and now - sched.slots[slot].submit_t > dl:
+                self._retire(slot, "deadline")
+        for _ in range(len(sched.pending)):
+            req, fut, t_sub = sched.pending.popleft()
+            if req.deadline_s is not None and now - t_sub > req.deadline_s:
+                self._finish_unslotted(req, fut, "deadline")
+            else:
+                sched.pending.append((req, fut, t_sub))
 
         # 2. capacity: every surviving slot needs room for one more token
         for slot in sched.active_slots():
@@ -324,7 +501,15 @@ class GenerativeEngine:
                     continue
                 break  # pool pressure: wait for evictions to free pages
             slot = free[0]
-            cache.ensure_capacity(slot, p_len + 1)
+            status = cache.ensure_capacity(slot, p_len + 1)
+            if status != "ok":
+                # the free-pages precheck passed, so this is injected pool
+                # pressure (faults.page_oom) or an allocator race: complete
+                # the request terminally instead of prefilling into a
+                # trash-page-only row (which would corrupt the invariants)
+                sched.pending.popleft()
+                self._finish_unslotted(req, fut, status)
+                continue
             first_tok = self._prefill_into(slot, req)
             cache.seq_lens[slot] = p_len
             now = time.perf_counter()
@@ -362,6 +547,11 @@ class GenerativeEngine:
             top_p[slot] = st.request.top_p
         if self._decode_fn is None:
             self._decode_fn = self._build_decode()
+        # chaos hooks (docs/ROBUSTNESS.md): both fire BEFORE the dispatch
+        # so an injected crash never leaves the donated kv buffer half
+        # consumed inside a real XLA call
+        faults.maybe_fail("decode_step_error")
+        faults.maybe_sleep("slow_decode", 0.05)
         key = self._next_key()
         args = (jnp.asarray(cache.page_table), jnp.asarray(cache.seq_lens),
                 jnp.asarray(tokens), jnp.asarray(act))

@@ -18,8 +18,13 @@ Slot lifecycle::
 
     FREE --admit(prefill ok)--> ACTIVE --finish(eos|length)--> FREE
                                    \\--evict(overflow|oom|stopped)--> FREE
+                                   \\--expire(deadline)--> FREE
+                                   \\--crash(retryable)--> PENDING (retry)
+                                   \\--crash(budget spent: error)--> FREE
 
 ``GenerationResult.finish_reason`` records which arc retired the request.
+``shed`` never reaches a slot: the engine's bounded-queue admission gate
+completes over-capacity submissions immediately (docs/ROBUSTNESS.md).
 """
 
 from __future__ import annotations
@@ -32,7 +37,13 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-FINISH_REASONS = ("eos", "length", "overflow", "oom", "stopped")
+# Terminal states. The last three are the robustness tier's
+# (docs/ROBUSTNESS.md): "shed" = bounded-queue admission rejected the
+# request, "deadline" = its per-request deadline expired (queued or
+# mid-decode), "error" = a worker crash consumed its whole retry budget.
+# The SLO frontend (ROADMAP item 2d) consumes these as load signals.
+FINISH_REASONS = ("eos", "length", "overflow", "oom", "stopped",
+                  "shed", "deadline", "error")
 
 
 @dataclasses.dataclass
@@ -46,6 +57,9 @@ class GenerationRequest:
     top_k: int = 0                   # 0 -> disabled
     top_p: float = 1.0               # 1.0 -> disabled
     eos_token: int = -1              # -1 -> never stop on a token
+    deadline_s: Optional[float] = None  # submit -> terminal budget (wall)
+    max_retries: int = 1             # crash re-admissions before "error"
+    retries_used: int = 0            # supervisor bookkeeping, not user-set
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -61,6 +75,12 @@ class GenerationRequest:
             # emitting id 0; "disable" is top_p=1.0
             raise ValueError(f"top_p must be in (0, 1] (1.0 disables), "
                              f"got {self.top_p}")
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0 (None disables), "
+                             f"got {self.deadline_s}")
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, "
+                             f"got {self.max_retries}")
 
 
 @dataclasses.dataclass
@@ -169,6 +189,12 @@ class SlotScheduler:
             st = self.slots.pop(slot, None)  # tolerate a concurrent caller
             if st is not None and not st.future.done():
                 st.future.set_exception(exc)
+        self.fail_pending(exc)
+
+    def fail_pending(self, exc: Exception) -> None:
+        """Fail ONLY the queued-but-never-admitted futures. Used alone when
+        a hung worker may still own the active slots (stop() timeout):
+        completing those futures here would race the stuck thread."""
         while True:
             try:
                 _req, fut, _t = self.pending.popleft()

@@ -1,0 +1,295 @@
+"""Fault-injection registry + durable-checkpoint tests
+(deeplearning4j_tpu/faults/, parallel/checkpoint.py — docs/ROBUSTNESS.md).
+
+Covers the injection machinery itself (arming, schedules, env parsing,
+determinism, the metric/counting contract) and the checkpoint durability
+guarantees (atomic publish, checksum verification, newest-intact
+fallback) the ``checkpoint_torn_write`` point exists to exercise. The
+engine-supervisor behaviors live in tests/test_robustness.py.
+"""
+
+import json
+import logging
+import os
+import types
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import faults, observe
+from deeplearning4j_tpu.faults import FaultSpec, InjectedFault
+from deeplearning4j_tpu.parallel.checkpoint import TrainingCheckpointer
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.FAULTS_ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# injection registry
+# ---------------------------------------------------------------------------
+
+
+class TestFaultRegistry:
+    def test_unarmed_never_fires(self):
+        assert not faults.active()
+        for point in faults.FAULT_POINTS:
+            assert not faults.should_fire(point)
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faults.arm("not_a_point")
+
+    def test_arm_prob_one_always_fires(self):
+        faults.arm("page_oom", prob=1.0)
+        assert all(faults.should_fire("page_oom") for _ in range(5))
+        assert faults.fire_counts() == {"page_oom": 5}
+
+    def test_prob_zero_never_fires(self):
+        faults.arm("page_oom", prob=0.0)
+        assert not any(faults.should_fire("page_oom") for _ in range(20))
+
+    def test_after_n_skips_first_calls(self):
+        faults.arm("decode_step_error", prob=1.0, after_n=3)
+        fired = [faults.should_fire("decode_step_error") for _ in range(5)]
+        assert fired == [False, False, False, True, True]
+
+    def test_max_fires_caps_schedule(self):
+        faults.arm("worker_death", prob=1.0, max_fires=2)
+        fired = [faults.should_fire("worker_death") for _ in range(5)]
+        assert fired == [True, True, False, False, False]
+
+    def test_seeded_schedule_is_deterministic(self):
+        faults.arm("page_oom", prob=0.5, seed=7)
+        a = [faults.should_fire("page_oom") for _ in range(32)]
+        faults.reset()
+        faults.arm("page_oom", prob=0.5, seed=7)
+        b = [faults.should_fire("page_oom") for _ in range(32)]
+        assert a == b and any(a) and not all(a)
+
+    def test_disarm_and_reset(self):
+        faults.arm("page_oom")
+        faults.arm("slow_decode")
+        faults.disarm("page_oom")
+        assert not faults.should_fire("page_oom")
+        assert faults.should_fire("slow_decode")
+        faults.reset()
+        assert not faults.should_fire("slow_decode")
+
+    def test_maybe_fail_raises_injected_fault(self):
+        faults.arm("decode_step_error")
+        with pytest.raises(InjectedFault, match="decode_step_error") as ei:
+            faults.maybe_fail("decode_step_error")
+        assert ei.value.point == "decode_step_error"
+        # unarmed points pass through silently
+        faults.maybe_fail("page_oom")
+
+    def test_fires_counted_in_metric_family(self):
+        observe.reset()
+        faults.arm("page_oom", max_fires=3)
+        for _ in range(5):
+            faults.should_fire("page_oom")
+        m = observe.metrics()
+        assert m.counter("dl4j_tpu_faults_injected_total",
+                         point="page_oom").value == 3
+        assert m.family_total("dl4j_tpu_faults_injected_total") == 3
+
+    def test_invalid_spec_rejected(self):
+        with pytest.raises(ValueError, match="prob"):
+            FaultSpec(point="page_oom", prob=1.5)
+        with pytest.raises(ValueError, match="after_n"):
+            FaultSpec(point="page_oom", after_n=-1)
+
+
+class TestEnvSchedule:
+    def test_env_syntax_point_prob_after(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULTS_ENV, "page_oom:1:2,slow_decode:0")
+        assert faults.active()
+        fired = [faults.should_fire("page_oom") for _ in range(4)]
+        assert fired == [False, False, True, True]
+        assert not faults.should_fire("slow_decode")  # prob 0
+
+    def test_env_point_alone_means_always(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULTS_ENV, "decode_step_error")
+        assert faults.should_fire("decode_step_error")
+
+    def test_malformed_env_entry_ignored(self, monkeypatch, caplog):
+        monkeypatch.setenv(faults.FAULTS_ENV,
+                           "bogus_point:1,page_oom:notafloat,slow_decode:1")
+        with caplog.at_level(logging.WARNING):
+            assert faults.should_fire("slow_decode")
+        assert not faults.should_fire("page_oom")
+
+    def test_programmatic_arm_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULTS_ENV, "page_oom:1")
+        faults.arm("page_oom", prob=0.0)
+        assert not faults.should_fire("page_oom")
+
+    def test_env_unset_is_inactive(self):
+        assert not faults.active()
+
+
+# ---------------------------------------------------------------------------
+# durable checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _fake_net(value: float):
+    net = types.SimpleNamespace()
+    net.params = {"W": np.full((4, 4), float(value), np.float32)}
+    net.opt_state = {"W": np.zeros((4, 4), np.float32)}
+    net.net_state = {}
+    net.iteration_count = int(value)
+    net.epoch_count = 0
+    return net
+
+
+class TestDurableCheckpoints:
+    def make(self, tmp_path, **kw):
+        kw.setdefault("use_orbax", False)
+        return TrainingCheckpointer(str(tmp_path / "ckpt"), **kw)
+
+    def test_atomic_save_no_temp_residue(self, tmp_path):
+        ck = self.make(tmp_path)
+        path = ck.save(1, _fake_net(1.0))
+        assert os.path.exists(path)
+        assert not any(f.endswith(".tmp")
+                       for f in os.listdir(os.path.dirname(path)))
+
+    def test_marker_carries_checksum(self, tmp_path):
+        ck = self.make(tmp_path)
+        ck.save(1, _fake_net(1.0))
+        with open(os.path.join(ck.dir, "latest.json")) as f:
+            d = json.load(f)
+        (step, path, checksum), = d["saved"]
+        assert step == 1 and len(checksum) == 64  # sha256 hex
+
+    def test_restore_falls_back_past_torn_file(self, tmp_path):
+        observe.reset()
+        ck = self.make(tmp_path)
+        ck.save(1, _fake_net(1.0))
+        ck.save(2, _fake_net(2.0))
+        p3 = ck.save(3, _fake_net(3.0))
+        with open(p3, "r+b") as f:  # torn write after publish
+            f.truncate(os.path.getsize(p3) // 2)
+        net = _fake_net(0.0)
+        assert ck.restore(net) == 2
+        assert net.params["W"][0, 0] == 2.0
+        m = observe.metrics()
+        assert m.counter("dl4j_tpu_checkpoint_corrupt_total").value >= 1
+        assert m.counter("dl4j_tpu_checkpoint_fallback_total").value == 1
+
+    def test_torn_write_fault_point(self, tmp_path):
+        """The chaos arm: checkpoint_torn_write corrupts the published
+        file; the checksum recorded pre-corruption exposes it."""
+        ck = self.make(tmp_path)
+        ck.save(1, _fake_net(1.0))
+        faults.arm("checkpoint_torn_write", max_fires=1)
+        ck.save(2, _fake_net(2.0))
+        net = _fake_net(0.0)
+        assert ck.restore(net) == 1
+        assert net.params["W"][0, 0] == 1.0
+
+    def test_all_corrupt_returns_none(self, tmp_path, caplog):
+        ck = self.make(tmp_path)
+        for s in (1, 2):
+            p = ck.save(s, _fake_net(s))
+            with open(p, "r+b") as f:
+                f.truncate(4)
+        with caplog.at_level(logging.WARNING):
+            assert ck.restore(_fake_net(0.0)) is None
+        assert "no intact checkpoint" in caplog.text
+
+    def test_explicit_corrupt_step_raises(self, tmp_path):
+        ck = self.make(tmp_path)
+        p1 = ck.save(1, _fake_net(1.0))
+        ck.save(2, _fake_net(2.0))
+        with open(p1, "r+b") as f:
+            f.truncate(4)
+        with pytest.raises(IOError, match="integrity"):
+            ck.restore(_fake_net(0.0), step=1)
+
+    def test_old_two_entry_marker_still_loads(self, tmp_path):
+        """Pre-robustness markers ([step, path] pairs, no checksum) keep
+        working — checksum None skips the verify."""
+        ck = self.make(tmp_path)
+        ck.save(1, _fake_net(1.0))
+        ck.save(2, _fake_net(2.0))
+        marker = os.path.join(ck.dir, "latest.json")
+        with open(marker) as f:
+            d = json.load(f)
+        d["saved"] = [[s, p] for s, p, _c in d["saved"]]
+        with open(marker, "w") as f:
+            json.dump(d, f)
+        ck2 = TrainingCheckpointer(ck.dir, use_orbax=False)
+        net = _fake_net(0.0)
+        assert ck2.restore(net) == 2
+        assert net.params["W"][0, 0] == 2.0
+
+    def test_unreadable_load_falls_back_not_raises(self, tmp_path):
+        """A checkpoint that passes no checksum but fails np.load (the
+        checksum-less legacy case) still falls back instead of raising
+        mid-fit."""
+        ck = self.make(tmp_path)
+        ck.save(1, _fake_net(1.0))
+        p2 = ck.save(2, _fake_net(2.0))
+        # legacy marker (no checksums), then corrupt the newest file
+        marker = os.path.join(ck.dir, "latest.json")
+        with open(marker) as f:
+            d = json.load(f)
+        d["saved"] = [[s, p] for s, p, _c in d["saved"]]
+        with open(marker, "w") as f:
+            json.dump(d, f)
+        with open(p2, "r+b") as f:
+            f.truncate(4)
+        ck2 = TrainingCheckpointer(ck.dir, use_orbax=False)
+        net = _fake_net(0.0)
+        assert ck2.restore(net) == 1
+        assert net.params["W"][0, 0] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# JSONL event-log hardening (observe/registry.py — satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestObsLogHardening:
+    def test_unwritable_path_warns_once_and_disables(self, tmp_path,
+                                                     monkeypatch, caplog):
+        observe.reset_log_state()
+        monkeypatch.setenv(observe.OBS_LOG_ENV, str(tmp_path))  # a DIRECTORY
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.observe.registry"):
+            observe.log_event("train_epoch", steps=1)  # must not raise
+            observe.log_event("train_epoch", steps=2)
+            observe.log_event("train_epoch", steps=3)
+        warnings = [r for r in caplog.records
+                    if "event logging DISABLED" in r.getMessage()]
+        assert len(warnings) == 1
+        observe.reset_log_state()
+
+    def test_fresh_path_reenables_after_failure(self, tmp_path, monkeypatch):
+        observe.reset_log_state()
+        monkeypatch.setenv(observe.OBS_LOG_ENV, str(tmp_path))  # fails
+        observe.log_event("train_epoch", steps=1)
+        good = tmp_path / "events.jsonl"
+        monkeypatch.setenv(observe.OBS_LOG_ENV, str(good))
+        observe.log_event("train_epoch", steps=2)  # different path: works
+        lines = good.read_text().strip().splitlines()
+        assert len(lines) == 1 and json.loads(lines[0])["steps"] == 2
+        observe.reset_log_state()
+
+    def test_reset_log_state_clears_disable(self, tmp_path, monkeypatch):
+        observe.reset_log_state()
+        bad_then_good = tmp_path / "log.jsonl"
+        monkeypatch.setenv(observe.OBS_LOG_ENV, str(tmp_path))
+        observe.log_event("x")           # disables the directory path
+        monkeypatch.setenv(observe.OBS_LOG_ENV, str(bad_then_good))
+        observe.reset_log_state()
+        observe.log_event("recovered", n=1)
+        assert "recovered" in bad_then_good.read_text()
+        observe.reset_log_state()

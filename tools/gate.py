@@ -26,6 +26,10 @@ Stages:
   8. tune smoke: tiny-shape autotune into a throwaway cache dir must
      produce a loadable tuning table and prove measured dispatch via the
      helper-dispatch counters (docs/KERNELS.md)
+  9. chaos smoke: tools/chaos.py under an injected fault schedule — every
+     request must reach a terminal finish reason, the supervisor must
+     restart within its cap with zero new_shape ledger events, and
+     restore() must fall back past a torn checkpoint (docs/ROBUSTNESS.md)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -265,6 +269,43 @@ def tune_stage() -> bool:
     return bool(ok)
 
 
+def chaos_stage() -> bool:
+    """Robustness smoke (docs/ROBUSTNESS.md): the chaos harness must
+    report ok — faults fired > 0 (all three required points), unresolved
+    requests == 0, restarts within cap, zero new_shape events, checkpoint
+    fallback intact. One JSON line, like lint/check/obs."""
+    print("== gate: chaos-smoke (fault injection + supervised recovery) ==",
+          flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_FAULTS", None)  # an ambient schedule would double-
+    try:                              # inject on top of the harness's own
+        proc = subprocess.run(
+            [sys.executable, "tools/chaos.py", "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (chaos-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (chaos-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    srv = rec.get("serving") or {}
+    ok = (bool(rec.get("ok"))
+          and (rec.get("faults_injected_total") or 0) > 0
+          and srv.get("unresolved") == 0)
+    print(f"   {'ok' if ok else 'FAIL'} (chaos-smoke: "
+          f"{rec.get('faults_injected_total')} faults, "
+          f"{srv.get('submitted')} submitted -> reasons {srv.get('reasons')}"
+          f", {srv.get('restarts')} restarts, checkpoint fallback "
+          f"{(rec.get('checkpoint') or {}).get('fallback_ok')})")
+    return bool(ok)
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -272,25 +313,27 @@ def multichip_stage() -> bool:
     gate log instead of a silent ok."""
     print("== gate: multichip dryrun (8 virtual CPU devices) ==", flush=True)
     try:
-        # outer timeout must exceed dryrun's own probe (240s) + worker
-        # timeout (1200s) so the hang case reaches the skipped line instead
-        # of being killed from outside just before reporting it
+        # outer timeout must exceed dryrun's own probe (240s) + the THREE
+        # per-stage worker watchdogs (3 × 600s default) so even the
+        # every-stage-hung case reaches its skipped lines instead of being
+        # killed from outside just before reporting them
         proc = subprocess.run(
             [sys.executable, "-c",
              "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
             cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
-            timeout=1800)
+            timeout=2100)
     except subprocess.TimeoutExpired:
         print("   FAIL (multichip timeout)")
         return False
-    skip = next((l for l in proc.stdout.splitlines()
-                 if l.startswith("{") and '"skipped": true' in l), None)
+    skips = [l for l in proc.stdout.splitlines()
+             if l.startswith("{") and '"skipped": true' in l]
     if proc.returncode != 0:
         tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-25:])
         print(f"   FAIL (multichip exit {proc.returncode})\n{tail}")
         return False
-    if skip:
-        print(f"   SKIPPED (environment): {skip}")
+    if skips:
+        for line in skips:  # per-stage watchdog markers — each is signal
+            print(f"   SKIPPED (environment): {line}")
         return True
     print("   ok (multichip)")
     return True
@@ -333,6 +376,7 @@ def main() -> int:
         results["obs"] = obs_stage()
         results["serve"] = serve_stage()
         results["tune"] = tune_stage()
+        results["chaos"] = chaos_stage()
         results["multichip"] = multichip_stage()
 
     failed = [k for k, v in results.items() if not v]
