@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke slo-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -54,6 +54,15 @@ tune:
 # ONE JSON line like lint/check/obs.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos.py --json
+
+# SLO smoke (docs/SERVING.md § SLO admission frontend): the goodput-
+# under-overload ramp, frontend on vs off with an identical offered
+# schedule — fails unless frontend-on goodput >= frontend-off, every
+# request reaches a terminal state on both legs, the degradation ladder
+# actually engaged, and zero new_shape ledger events were paid for it.
+# ONE JSON line like lint/check/obs/chaos.
+slo-smoke:
+	JAX_PLATFORMS=cpu python tools/slo.py --json
 
 # generative-serving smoke (docs/SERVING.md): continuous-batching
 # generation, smoke-sized, CPU-pinned — ONE JSON line with tokens/sec,

@@ -59,6 +59,8 @@ FAULTS_ENV = "DL4J_TPU_FAULTS"
 #:   worker_death          serving/engine.py _serve_loop     -> raise
 #:   checkpoint_torn_write parallel/checkpoint.py save       -> truncate file
 #:   backend_init_fail     parallel/mesh.py  ParallelInference -> raise
+#:   burst_arrival         serving/frontend.py SLOFrontend.submit
+#:                                            -> inject synthetic arrivals
 FAULT_POINTS = (
     "page_oom",
     "decode_step_error",
@@ -66,6 +68,7 @@ FAULT_POINTS = (
     "worker_death",
     "checkpoint_torn_write",
     "backend_init_fail",
+    "burst_arrival",
 )
 
 

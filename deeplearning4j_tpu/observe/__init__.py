@@ -130,6 +130,30 @@ def summary() -> Dict[str, Any]:
             "intertoken_p99_ms": _ms(itl["p99"]),
         }
 
+    slo_admitted = m.family_total("dl4j_tpu_slo_admitted_total")
+    slo_shed = m.family_total("dl4j_tpu_slo_shed_total")
+    if slo_admitted or slo_shed:
+        admitted_by_class: Dict[str, int] = {}
+        shed_by: Dict[str, int] = {}
+        transitions: Dict[str, int] = {}
+        for inst in m.instruments():
+            lbl = dict(inst.labels)
+            if inst.name == "dl4j_tpu_slo_admitted_total" and lbl:
+                admitted_by_class[lbl.get("class", "?")] = int(inst.value)
+            elif inst.name == "dl4j_tpu_slo_shed_total" and lbl:
+                key = f"{lbl.get('class')}/{lbl.get('reason')}"
+                shed_by[key] = shed_by.get(key, 0) + int(inst.value)
+            elif inst.name == "dl4j_tpu_slo_transitions_total" and lbl:
+                transitions[lbl.get("to", "?")] = int(inst.value)
+        out["slo"] = {
+            "state": int(m.gauge("dl4j_tpu_slo_state").value),
+            "breaker_open": int(m.gauge("dl4j_tpu_slo_breaker_open").value),
+            "admitted": dict(sorted(admitted_by_class.items())),
+            "shed": dict(sorted(shed_by.items())),
+            "degraded": int(m.family_total("dl4j_tpu_slo_degraded_total")),
+            "transitions": dict(sorted(transitions.items())),
+        }
+
     robustness = {
         "faults_injected": int(
             m.family_total("dl4j_tpu_faults_injected_total")),

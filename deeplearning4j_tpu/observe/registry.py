@@ -328,6 +328,16 @@ _CORE_METRICS: Tuple[Tuple[str, str], ...] = (
     ("counter", "dl4j_tpu_checkpoint_saves_total"),
     ("counter", "dl4j_tpu_checkpoint_corrupt_total"),
     ("counter", "dl4j_tpu_checkpoint_fallback_total"),
+    # SLO admission frontend (serving/frontend.py — docs/SERVING.md).
+    # admitted/shed/degraded/transitions grow labelled children
+    # ({class}, {class,reason}, {to}) next to these eagerly-registered
+    # bases; the state gauge carries the OVERLOAD_STATES index.
+    ("gauge", "dl4j_tpu_slo_state"),
+    ("gauge", "dl4j_tpu_slo_breaker_open"),
+    ("counter", "dl4j_tpu_slo_admitted_total"),
+    ("counter", "dl4j_tpu_slo_shed_total"),
+    ("counter", "dl4j_tpu_slo_degraded_total"),
+    ("counter", "dl4j_tpu_slo_transitions_total"),
 )
 
 

@@ -18,15 +18,33 @@ transformer (``models/gpt.py``) served through
   reason — exercised by ``make chaos-smoke`` over the
   ``deeplearning4j_tpu/faults/`` injection points.
 
+* :class:`SLOFrontend` (``serving/frontend.py``) — the SLO-driven
+  admission layer: priority classes over a priority-ordered pending
+  queue, token-bucket rate limits, predictive early shed against
+  per-request deadlines, an ``ok``/``degraded``/``shedding`` hysteresis
+  ladder, and a circuit breaker on the supervisor's restart rate —
+  overload becomes goodput management instead of a failure mode
+  (``serving/overload.py`` measures it; ``make slo-smoke`` gates it).
+
 Serve it directly or through the ``ParallelInference.generative`` facade
 (``parallel/mesh.py``). ``BENCH_MODEL=generate`` (bench.py) measures
-tokens/sec with p50/p99 TTFT and inter-token latency.
+tokens/sec with p50/p99 TTFT and inter-token latency;
+``BENCH_OVERLOAD=1`` switches it to the overload ramp reporting goodput
+(completed-within-deadline tokens/sec) with vs without the frontend.
 """
 
 from deeplearning4j_tpu.serving.cache import PagedKVCache
 from deeplearning4j_tpu.serving.engine import GenerativeEngine
+from deeplearning4j_tpu.serving.frontend import (
+    ClassPolicy,
+    LadderThresholds,
+    OVERLOAD_STATES,
+    SLOFrontend,
+    default_classes,
+)
 from deeplearning4j_tpu.serving.sampling import sample_tokens
 from deeplearning4j_tpu.serving.scheduler import (
+    FINISH_REASONS,
     GenerationRequest,
     GenerationResult,
     SlotScheduler,
@@ -35,4 +53,6 @@ from deeplearning4j_tpu.serving.scheduler import (
 __all__ = [
     "PagedKVCache", "GenerativeEngine", "sample_tokens",
     "GenerationRequest", "GenerationResult", "SlotScheduler",
+    "FINISH_REASONS", "SLOFrontend", "ClassPolicy", "LadderThresholds",
+    "OVERLOAD_STATES", "default_classes",
 ]

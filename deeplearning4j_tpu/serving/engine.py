@@ -57,7 +57,7 @@ from deeplearning4j_tpu.models.gpt import GptModel, gpt_decode_step, gpt_prefill
 from deeplearning4j_tpu.serving.cache import PagedKVCache
 from deeplearning4j_tpu.serving.sampling import sample_tokens
 from deeplearning4j_tpu.serving.scheduler import (
-    GenerationRequest, GenerationResult, SlotScheduler)
+    GenerationRequest, GenerationResult, SlotScheduler, count_terminal)
 
 logger = logging.getLogger(__name__)
 
@@ -211,28 +211,34 @@ class GenerativeEngine:
     def submit(self, prompt, *, max_new_tokens: int = 16,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                eos_token: Optional[int] = None,
-               deadline_s: Optional[float] = None, max_retries: int = 1
+               deadline_s: Optional[float] = None, max_retries: int = 1,
+               priority: int = 1, slo_class: str = "standard"
                ) -> "Future[GenerationResult]":
         """Queue one generation; returns a Future (thread-safe). A stopped
         engine rejects new work — build a fresh one.
 
         ``deadline_s`` bounds submit->terminal wall time (engine default
         when None); ``max_retries`` is this request's crash re-admission
-        budget (docs/ROBUSTNESS.md). When the pending queue is at
-        ``max_queue``, the request is SHED: the future completes
-        immediately with the terminal reason ``"shed"`` — callers always
-        get a terminal state, never a hang."""
-        if self._error is not None:
-            raise RuntimeError("engine loop died") from self._error
-        if self._stop_flag:
-            raise RuntimeError("engine stopped — submit rejected")
+        budget (docs/ROBUSTNESS.md). ``priority`` orders the pending queue
+        (lower admits first; ties FIFO) and ``slo_class`` labels the
+        request for the SLO frontend's metrics — plain callers can ignore
+        both. When the pending queue is at ``max_queue``, the request is
+        SHED: the future completes immediately with the terminal reason
+        ``"shed"`` — callers always get a terminal state, never a hang."""
         eos = self.cfg.eos_token if eos_token is None else eos_token
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         req = GenerationRequest(
             prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p, eos_token=eos,
-            deadline_s=deadline_s, max_retries=max_retries)
+            deadline_s=deadline_s, max_retries=max_retries,
+            priority=priority, slo_class=slo_class)
+        return self.submit_request(req)
+
+    def validate_request(self, req: GenerationRequest) -> None:
+        """Raise on a request this engine can never serve. Shared by
+        :meth:`submit_request` and the SLO frontend, which must validate
+        BEFORE displacing queued work to make room for an arrival."""
         if req.prompt.size > self.max_prompt:
             raise ValueError(
                 f"prompt length {req.prompt.size} exceeds the engine's "
@@ -244,6 +250,19 @@ class GenerativeEngine:
             raise ValueError(
                 f"prompt token ids must be in [0, {self.cfg.vocab_size}), "
                 f"got range [{lo}, {hi}]")
+
+    def submit_request(self, req: GenerationRequest
+                       ) -> "Future[GenerationResult]":
+        """Queue a pre-built :class:`GenerationRequest` (the SLO frontend's
+        entry point — it constructs requests carrying class/priority/
+        degradation state). Same contract as :meth:`submit`."""
+        if self._error is not None:
+            raise RuntimeError("engine loop died") from self._error
+        if self._stop_flag:
+            raise RuntimeError("engine stopped — submit rejected")
+        if req.deadline_s is None:
+            req.deadline_s = self.default_deadline_s
+        self.validate_request(req)
         if (self.max_queue is not None
                 and len(self.scheduler.pending) >= self.max_queue):
             # admission gate: shedding is a TERMINAL result, not an
@@ -327,7 +346,8 @@ class GenerativeEngine:
                 observe.log_event("engine_stop_hung", timeout_s=timeout)
                 self.scheduler.fail_pending(
                     RuntimeError("GenerativeEngine stop timed out with the "
-                                 "worker hung; queued request failed"))
+                                 "worker hung; queued request failed"),
+                    reason="stopped")
                 return
             with self._lifecycle:
                 if self._worker is w:
@@ -344,7 +364,7 @@ class GenerativeEngine:
             self._retire(slot, "stopped")
         self.scheduler.fail_all(
             RuntimeError("GenerativeEngine stopped before this request "
-                         "completed"))
+                         "completed"), reason="stopped")
 
     def _serve_loop(self) -> None:
         while not self._stop_flag:
@@ -382,10 +402,11 @@ class GenerativeEngine:
             fut.set_result(GenerationResult(
                 tokens=np.zeros((0,), np.int32), finish_reason=reason,
                 prompt_len=int(req.prompt.size), ttft_s=None,
-                intertoken_s=[]))
-        observe.metrics().counter(
-            "dl4j_tpu_serving_evicted_total", reason=reason).inc()
-        observe.log_event("serving_terminal", reason=reason)
+                intertoken_s=[], slo_class=req.slo_class,
+                degraded=req.degraded))
+        count_terminal(reason)
+        observe.log_event("serving_terminal", reason=reason,
+                          slo_class=req.slo_class)
 
     def _recover(self, exc: Exception) -> bool:
         """Crash recovery (docs/ROBUSTNESS.md state machine): free every
@@ -414,7 +435,8 @@ class GenerativeEngine:
                 # the crash) — generation restarts from the prompt
                 req.retries_used += 1
                 self._obs["retries"].inc()
-                sched.pending.appendleft((req, st.future, st.submit_t))
+                with sched._plock:
+                    sched.pending.appendleft((req, st.future, st.submit_t))
             else:
                 self._finish_unslotted(req, st.future, "error")
         # the crash may have killed a decode step AFTER the donation of
@@ -433,8 +455,7 @@ class GenerativeEngine:
     def _retire(self, slot: int, reason: str) -> None:
         self.scheduler.retire(slot, reason)
         self.cache.free_slot(slot)
-        observe.metrics().counter(
-            "dl4j_tpu_serving_evicted_total", reason=reason).inc()
+        count_terminal(reason)
 
     def step(self) -> int:
         """ONE scheduler iteration: capacity-evict, admit, retire finished,
@@ -459,12 +480,18 @@ class GenerativeEngine:
             dl = sched.slots[slot].request.deadline_s
             if dl is not None and now - sched.slots[slot].submit_t > dl:
                 self._retire(slot, "deadline")
-        for _ in range(len(sched.pending)):
-            req, fut, t_sub = sched.pending.popleft()
-            if req.deadline_s is not None and now - t_sub > req.deadline_s:
-                self._finish_unslotted(req, fut, "deadline")
-            else:
-                sched.pending.append((req, fut, t_sub))
+        expired = []
+        with sched._plock:
+            for _ in range(len(sched.pending)):
+                item = sched.pending.popleft()
+                if (item[0].deadline_s is not None
+                        and now - item[2] > item[0].deadline_s):
+                    expired.append(item)
+                else:
+                    sched.pending.append(item)
+        for req, fut, _t in expired:  # complete OUTSIDE the queue lock —
+            # future callbacks (frontend accounting) must not run under it
+            self._finish_unslotted(req, fut, "deadline")
 
         # 2. capacity: every surviving slot needs room for one more token
         for slot in sched.active_slots():
@@ -476,14 +503,21 @@ class GenerativeEngine:
             if status != "ok":
                 self._retire(slot, status)
 
-        # 3. admissions into free slots, in arrival order (submit() already
-        #    bounds prompts to the max_prompt bucket, which __init__ bounds
-        #    to the per-slot context — no per-request overflow check here)
-        while sched.pending:
+        # 3. admissions into free slots, highest-priority first (FIFO
+        #    within a priority — peek_best_pending orders by (priority,
+        #    submit time), so supervisor retries with their ORIGINAL
+        #    submit time re-admit ahead of younger same-class work and
+        #    recovery never inverts priority). submit() already bounds
+        #    prompts to the max_prompt bucket, which __init__ bounds to
+        #    the per-slot context — no per-request overflow check here.
+        while True:
             free = sched.free_slot_ids()
             if not free:
                 break
-            req, fut, t_sub = sched.pending[0]
+            item = sched.peek_best_pending()
+            if item is None:
+                break
+            req, fut, t_sub = item
             p_len = int(req.prompt.size)
             # p_len + 1 everywhere: the SAME iteration's decode writes the
             # first generated token's K/V at position p_len, so a page-
@@ -492,14 +526,16 @@ class GenerativeEngine:
             if cache.pages_for(p_len + 1) > cache.free_pages:
                 if not sched.slots:
                     # nothing active to ever free pages — config-impossible
-                    sched.pending.popleft()
-                    if not fut.done():
+                    if sched.remove_pending(item) and not fut.done():
                         fut.set_exception(RuntimeError(
                             f"prompt needs {cache.pages_for(p_len + 1)} "
                             f"pages but the pool only has "
                             f"{cache.num_pages}"))
+                        count_terminal("error")
                     continue
                 break  # pool pressure: wait for evictions to free pages
+            if not sched.remove_pending(item):
+                continue  # a frontend steal raced us — re-select
             slot = free[0]
             status = cache.ensure_capacity(slot, p_len + 1)
             if status != "ok":
@@ -507,14 +543,22 @@ class GenerativeEngine:
                 # pressure (faults.page_oom) or an allocator race: complete
                 # the request terminally instead of prefilling into a
                 # trash-page-only row (which would corrupt the invariants)
-                sched.pending.popleft()
                 self._finish_unslotted(req, fut, status)
                 continue
-            first_tok = self._prefill_into(slot, req)
+            try:
+                first_tok = self._prefill_into(slot, req)
+            except BaseException:
+                # the request sits in neither pending nor a slot right
+                # now — put it back at the queue FRONT (original submit
+                # time) and release the just-grown pages, so supervision
+                # retries it instead of stranding its future forever
+                cache.free_slot(slot)
+                with sched._plock:
+                    sched.pending.appendleft(item)
+                raise
             cache.seq_lens[slot] = p_len
             now = time.perf_counter()
             sched.admit(slot, req, fut, t_sub, first_tok, now)
-            sched.pending.popleft()
             self._obs["admitted"].inc()
             self._obs["generated"].inc()
             self._obs["ttft_h"].observe(now - t_sub)

@@ -30,6 +30,7 @@ completes over-capacity submissions immediately (docs/ROBUSTNESS.md).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
 from concurrent.futures import Future
@@ -37,13 +38,29 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import observe
+
 # Terminal states. The last three are the robustness tier's
-# (docs/ROBUSTNESS.md): "shed" = bounded-queue admission rejected the
-# request, "deadline" = its per-request deadline expired (queued or
-# mid-decode), "error" = a worker crash consumed its whole retry budget.
-# The SLO frontend (ROADMAP item 2d) consumes these as load signals.
+# (docs/ROBUSTNESS.md): "shed" = an admission gate (the engine's bounded
+# queue or the SLO frontend, serving/frontend.py) rejected the request,
+# "deadline" = its per-request deadline expired (queued or mid-decode),
+# "error" = a worker crash consumed its whole retry budget OR the
+# frontend's circuit breaker fast-failed it. The SLO frontend consumes
+# these as load signals AND produces them — one shared taxonomy, so
+# ``dl4j_tpu_serving_evicted_total{reason}`` is the single place every
+# terminal outcome is counted (asserted in tests/test_frontend.py).
 FINISH_REASONS = ("eos", "length", "overflow", "oom", "stopped",
                   "shed", "deadline", "error")
+
+
+def count_terminal(reason: str) -> None:
+    """Increment the ONE terminal-outcome counter family. Every path that
+    completes a request — retire, unslotted finish, fail_all/fail_pending,
+    frontend sheds — funnels through here so the taxonomy cannot drift."""
+    if reason not in FINISH_REASONS:
+        raise ValueError(f"unknown finish reason {reason!r}")
+    observe.metrics().counter(
+        "dl4j_tpu_serving_evicted_total", reason=reason).inc()
 
 
 @dataclasses.dataclass
@@ -60,6 +77,16 @@ class GenerationRequest:
     deadline_s: Optional[float] = None  # submit -> terminal budget (wall)
     max_retries: int = 1             # crash re-admissions before "error"
     retries_used: int = 0            # supervisor bookkeeping, not user-set
+    # SLO-frontend fields (serving/frontend.py). ``priority`` orders the
+    # pending queue (lower admits first); supervisor retries re-queue the
+    # SAME request object, so class/priority/submit-time survive a crash
+    # and recovery can never invert priority. ``degraded`` records that
+    # the degradation ladder trimmed this request's parameters — it rides
+    # into the GenerationResult so callers can see they got a degraded
+    # answer.
+    priority: int = 1                # 0 = most important
+    slo_class: str = "standard"      # frontend class name (label value)
+    degraded: bool = False           # ladder trimmed max_new_tokens/extras
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -81,6 +108,8 @@ class GenerationRequest:
         if self.max_retries < 0:
             raise ValueError(f"max_retries must be >= 0, "
                              f"got {self.max_retries}")
+        if self.priority < 0:
+            raise ValueError(f"priority must be >= 0, got {self.priority}")
 
 
 @dataclasses.dataclass
@@ -92,6 +121,8 @@ class GenerationResult:
     prompt_len: int
     ttft_s: Optional[float]          # submit -> first token (perf_counter)
     intertoken_s: List[float]        # successive decode-token gaps
+    slo_class: str = "standard"      # the request's admission class
+    degraded: bool = False           # True: the ladder trimmed this answer
 
 
 @dataclasses.dataclass
@@ -107,19 +138,24 @@ class _Slot:
 
 
 class SlotScheduler:
-    """Pending queue + slot bank. Thread-safe enough for one engine loop
-    plus submitting client threads (the deque is the only shared mutable;
-    appends/popleft are atomic)."""
+    """Pending queue + slot bank. Thread-safe for one engine loop plus
+    submitting client threads AND the SLO frontend: every structural
+    mutation of ``pending`` (append, best-pending removal, victim steal,
+    drain) holds ``_plock``, because the frontend's shed-lowest-first
+    steal removes items from the middle of the deque while the worker is
+    index-scanning it — atomic deque ops alone no longer suffice."""
 
     def __init__(self, max_slots: int):
         self.max_slots = int(max_slots)
         self.pending: Deque[tuple] = deque()
         self.slots: Dict[int, _Slot] = {}
+        self._plock = threading.Lock()
 
     # ------------------------------------------------------------ submission
     def submit(self, request: GenerationRequest) -> "Future[GenerationResult]":
         fut: "Future[GenerationResult]" = Future()
-        self.pending.append((request, fut, time.perf_counter()))
+        with self._plock:
+            self.pending.append((request, fut, time.perf_counter()))
         return fut
 
     # --------------------------------------------------------------- queries
@@ -134,6 +170,54 @@ class SlotScheduler:
 
     def occupancy(self) -> float:
         return len(self.slots) / self.max_slots if self.max_slots else 0.0
+
+    def pending_snapshot(self) -> List[tuple]:
+        """A consistent copy of the pending queue (frontend accounting)."""
+        with self._plock:
+            return list(self.pending)
+
+    # --------------------------------------------------- priority admission
+    def peek_best_pending(self) -> Optional[tuple]:
+        """The pending item that should admit NEXT: lowest
+        ``request.priority`` first, then earliest submit time (FIFO within
+        a class). Returns the item without removing it — the engine
+        inspects page-pool feasibility before committing."""
+        with self._plock:
+            best, best_key = None, None
+            for i, item in enumerate(self.pending):
+                key = (item[0].priority, item[2], i)
+                if best_key is None or key < best_key:
+                    best_key, best = key, item
+            return best
+
+    def remove_pending(self, item: tuple) -> bool:
+        """Remove ``item`` (by identity) from the pending queue. Returns
+        False when a concurrent actor (a frontend victim steal, a deadline
+        sweep) already took it — the caller must then re-select."""
+        with self._plock:
+            for i, it in enumerate(self.pending):
+                if it is item:
+                    del self.pending[i]
+                    return True
+        return False
+
+    def steal_lowest_pending(self, than_priority: int) -> Optional[tuple]:
+        """Remove and return the WORST queued item strictly lower-priority
+        than ``than_priority`` (highest priority number; latest submit
+        breaks ties — the newest of the worst class is shed, the oldest is
+        closest to service). None when nothing lower-priority is queued.
+        The shed-lowest-first arm of the SLO frontend's queue bound."""
+        with self._plock:
+            worst, worst_key, worst_i = None, None, -1
+            for i, item in enumerate(self.pending):
+                if item[0].priority <= than_priority:
+                    continue
+                key = (item[0].priority, item[2], i)
+                if worst_key is None or key > worst_key:
+                    worst_key, worst, worst_i = key, item, i
+            if worst is not None:
+                del self.pending[worst_i]
+            return worst
 
     # ------------------------------------------------------------- lifecycle
     def admit(self, slot: int, request: GenerationRequest,
@@ -176,29 +260,37 @@ class SlotScheduler:
         result = GenerationResult(
             tokens=np.asarray(toks, np.int32), finish_reason=reason,
             prompt_len=st.prompt_len, ttft_s=st.ttft_s,
-            intertoken_s=list(st.intertoken_s))
+            intertoken_s=list(st.intertoken_s),
+            slo_class=st.request.slo_class, degraded=st.request.degraded)
         if not st.future.done():
             st.future.set_result(result)
         return result
 
-    def fail_all(self, exc: Exception) -> None:
+    def fail_all(self, exc: Exception, reason: str = "error") -> None:
         """Engine shutdown/crash: fail every in-flight and queued future so
         blocked callers wake instead of hanging (the ParallelInference.stop
-        contract)."""
+        contract). Each future actually failed here counts ONCE under
+        ``dl4j_tpu_serving_evicted_total{reason}`` — exception exits share
+        the terminal-reason taxonomy with result exits."""
         for slot in list(self.slots):
             st = self.slots.pop(slot, None)  # tolerate a concurrent caller
             if st is not None and not st.future.done():
                 st.future.set_exception(exc)
-        self.fail_pending(exc)
+                count_terminal(reason)
+        self.fail_pending(exc, reason=reason)
 
-    def fail_pending(self, exc: Exception) -> None:
+    def fail_pending(self, exc: Exception, reason: str = "error") -> None:
         """Fail ONLY the queued-but-never-admitted futures. Used alone when
         a hung worker may still own the active slots (stop() timeout):
         completing those futures here would race the stuck thread."""
+        drained: List[tuple] = []
         while True:
-            try:
-                _req, fut, _t = self.pending.popleft()
-            except IndexError:  # drained (possibly by a concurrent caller)
-                break
+            with self._plock:
+                try:
+                    drained.append(self.pending.popleft())
+                except IndexError:  # drained (possibly by a concurrent one)
+                    break
+        for _req, fut, _t in drained:
             if not fut.done():
                 fut.set_exception(exc)
+                count_terminal(reason)

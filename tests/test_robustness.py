@@ -236,6 +236,44 @@ class TestDeadlinesAndShedding:
         with pytest.raises(ValueError, match="max_retries"):
             GenerationRequest(prompt=PROMPTS[0], max_retries=-1)
 
+    def test_prefill_crash_does_not_strand_request(self, monkeypatch):
+        """A crash inside prefill hits AFTER the request left the pending
+        queue but BEFORE it owns a slot — recovery must re-queue it (front,
+        original submit time) instead of stranding its future forever."""
+        eng = make_engine(max_slots=1)
+        real = eng._prefill_into
+        calls = {"n": 0}
+
+        def flaky(slot, req):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected prefill crash")
+            return real(slot, req)
+
+        monkeypatch.setattr(eng, "_prefill_into", flaky)
+        res = eng.generate([PROMPTS[0]], max_new_tokens=3)[0]
+        assert res.finish_reason == "length"
+        np.testing.assert_array_equal(
+            res.tokens, reference_generate(MODEL.params, CFG, PROMPTS[0], 3))
+        assert eng.restarts == 1
+        eng.cache.check_invariants()
+        assert eng.cache.free_pages == eng.cache.num_pages
+
+    def test_wall_clock_jump_cannot_expire_deadlines(self, monkeypatch):
+        """GL010 satellite: deadline bookkeeping runs on perf_counter.
+        A wall-clock jump (NTP step, manual reset) mid-generation must
+        NOT spuriously expire a request whose monotonic budget is fine —
+        here the wall clock leaps a full year and everything still
+        finishes as 'length'."""
+        real_time = time.time
+        monkeypatch.setattr(time, "time",
+                            lambda: real_time() + 365 * 24 * 3600.0)
+        eng = make_engine(max_slots=1)
+        res = eng.generate([PROMPTS[0]], max_new_tokens=4,
+                           deadline_s=120.0)[0]
+        assert res.finish_reason == "length"
+        assert res.tokens.size == 4
+
 
 # ---------------------------------------------------------------------------
 # death paths of the existing stack (satellite)

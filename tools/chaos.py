@@ -13,7 +13,11 @@ supervised-degradation contract instead of trusting it:
     zero ``new_shape`` RecompileLedger events across all restarts;
   * the paged KV cache invariants hold after the dust settles;
   * a torn checkpoint write is detected by ``restore()``, which falls back
-    to the newest intact checkpoint.
+    to the newest intact checkpoint;
+  * the SLO frontend's ladder survives chaos end-to-end: under injected
+    ``slow_decode`` plus ``burst_arrival`` floods, goodput with the
+    frontend must not lose to the frontend-off baseline, burst-injected
+    requests included in the every-request-terminal invariant.
 
 Contract (same as lint/check/obs/tune): ONE JSON summary line on stdout
 with ``"tool": "chaos"``; exit 0 iff ``ok``. ``make chaos-smoke`` pins
@@ -122,6 +126,50 @@ def run_serving_chaos(n_requests: int, gen_tokens: int):
     }
 
 
+def run_frontend_chaos():
+    """The SLO-frontend leg (docs/SERVING.md § SLO admission frontend):
+    the shared overload ramp under probabilistic ``slow_decode`` plus the
+    ``burst_arrival`` injection point (which floods the frontend with
+    synthetic lowest-class arrivals), frontend on vs off with an
+    identical offered schedule. Proves the ladder end-to-end under
+    chaos: goodput with the frontend must not lose to the baseline,
+    every request (bursts included) must reach a terminal state, and no
+    degradation transition may recompile."""
+    from deeplearning4j_tpu import faults
+    from deeplearning4j_tpu.serving.overload import run_overload_ramp
+
+    # slow_decode at prob 1.0: a DETERMINISTIC 50ms service floor on both
+    # legs (probabilistic injection gave each leg a different slow-step
+    # pattern and made the single-trial goodput comparison flaky).
+    # burst_arrival only has a call site in the frontend, so it fires on
+    # the ON leg — which is the point: extra injected load on top, and
+    # the ON leg must still not lose
+    faults.arm("slow_decode", prob=1.0, seed=2)
+    faults.arm("burst_arrival", prob=1.0, after_n=3, max_fires=2)
+    try:
+        on = run_overload_ramp(frontend_on=True, n_requests=12,
+                               gen_tokens=8, max_slots=2,
+                               overload_factor=2.5)
+        off = run_overload_ramp(
+            frontend_on=False, n_requests=12, gen_tokens=8, max_slots=2,
+            overload_factor=2.5,
+            capacity_tokens_per_sec=on["capacity_tokens_per_sec"])
+    finally:
+        faults.reset()
+    g_on = on["goodput_tokens_per_sec"]
+    g_off = off["goodput_tokens_per_sec"]
+    return {
+        "goodput_on": g_on,
+        "goodput_off": g_off,
+        "beats_baseline": g_on >= g_off,
+        "burst_requests": on["burst_requests"],
+        "states_visited": on.get("states_visited"),
+        "all_terminal": bool(on["all_terminal"] and off["all_terminal"]),
+        "new_shape_events": on["new_shape_events"] + off["new_shape_events"],
+        "reasons_on": on["reasons"], "reasons_off": off["reasons"],
+    }
+
+
 def run_checkpoint_chaos():
     """The durability leg: three saves, the newest torn; restore must fall
     back to the last intact checkpoint with the right parameters."""
@@ -161,16 +209,18 @@ def main() -> int:
     t0 = time.perf_counter()
     serving = run_serving_chaos(args.requests, args.tokens)
     ckpt = run_checkpoint_chaos()
+    frontend = run_frontend_chaos()
     m = observe.metrics()
     faults_total = int(m.family_total("dl4j_tpu_faults_injected_total"))
     by_point = {}
     for inst in m.instruments():
         if inst.name == "dl4j_tpu_faults_injected_total" and inst.labels:
             by_point[dict(inst.labels).get("point")] = int(inst.value)
-    # the acceptance-criterion triple must all have actually fired — a
-    # chaos run that never hit the pool, the decode step AND the
-    # checkpoint proved nothing
-    required = ("page_oom", "decode_step_error", "checkpoint_torn_write")
+    # the acceptance-criterion points must all have actually fired — a
+    # chaos run that never hit the pool, the decode step, the checkpoint
+    # AND the frontend's burst hook proved nothing
+    required = ("page_oom", "decode_step_error", "checkpoint_torn_write",
+                "burst_arrival")
     missing = [p for p in required if not by_point.get(p)]
 
     ok = (serving["unresolved"] == 0
@@ -180,6 +230,9 @@ def main() -> int:
           and serving["new_shape_events"] == 0
           and serving["stopped_cleanly"]
           and ckpt["fallback_ok"]
+          and frontend["beats_baseline"]
+          and frontend["all_terminal"]
+          and frontend["new_shape_events"] == 0
           and faults_total > 0
           and not missing)
 
@@ -190,6 +243,7 @@ def main() -> int:
         "required_points_missing": missing,
         "serving": serving,
         "checkpoint": ckpt,
+        "frontend": frontend,
         "elapsed_s": round(time.perf_counter() - t0, 2),
     }
     print(json.dumps(rec), flush=True)
@@ -198,7 +252,9 @@ def main() -> int:
               f"{serving['submitted']} submitted, reasons "
               f"{serving['reasons']}, {serving['restarts']} restarts, "
               f"{faults_total} faults injected, checkpoint fallback "
-              f"{'ok' if ckpt['fallback_ok'] else 'FAILED'}",
+              f"{'ok' if ckpt['fallback_ok'] else 'FAILED'}, frontend "
+              f"goodput {frontend['goodput_on']}/{frontend['goodput_off']} "
+              f"(burst {frontend['burst_requests']})",
               file=sys.stderr)
     return 0 if ok else 1
 

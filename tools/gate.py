@@ -30,6 +30,10 @@ Stages:
      request must reach a terminal finish reason, the supervisor must
      restart within its cap with zero new_shape ledger events, and
      restore() must fall back past a torn checkpoint (docs/ROBUSTNESS.md)
+ 10. slo smoke: tools/slo.py goodput-under-overload ramp — frontend-on
+     goodput must be >= frontend-off under an identical past-capacity
+     schedule, with every request terminal and zero new_shape events
+     (docs/SERVING.md § SLO admission frontend)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -306,6 +310,44 @@ def chaos_stage() -> bool:
     return bool(ok)
 
 
+def slo_stage() -> bool:
+    """Goodput smoke (docs/SERVING.md § SLO admission frontend): the
+    overload ramp must report frontend-on goodput >= frontend-off with
+    every request terminal on both legs, the ladder engaged, and zero
+    new_shape events. One JSON line, like lint/check/obs/chaos."""
+    print("== gate: slo-smoke (goodput under overload, frontend on/off) ==",
+          flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_FAULTS", None)  # an ambient schedule would distort
+    try:                              # the measured legs
+        proc = subprocess.run(
+            [sys.executable, "tools/slo.py", "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (slo-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (slo-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    on = rec.get("frontend_on") or {}
+    off = rec.get("frontend_off") or {}
+    ok = (bool(rec.get("ok"))
+          and (rec.get("goodput_on") or 0) >= (rec.get("goodput_off") or 0)
+          and on.get("all_terminal") and off.get("all_terminal"))
+    print(f"   {'ok' if ok else 'FAIL'} (slo-smoke: goodput on/off "
+          f"{rec.get('goodput_on')}/{rec.get('goodput_off')} tok/s "
+          f"(x{rec.get('goodput_ratio')}), states "
+          f"{on.get('states_visited')}, reasons on={on.get('reasons')} "
+          f"off={off.get('reasons')})")
+    return bool(ok)
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -377,6 +419,7 @@ def main() -> int:
         results["serve"] = serve_stage()
         results["tune"] = tune_stage()
         results["chaos"] = chaos_stage()
+        results["slo"] = slo_stage()
         results["multichip"] = multichip_stage()
 
     failed = [k for k, v in results.items() if not v]
