@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke slo-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke slo-smoke prefix-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -63,6 +63,15 @@ chaos-smoke:
 # ONE JSON line like lint/check/obs/chaos.
 slo-smoke:
 	JAX_PLATFORMS=cpu python tools/slo.py --json
+
+# prefix-cache smoke (docs/SERVING.md § Radix prefix cache): the shared-
+# prompt replay, cache on vs off with an identical request plan — fails
+# unless prefix hit tokens > 0, TTFT p50 is >= 30% better than cache-off
+# (median of paired trials), greedy outputs are bit-identical on both
+# legs, and zero new_shape ledger events were paid for it.
+# ONE JSON line like lint/check/obs/chaos/slo.
+prefix-smoke:
+	JAX_PLATFORMS=cpu python tools/prefix.py --json
 
 # generative-serving smoke (docs/SERVING.md): continuous-batching
 # generation, smoke-sized, CPU-pinned — ONE JSON line with tokens/sec,

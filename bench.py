@@ -370,6 +370,50 @@ def _bench_generate_overload(n_requests: int, gen_tokens: int,
     return g_on, "generate_overload_goodput_tokens_per_sec", extra
 
 
+def _bench_generate_prefix(n_requests: int, n_prefixes: int, sys_len: int,
+                           gen_tokens: int):
+    """Shared-prompt replay benchmark (BENCH_MODEL=generate +
+    BENCH_PREFIX=1): the radix-prefix-cache acceptance harness
+    (serving/replay.py, docs/SERVING.md § Radix prefix cache) run twice —
+    cache on, then cache off with the IDENTICAL request plan. Value = the
+    TTFT p50 speedup the cache buys (off/on); the JSON line carries both
+    legs' TTFT percentiles, the hit accounting, and the bit-identical
+    check, so "shared prompts admit in O(suffix)" is a recorded number.
+    Both legs greedy: outputs MUST match token-for-token — a numerics
+    regression in the suffix-prefill path fails the bench, not just a
+    test."""
+    from deeplearning4j_tpu.serving.replay import run_prefix_replay
+
+    on = run_prefix_replay(prefix_on=True, n_requests=n_requests,
+                           n_prefixes=n_prefixes, sys_len=sys_len,
+                           gen_tokens=gen_tokens)
+    off = run_prefix_replay(prefix_on=False, n_requests=n_requests,
+                            n_prefixes=n_prefixes, sys_len=sys_len,
+                            gen_tokens=gen_tokens)
+    identical = on["outputs"] == off["outputs"]
+    assert identical, (
+        "prefix-cache outputs diverged from the cache-off oracle — the "
+        "suffix-prefill path is numerically wrong")
+    assert on["prefix_hit_tokens"] > 0, "replay produced zero prefix hits"
+    speedup = (off["ttft_p50_ms"] / on["ttft_p50_ms"]
+               if on["ttft_p50_ms"] else 0.0)
+    extra = {
+        "ttft_p50_ms_on": on["ttft_p50_ms"],
+        "ttft_p50_ms_off": off["ttft_p50_ms"],
+        "ttft_p99_ms_on": on["ttft_p99_ms"],
+        "ttft_p99_ms_off": off["ttft_p99_ms"],
+        "ttft_improvement_pct": round(100.0 * (1.0 - 1.0 / speedup), 1)
+        if speedup else None,
+        "prefix_hit_tokens": on["prefix_hit_tokens"],
+        "hit_requests": on["hit_requests"],
+        "requests": on["requests"],
+        "outputs_identical": identical,
+        "tree_pages": on.get("tree_pages"),
+        "new_shape_events": on["new_shape_events"] + off["new_shape_events"],
+    }
+    return speedup, "generate_prefix_ttft_p50_speedup", extra
+
+
 def _bench_bert_import(layers: int, seq: int, d: int, heads: int, ff: int,
                        iters: int):
     """Imported-BERT forward throughput (BENCH_MODEL=bert_import): the
@@ -556,7 +600,8 @@ _UNITS = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
           "serving_fixed_qps_req_per_sec": "req/sec",
           "generate_open_loop_tokens_per_sec": "tokens/sec",
           "generate_overload_goodput_tokens_per_sec":
-              "deadline-met tokens/sec"}
+              "deadline-met tokens/sec",
+          "generate_prefix_ttft_p50_speedup": "x TTFT p50 vs cache-off"}
 
 _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "lenet": "lenet5_mnist_train_images_per_sec",
@@ -567,16 +612,20 @@ _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "serving": "serving_fixed_qps_req_per_sec",
                  "generate": "generate_open_loop_tokens_per_sec",
                  "generate_overload":
-                     "generate_overload_goodput_tokens_per_sec"}
+                     "generate_overload_goodput_tokens_per_sec",
+                 "generate_prefix": "generate_prefix_ttft_p50_speedup"}
 
 
 def main() -> None:
     backend = _ensure_backend()
     model = os.environ.get("BENCH_MODEL", "resnet50")
-    # the documented spelling is BENCH_MODEL=generate BENCH_OVERLOAD=1;
-    # generate_overload is the canonical metric key either way
+    # the documented spellings are BENCH_MODEL=generate BENCH_OVERLOAD=1
+    # (goodput ramp) and BENCH_MODEL=generate BENCH_PREFIX=1 (shared-
+    # prompt replay); the canonical metric keys apply either way
     if model == "generate" and os.environ.get("BENCH_OVERLOAD") == "1":
         model = "generate_overload"
+    elif model == "generate" and os.environ.get("BENCH_PREFIX") == "1":
+        model = "generate_prefix"
     dtype = os.environ.get("BENCH_DTYPE", "mixed")
     smoke = backend == "cpu-fallback"
     # On cpu-fallback, headline workloads at device sizes would run for
@@ -645,6 +694,15 @@ def main() -> None:
             value, metric, extra = _bench_generate(qps, nreq, gen, slots,
                                                    preset)
             method = f"q{qps:g}n{nreq}g{gen}s{slots}{preset}"
+        elif model == "generate_prefix":
+            nreq = int(os.environ.get("BENCH_REQUESTS",
+                                      "12" if smoke else "32"))
+            npfx = int(os.environ.get("BENCH_PREFIX_COUNT", "3"))
+            slen = int(os.environ.get("BENCH_PREFIX_SYS", "88"))
+            gen = int(os.environ.get("BENCH_GEN_TOKENS", "4"))
+            value, metric, extra = _bench_generate_prefix(nreq, npfx, slen,
+                                                          gen)
+            method = f"n{nreq}p{npfx}s{slen}g{gen}"
         elif model == "generate_overload":
             nreq = int(os.environ.get("BENCH_REQUESTS",
                                       "24" if smoke else "64"))

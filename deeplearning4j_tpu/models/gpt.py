@@ -165,6 +165,64 @@ def gpt_prefill(params, ids, cfg: GptConfig, *, mask=None
     return logits, jnp.stack(kvs)
 
 
+def gpt_prefill_suffix(params, ids, prefix_kv, prefix_len, suffix_len,
+                       cfg: GptConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Suffix-only prefill against a cached prefix (the radix prefix
+    cache's fast path, docs/SERVING.md § Radix prefix cache).
+
+    ids: (1, B) int32 — the prompt's UNCACHED tail, zero-padded to the
+    engine's suffix bucket; prefix_kv: (L, 2, Tpre, H, Dh) — the cached
+    prefix K/V gathered from the paged cache (positions >= ``prefix_len``
+    are garbage and masked); prefix_len/suffix_len: scalars. Suffix token
+    i sits at absolute position ``prefix_len + i`` and attends to every
+    valid prefix position plus suffix positions <= i — the same causal
+    math as :func:`gpt_prefill`, computed for B tokens instead of the
+    whole prompt. Returns ``(logits (1, B, V), kv (L, 2, B, H, Dh))`` —
+    the suffix K/V for the cache scatter (token-major, like the prefill
+    layout the engine already writes).
+    """
+    from deeplearning4j_tpu.ops import exec_op
+
+    emb = params["embeddings"]
+    n, b = ids.shape
+    t_pre = prefix_kv.shape[2]
+    h, dh = cfg.heads, cfg.hidden // cfg.heads
+    pos = jnp.clip(prefix_len + jnp.arange(b), 0, cfg.max_position - 1)
+    x = emb["word"][ids] + emb["position"][pos][None]
+    x = _layer_norm(x, emb["ln_gamma"], emb["ln_beta"], cfg.layer_norm_eps)
+
+    def split(a):  # (1, B, E) -> (1, H, B, Dh)
+        return a.reshape(n, b, h, dh).transpose(0, 2, 1, 3)
+
+    # (1, 1, B, Tpre + B) bool: query i -> prefix j < prefix_len, then
+    # suffix j' <= i (causal) and j' < suffix_len (padding)
+    qi = jnp.arange(b)[:, None]
+    m_pre = jnp.broadcast_to(jnp.arange(t_pre)[None, :] < prefix_len,
+                             (b, t_pre))
+    js = jnp.arange(b)[None, :]
+    m_suf = (js <= qi) & (js < suffix_len)
+    m4 = jnp.concatenate([m_pre, m_suf], axis=1)[None, None]
+    kvs = []
+    for li, blk in enumerate(params["blocks"]):
+        a = blk["attn"]
+        q = split(x @ a["Wq"] + a["bq"])
+        k = split(x @ a["Wk"] + a["bk"])
+        v = split(x @ a["Wv"] + a["bv"])
+        kvs.append(jnp.stack([k.transpose(0, 2, 1, 3)[0],
+                              v.transpose(0, 2, 1, 3)[0]]))  # (2, B, H, Dh)
+        kp = prefix_kv[li, 0].transpose(1, 0, 2)[None]  # (1, H, Tpre, Dh)
+        vp = prefix_kv[li, 1].transpose(1, 0, 2)[None]
+        out = exec_op("dot_product_attention", q,
+                      jnp.concatenate([kp, k], axis=2),
+                      jnp.concatenate([vp, v], axis=2), m4, scaled=True)
+        out = out.transpose(0, 2, 1, 3).reshape(n, b, cfg.hidden)
+        x = _layer_norm(x + out @ a["Wo"] + a["bo"],
+                        a["ln_gamma"], a["ln_beta"], cfg.layer_norm_eps)
+        x = _ffn(blk, x, cfg.layer_norm_eps)
+    logits = x @ emb["word"].T
+    return logits, jnp.stack(kvs)
+
+
 def gpt_decode_step(params, kv_pages, tokens, positions, page_table,
                     seq_lens_incl, write_page, write_offset, cfg: GptConfig
                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -130,6 +130,26 @@ def summary() -> Dict[str, Any]:
             "intertoken_p99_ms": _ms(itl["p99"]),
         }
 
+    lookups = m.counter("dl4j_tpu_prefix_lookups_total").value
+    if lookups:
+        hits = m.counter("dl4j_tpu_prefix_hits_total").value
+        out["prefix"] = {
+            "lookups": int(lookups),
+            "hits": int(hits),
+            "hit_rate": round(hits / lookups, 4),
+            "hit_tokens": int(
+                m.counter("dl4j_tpu_prefix_hit_tokens_total").value),
+            "cow_copies": int(
+                m.counter("dl4j_tpu_prefix_cow_copies_total").value),
+            "inserted_pages": int(
+                m.counter("dl4j_tpu_prefix_inserted_pages_total").value),
+            "evicted_pages": int(
+                m.counter("dl4j_tpu_prefix_evicted_pages_total").value),
+            "tree_pages": int(m.gauge("dl4j_tpu_prefix_tree_pages").value),
+            "pinned_pages": int(
+                m.gauge("dl4j_tpu_prefix_pinned_pages").value),
+        }
+
     slo_admitted = m.family_total("dl4j_tpu_slo_admitted_total")
     slo_shed = m.family_total("dl4j_tpu_slo_shed_total")
     if slo_admitted or slo_shed:

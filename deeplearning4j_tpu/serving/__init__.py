@@ -18,6 +18,15 @@ transformer (``models/gpt.py``) served through
   reason — exercised by ``make chaos-smoke`` over the
   ``deeplearning4j_tpu/faults/`` injection points.
 
+* :class:`RadixPrefixCache` (``serving/prefix.py``) — shared-prompt KV
+  reuse: a radix tree over token sequences whose nodes hold refcounted
+  cache pages, so repeated system prompts/few-shot prefixes map by
+  reference and only the uncached suffix prefills
+  (copy-on-write for mid-page divergence, LRU leaf eviction under a page
+  budget, per-class pre-warm + pinning via the frontend's
+  ``ClassPolicy.shared_prefix``; ``BENCH_PREFIX=1`` / ``make
+  prefix-smoke`` measure the TTFT win);
+
 * :class:`SLOFrontend` (``serving/frontend.py``) — the SLO-driven
   admission layer: priority classes over a priority-ordered pending
   queue, token-bucket rate limits, predictive early shed against
@@ -35,6 +44,7 @@ tokens/sec with p50/p99 TTFT and inter-token latency;
 
 from deeplearning4j_tpu.serving.cache import PagedKVCache
 from deeplearning4j_tpu.serving.engine import GenerativeEngine
+from deeplearning4j_tpu.serving.prefix import PrefixMatch, RadixPrefixCache
 from deeplearning4j_tpu.serving.frontend import (
     ClassPolicy,
     LadderThresholds,
@@ -54,5 +64,6 @@ __all__ = [
     "PagedKVCache", "GenerativeEngine", "sample_tokens",
     "GenerationRequest", "GenerationResult", "SlotScheduler",
     "FINISH_REASONS", "SLOFrontend", "ClassPolicy", "LadderThresholds",
-    "OVERLOAD_STATES", "default_classes",
+    "OVERLOAD_STATES", "default_classes", "RadixPrefixCache",
+    "PrefixMatch",
 ]

@@ -17,31 +17,43 @@ concurrent sequences. The vLLM answer, reproduced here:
   back at evict — allocation is O(1) list ops between decode iterations,
   never device work.
 
+Since the radix prefix cache (``serving/prefix.py``, docs/SERVING.md
+§ Radix prefix cache) pages are **refcounted**: a page may be mapped into
+several slots' page-table rows at once (shared system-prompt KV) and/or
+pinned by the prefix tree, so "owned by exactly one slot" became "held by
+``refcount`` holders"; a page returns to the free list only when the last
+holder releases it. Writes into shared pages are forbidden by construction
+— the engine's admission path **copies** a partially-filled tail page
+before a slot may write into it (:meth:`cow_page`, the copy-on-write rule).
+
 The LAST page (index ``num_pages``) is the **trash page**: inactive slots'
 decode writes and unallocated page-table entries point at it, so the fully
 vectorized decode step needs no scatter masking — garbage lands where
 nothing ever reads it (attention masks positions ``>= seq_len``).
 
-Invariants (exercised by tests/test_serving.py):
-  * every page is either in the free list or owned by exactly one slot;
-  * ``len(free) + sum(owned) == num_pages`` at all times;
+Invariants (exercised by tests/test_serving.py + tests/test_prefix.py):
+  * every page is either in the free list XOR has ``refcount >= 1``;
+  * ``len(free) + |{p : refcount(p) > 0}| == num_pages`` at all times;
+  * ``refcount(p) == (#slot rows mapping p) + (#prefix-tree refs on p)``;
   * a freed slot's page-table row points wholly at the trash page.
 """
 
 from __future__ import annotations
 
-from typing import List
+import functools
+from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu import faults
+from deeplearning4j_tpu import faults, observe
 
 
 class PagedKVCache:
-    """Fixed-pool paged KV storage + free-list allocator (host-side
-    bookkeeping, device-side ``kv`` array threaded through the jitted
-    decode step functionally)."""
+    """Fixed-pool paged KV storage + refcounted free-list allocator
+    (host-side bookkeeping, device-side ``kv`` array threaded through the
+    jitted decode step functionally)."""
 
     def __init__(self, *, layers: int, heads: int, head_dim: int,
                  page_size: int = 16, num_pages: int = 64,
@@ -63,10 +75,12 @@ class PagedKVCache:
         self._kv_dtype = dtype
         self.kv = jnp.zeros(self._kv_shape, self._kv_dtype)
         self.free: List[int] = list(range(self.num_pages))
+        self.refcount: List[int] = [0] * self.num_pages
         self.page_table = np.full((self.max_slots, self.max_pages_per_seq),
                                   self.trash_page, np.int32)
         self.seq_lens = np.zeros((self.max_slots,), np.int32)
         self.owned: List[List[int]] = [[] for _ in range(self.max_slots)]
+        self._copy_fn = None
 
     # ----------------------------------------------------------- accounting
     def pages_for(self, n_tokens: int) -> int:
@@ -83,6 +97,74 @@ class PagedKVCache:
     def max_context(self) -> int:
         """Longest sequence one slot can hold."""
         return self.max_pages_per_seq * self.page_size
+
+    # ------------------------------------------------------------- refcounts
+    def alloc_page(self) -> Optional[int]:
+        """Pop a page off the free list with ``refcount == 1``. None when
+        the pool is exhausted (callers translate to their oom arm)."""
+        if not self.free:
+            return None
+        page = self.free.pop()
+        self.refcount[page] = 1
+        return page
+
+    def retain(self, page: int) -> None:
+        """Add one reference to a LIVE page (a prefix-tree insert, or a
+        slot mapping a shared page). Retaining a free page is a bug — it
+        would hand the same page to two unrelated holders."""
+        if self.refcount[page] <= 0:
+            raise AssertionError(
+                f"retain of page {page} with refcount "
+                f"{self.refcount[page]} (page is on the free list)")
+        self.refcount[page] += 1
+
+    def release(self, page: int) -> None:
+        """Drop one reference; the page returns to the free list only at
+        refcount zero — the exactly-once property under sharing."""
+        if self.refcount[page] <= 0:
+            raise AssertionError(
+                f"release of page {page} with refcount "
+                f"{self.refcount[page]} (double free)")
+        self.refcount[page] -= 1
+        if self.refcount[page] == 0:
+            self.free.append(page)
+
+    def map_shared(self, slot: int, page: int) -> None:
+        """Map an already-live page (a prefix-cache hit) into ``slot``'s
+        next page-table position, taking a reference. The slot must never
+        WRITE into a shared page — the engine CoWs the partial tail first."""
+        self.retain(page)
+        idx = len(self.owned[slot])
+        self.owned[slot].append(page)
+        self.page_table[slot, idx] = page
+
+    def cow_page(self, slot: int, src: int) -> Optional[int]:
+        """Copy-on-write: allocate a fresh page, device-copy ``src`` into
+        it, and map it into ``slot``'s next page-table position. Returns
+        the new page id, or None when the pool is exhausted (the caller
+        unwinds the admission). The copy is ONE jitted device op whose
+        signature depends only on the kv geometry — compile once."""
+        dst = self.alloc_page()
+        if dst is None:
+            return None
+        idx = len(self.owned[slot])
+        self.owned[slot].append(dst)
+        self.page_table[slot, idx] = dst
+        if self._copy_fn is None:
+            self._copy_fn = self._build_copy()
+        observe.note_jit_signature(
+            self._copy_fn, graph="serving", key="copy_page",
+            signature=observe.signature_of(shape=self._kv_shape))
+        self.kv = self._copy_fn(self.kv, jnp.asarray(src, jnp.int32),
+                                jnp.asarray(dst, jnp.int32))
+        return dst
+
+    def _build_copy(self):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def copy_page(kv_pages, src, dst):
+            return kv_pages.at[:, :, dst].set(kv_pages[:, :, src])
+
+        return copy_page
 
     # ----------------------------------------------------------- allocation
     def ensure_capacity(self, slot: int, n_tokens: int) -> str:
@@ -106,16 +188,20 @@ class PagedKVCache:
         if need - have > len(self.free):
             return "oom"
         for i in range(have, need):
-            page = self.free.pop()
+            page = self.alloc_page()
             self.owned[slot].append(page)
             self.page_table[slot, i] = page
         return "ok"
 
     def free_slot(self, slot: int) -> int:
-        """Return ``slot``'s pages to the free list; reset its row to the
-        trash page. Returns the number of pages released."""
+        """Release ``slot``'s references; reset its row to the trash page.
+        Under sharing a page only returns to the free list when its LAST
+        holder (another slot, or the prefix tree) releases it — each
+        holder releases exactly once, so a page can never enter the free
+        list twice. Returns the number of page references released."""
         released = len(self.owned[slot])
-        self.free.extend(self.owned[slot])
+        for page in self.owned[slot]:
+            self.release(page)
         self.owned[slot] = []
         self.page_table[slot, :] = self.trash_page
         self.seq_lens[slot] = 0
@@ -127,16 +213,22 @@ class PagedKVCache:
         buffer, leaving ``self.kv`` pointing at deleted device memory.
         Shape and dtype are unchanged, so the engine's cached jit
         signatures stay valid — recovery never recompiles. Host-side page
-        accounting is untouched; the caller frees/retries slots."""
+        accounting is untouched; the caller frees/retries slots (and drops
+        the prefix tree — its cached KV died with the buffer)."""
         self.kv = jnp.zeros(self._kv_shape, self._kv_dtype)
 
-    def check_invariants(self) -> None:
-        """Allocator soundness (test hook): partition property + table/owned
-        agreement. Raises AssertionError on violation."""
-        all_pages = sorted(self.free + [p for o in self.owned for p in o])
-        assert all_pages == list(range(self.num_pages)), (
+    def check_invariants(self, tree_refs=None) -> None:
+        """Allocator soundness (test hook), refcount era: partition
+        property (free XOR refcount >= 1, jointly covering the pool),
+        table/owned agreement, and — when the prefix tree's per-page
+        reference counts are passed as ``tree_refs`` — exact refcount
+        accounting: rc(p) == slot holders + tree holders. Raises
+        AssertionError on violation."""
+        live = [p for p in range(self.num_pages) if self.refcount[p] > 0]
+        assert sorted(self.free + live) == list(range(self.num_pages)), (
             f"page pool corrupt: free={sorted(self.free)} "
-            f"owned={self.owned}")
+            f"live={live} owned={self.owned}")
+        holders = {}
         for slot, pages in enumerate(self.owned):
             row = self.page_table[slot]
             assert list(row[:len(pages)]) == pages, (
@@ -146,3 +238,16 @@ class PagedKVCache:
                        for p in row[len(pages):]), (
                 f"slot {slot} has stale table entries past its pages: {row}")
             assert self.seq_lens[slot] <= len(pages) * self.page_size
+            for p in pages:
+                holders[p] = holders.get(p, 0) + 1
+        for p in range(self.num_pages):
+            assert self.refcount[p] >= holders.get(p, 0), (
+                f"page {p}: refcount {self.refcount[p]} below its "
+                f"{holders.get(p, 0)} slot holders")
+        if tree_refs is not None:
+            for p in range(self.num_pages):
+                want = holders.get(p, 0) + int(tree_refs.get(p, 0))
+                assert self.refcount[p] == want, (
+                    f"page {p}: refcount {self.refcount[p]} != "
+                    f"{holders.get(p, 0)} slot holders + "
+                    f"{tree_refs.get(p, 0)} tree refs")

@@ -19,6 +19,14 @@ admits/evicts (asserted in tests/test_serving.py):
   vectorized temperature/top-k/top-p sampler with per-slot keys split from
   this step's fresh key.
 
+With ``prefix_pages > 0`` a fourth compiled function joins them —
+**suffix-prefill**: on a radix-prefix-cache hit (``serving/prefix.py``)
+the shared pages are mapped into the slot's page-table row by reference
+and only the prompt's uncached tail (padded to the static
+``suffix_bucket``) is prefilled against the cached prefix K/V, so shared
+system prompts admit in O(suffix) instead of O(prompt). All four
+signatures stay config-only — prefix hits never recompile.
+
 Observability (docs/OBSERVABILITY.md catalog additions): admitted/evicted/
 generated-token counters, slot-occupancy gauge, decode-step latency
 histogram, TTFT + inter-token histograms, ``serving_prefill``/
@@ -53,8 +61,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import faults, observe
-from deeplearning4j_tpu.models.gpt import GptModel, gpt_decode_step, gpt_prefill
+from deeplearning4j_tpu.models.gpt import (
+    GptModel, gpt_decode_step, gpt_prefill, gpt_prefill_suffix)
 from deeplearning4j_tpu.serving.cache import PagedKVCache
+from deeplearning4j_tpu.serving.prefix import PrefixMatch, RadixPrefixCache
 from deeplearning4j_tpu.serving.sampling import sample_tokens
 from deeplearning4j_tpu.serving.scheduler import (
     GenerationRequest, GenerationResult, SlotScheduler, count_terminal)
@@ -84,7 +94,10 @@ class GenerativeEngine:
                  seed: int = 0, supervise: bool = True,
                  max_restarts: int = 3, restart_backoff_s: float = 0.05,
                  max_backoff_s: float = 2.0, max_queue: Optional[int] = None,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 prefix_pages: int = 0,
+                 suffix_bucket: Optional[int] = None,
+                 prefix_min_match: Optional[int] = None):
         cfg = model.cfg
         if cfg.hidden % cfg.heads:
             raise ValueError("hidden must be divisible by heads")
@@ -99,8 +112,10 @@ class GenerativeEngine:
         self.max_prompt = int(max_prompt)
         if num_pages is None:
             # full reservation by default; oversubscribe explicitly to make
-            # the free-list pressure (oom evictions) reachable
-            num_pages = max_slots * max_pages_per_seq
+            # the free-list pressure (oom evictions) reachable. A prefix
+            # cache gets its page budget ON TOP so the tree never starves
+            # the slot bank by default.
+            num_pages = max_slots * max_pages_per_seq + max(0, prefix_pages)
         self.cache = PagedKVCache(
             layers=cfg.layers, heads=cfg.heads,
             head_dim=cfg.hidden // cfg.heads, page_size=page_size,
@@ -113,6 +128,21 @@ class GenerativeEngine:
                 f"{self.cache.max_context()} "
                 f"(page_size*max_pages_per_seq)")
         self.scheduler = SlotScheduler(max_slots)
+        # ---------------------------------------- radix prefix cache (2a)
+        # prefix_pages > 0 enables shared-prompt KV reuse: a radix tree
+        # over token sequences whose nodes hold refcounted cache pages
+        # (docs/SERVING.md § Radix prefix cache). suffix_bucket is the
+        # compiled suffix-prefill width — a hit whose uncached tail
+        # exceeds it falls back to the full prefill (static shapes keep
+        # the compile-once property: zero new_shape, test-asserted).
+        self.prefix: Optional[RadixPrefixCache] = None
+        self.suffix_bucket = min(self.max_prompt,
+                                 int(suffix_bucket) if suffix_bucket
+                                 else 2 * self.cache.page_size)
+        if prefix_pages:
+            self.prefix = RadixPrefixCache(
+                self.cache, max_pages=int(prefix_pages),
+                min_match=prefix_min_match)
         self._key = jax.random.key(seed)
         # key-hygiene audit trail: raw key data of every key handed to a
         # jitted sampler, bounded; tests assert no value ever repeats
@@ -120,6 +150,13 @@ class GenerativeEngine:
         self._prefill_fn = None
         self._write_fn = None
         self._decode_fn = None
+        self._suffix_fn = None
+        # per-slot prefix match staged between _admit_pages and
+        # _prefill_into — set (or cleared) on EVERY admission, so a crash
+        # between the two can never leak a stale match into the slot's
+        # next tenant. Kept out of _prefill_into's signature: the
+        # robustness tests wrap that method with (slot, req) shims.
+        self._slot_match: dict = {}
         self._worker: Optional[threading.Thread] = None
         self._stop_flag = False
         self._error: Optional[Exception] = None
@@ -185,6 +222,37 @@ class GenerativeEngine:
             return kv_pages.at[:, :, page_idx, off].set(kv_prompt)
 
         return write_prompt
+
+    def _build_suffix(self):
+        """Suffix-only prefill for prefix-cache hits: gather the cached
+        prefix K/V out of the slot's pages, run the (bucketed) suffix
+        through :func:`gpt_prefill_suffix`, sample the first token from
+        the last suffix position, and scatter the suffix K/V back into
+        the pages. Shapes depend only on server config (max_prompt,
+        suffix_bucket, page geometry) — ONE first_compile, zero
+        new_shape, same as the other three."""
+        cfg, cache = self.cfg, self.cache
+        page, trash = cache.page_size, cache.trash_page
+        t_pre = self.max_prompt
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def suffix_prefill(params, kv_pages, ids, prefix_len, suffix_len,
+                           pt_row, key, temp, top_k, top_p):
+            pos = jnp.arange(t_pre)
+            prefix_kv = kv_pages[:, :, pt_row[pos // page], pos % page]
+            logits, kv_suf = gpt_prefill_suffix(
+                params, ids, prefix_kv, prefix_len, suffix_len, cfg)
+            last = logits[0, suffix_len - 1][None]  # (1, V)
+            tok = sample_tokens(last, key, temp, top_k, top_p)[0]
+            b = ids.shape[1]
+            apos = prefix_len + jnp.arange(b)
+            valid = jnp.arange(b) < suffix_len
+            row_idx = jnp.clip(apos // page, 0, pt_row.shape[0] - 1)
+            wpage = jnp.where(valid, pt_row[row_idx], trash)
+            kv_pages = kv_pages.at[:, :, wpage, apos % page].set(kv_suf)
+            return kv_pages, tok
+
+        return suffix_prefill
 
     def _build_decode(self):
         cfg, cache = self.cfg, self.cache
@@ -439,6 +507,12 @@ class GenerativeEngine:
                     sched.pending.appendleft((req, st.future, st.submit_t))
             else:
                 self._finish_unslotted(req, st.future, "error")
+        if self.prefix is not None:
+            # reset_kv is about to zero the device pages, so every cached
+            # prefix is garbage: drop the tree wholesale (pin intents
+            # survive — re-inserted pinned prefixes re-pin) and rebuild
+            # from live traffic
+            self.prefix.clear()
         # the crash may have killed a decode step AFTER the donation of
         # cache.kv; same-shape reallocation keeps the cached jit fns (and
         # therefore the ledger's zero-new_shape property) intact
@@ -451,8 +525,121 @@ class GenerativeEngine:
             time.sleep(delay)
         return True
 
+    # ---------------------------------------------------------- prefix cache
+    def _match_prefix(self, req: GenerationRequest) -> Optional[PrefixMatch]:
+        """Longest usable cached prefix for an arrival: present, at least
+        ``min_match`` tokens, and with an uncached tail that fits the
+        compiled suffix bucket (otherwise the full prefill is the only
+        compile-once path — match() neither returns nor LRU-refreshes
+        such entries). Lookup counting happens in _admit_pages, once per
+        admission, so pool-pressure retries don't deflate the hit rate."""
+        if self.prefix is None:
+            return None
+        return self.prefix.match(req.prompt, max_suffix=self.suffix_bucket)
+
+    def _admit_pages(self, slot: int, req: GenerationRequest,
+                     match: Optional[PrefixMatch]) -> tuple:
+        """Build ``slot``'s page run for ``req`` (``prompt + 1`` tokens).
+        Without a match this is plain ``ensure_capacity``. With one: map
+        the shared full pages (taking references), copy-on-write the
+        partially-filled tail page the prompt diverges in, then allocate
+        the rest fresh — evicting unpinned tree leaves first when the
+        free list cannot cover it. Any failure (including injected
+        ``page_oom`` mid-match) unwinds the slot completely and returns a
+        terminal status; the caller completes the request. Returns
+        ``(status, prefix_hit_tokens)``."""
+        cache = self.cache
+        p_len = int(req.prompt.size)
+        if self.prefix is not None:
+            self.prefix.note_lookup()
+        if match is None:
+            return cache.ensure_capacity(slot, p_len + 1), 0
+        full = match.matched // cache.page_size
+        tail_len = match.matched % cache.page_size
+        for page in match.pages[:full]:
+            cache.map_shared(slot, page)
+        if faults.should_fire("page_oom"):
+            # injected pool pressure MID-MATCH: unwind the shared
+            # mappings (references only — the tree keeps its pages) and
+            # report the same terminal oom the real arm would
+            cache.free_slot(slot)
+            return "oom", 0
+        guard = None
+        try:
+            if tail_len:
+                # guard the CoW source FIRST: the pool-pressure eviction
+                # below may otherwise drop the tree's (only) reference on
+                # it before we copy
+                guard = match.pages[full]
+                cache.retain(guard)
+            need_rest = cache.pages_for(p_len + 1) - full
+            if need_rest > cache.free_pages:
+                self.prefix.evict_to_free(need_rest - cache.free_pages)
+            if tail_len:
+                if cache.cow_page(slot, guard) is None:
+                    cache.free_slot(slot)
+                    return "oom", 0
+                self.prefix.note_cow()
+            status = cache.ensure_capacity(slot, p_len + 1)
+        finally:
+            if guard is not None:
+                cache.release(guard)
+        if status != "ok":
+            cache.free_slot(slot)
+            return status, 0
+        self.prefix.note_hit(match)
+        return "ok", match.matched
+
+    def prewarm_prefix(self, prompt, *, pin: bool = True):
+        """Run ``prompt`` through one 1-token generation so its KV pages
+        land in the prefix tree, then (by default) PIN them — pre-warmed
+        per-class system prompts are never evicted (the SLO frontend's
+        ``ClassPolicy.shared_prefix`` knob calls this). Works on both an
+        idle engine (inline) and a running one (through the queue)."""
+        if self.prefix is None:
+            raise RuntimeError("prefix cache disabled — construct the "
+                               "engine with prefix_pages > 0")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < self.prefix.min_match:
+            # a prefix shorter than min_match can never match — pinning
+            # it would hold pages forever for zero hits
+            logger.warning(
+                "shared prefix of %d tokens is below the prefix cache's "
+                "min_match=%d — it will never produce a hit (use a longer "
+                "prefix or lower prefix_min_match)", prompt.size,
+                self.prefix.min_match)
+        if self._worker is None:
+            res = self.generate([prompt], max_new_tokens=1, eos_token=-1)[0]
+        else:
+            res = self.submit(prompt, max_new_tokens=1,
+                              eos_token=-1).result(timeout=600)
+        if res.finish_reason not in ("eos", "length"):
+            logger.warning("prefix pre-warm retired as %r — prefix not "
+                           "cached", res.finish_reason)
+            return res
+        if pin:
+            self.prefix.pin(prompt)
+        return res
+
+    def check_invariants(self) -> None:
+        """Allocator + prefix-tree soundness with EXACT refcount
+        accounting (test/chaos hook)."""
+        if self.prefix is not None:
+            self.prefix.check_invariants()
+            self.cache.check_invariants(tree_refs=self.prefix.page_refs())
+        else:
+            self.cache.check_invariants()
+
     # ------------------------------------------------------------ scheduling
     def _retire(self, slot: int, reason: str) -> None:
+        if self.prefix is not None and reason in ("eos", "length"):
+            # a COMPLETED sequence donates its prompt's pages to the
+            # radix tree (insert or LRU-refresh) before the slot lets go
+            st = self.scheduler.slots.get(slot)
+            if st is not None:
+                n = self.cache.pages_for(st.prompt_len)
+                self.prefix.insert(st.request.prompt,
+                                   list(self.cache.owned[slot][:n]))
         self.scheduler.retire(slot, reason)
         self.cache.free_slot(slot)
         count_terminal(reason)
@@ -522,22 +709,39 @@ class GenerativeEngine:
             # p_len + 1 everywhere: the SAME iteration's decode writes the
             # first generated token's K/V at position p_len, so a page-
             # aligned prompt needs its next page NOW — allocating only the
-            # prompt's pages would send that write to the trash page
-            if cache.pages_for(p_len + 1) > cache.free_pages:
-                if not sched.slots:
-                    # nothing active to ever free pages — config-impossible
-                    if sched.remove_pending(item) and not fut.done():
-                        fut.set_exception(RuntimeError(
-                            f"prompt needs {cache.pages_for(p_len + 1)} "
-                            f"pages but the pool only has "
-                            f"{cache.num_pages}"))
-                        count_terminal("error")
-                    continue
-                break  # pool pressure: wait for evictions to free pages
+            # prompt's pages would send that write to the trash page.
+            # A prefix-cache match discounts its shared full pages from
+            # the bill (the CoW tail still costs a fresh page), and the
+            # tree's unpinned pages count as reclaimable supply.
+            match = self._match_prefix(req)
+            need_new = cache.pages_for(p_len + 1) - (
+                match.matched // cache.page_size if match else 0)
+            if need_new > cache.free_pages:
+                # only now pay the O(tree) reclaimable walk: tree pages
+                # eviction would ACTUALLY free (no slot holders, and not
+                # the match's own pages — those are being consumed, not
+                # freed) count as supply — overcounting here would turn
+                # this wait into a spurious terminal oom downstream
+                reclaimable = (self.prefix.reclaimable_pages(
+                    exclude=match.pages if match else ())
+                    if self.prefix is not None else 0)
+                if need_new > cache.free_pages + reclaimable:
+                    if not sched.slots:
+                        # nothing active to ever free pages —
+                        # config-impossible
+                        if sched.remove_pending(item) and not fut.done():
+                            fut.set_exception(RuntimeError(
+                                f"prompt needs {need_new} free pages but "
+                                f"the pool only has {cache.num_pages} "
+                                f"({reclaimable} reclaimable from the "
+                                f"prefix tree)"))
+                            count_terminal("error")
+                        continue
+                    break  # pool pressure: wait for evictions
             if not sched.remove_pending(item):
                 continue  # a frontend steal raced us — re-select
             slot = free[0]
-            status = cache.ensure_capacity(slot, p_len + 1)
+            status, hit_tokens = self._admit_pages(slot, req, match)
             if status != "ok":
                 # the free-pages precheck passed, so this is injected pool
                 # pressure (faults.page_oom) or an allocator race: complete
@@ -545,6 +749,7 @@ class GenerativeEngine:
                 # trash-page-only row (which would corrupt the invariants)
                 self._finish_unslotted(req, fut, status)
                 continue
+            self._slot_match[slot] = match if hit_tokens else None
             try:
                 first_tok = self._prefill_into(slot, req)
             except BaseException:
@@ -558,7 +763,8 @@ class GenerativeEngine:
                 raise
             cache.seq_lens[slot] = p_len
             now = time.perf_counter()
-            sched.admit(slot, req, fut, t_sub, first_tok, now)
+            sched.admit(slot, req, fut, t_sub, first_tok, now,
+                        prefix_hit_tokens=hit_tokens)
             self._obs["admitted"].inc()
             self._obs["generated"].inc()
             self._obs["ttft_h"].observe(now - t_sub)
@@ -627,7 +833,12 @@ class GenerativeEngine:
 
     def _prefill_into(self, slot: int, req: GenerationRequest) -> int:
         """Run the (bucketed) prefill, scatter K/V into the slot's pages,
-        return the first sampled token."""
+        return the first sampled token. With a prefix-cache match staged
+        for this slot the shared pages are already mapped and only the
+        SUFFIX runs — TTFT is measured across this (much shorter) pass."""
+        match = self._slot_match.pop(slot, None)
+        if match is not None:
+            return self._prefill_suffix_into(slot, req, match)
         cache = self.cache
         p_len = int(req.prompt.size)
         ids = np.zeros((1, self.max_prompt), np.int32)
@@ -651,5 +862,34 @@ class GenerativeEngine:
             cache.kv = self._write_fn(
                 cache.kv, kv_prompt, jnp.asarray(cache.page_table[slot]),
                 jnp.asarray(p_len, jnp.int32))
+            tok = int(tok)
+        return tok
+
+    def _prefill_suffix_into(self, slot: int, req: GenerationRequest,
+                             match: PrefixMatch) -> int:
+        """Prefix-hit admission: prefill ONLY the uncached suffix against
+        the cached prefix pages already mapped into the slot's row."""
+        cache = self.cache
+        p_len = int(req.prompt.size)
+        suffix = np.asarray(req.prompt).reshape(-1)[match.matched:]
+        ids = np.zeros((1, self.suffix_bucket), np.int32)
+        ids[0, :suffix.size] = suffix
+        if self._suffix_fn is None:
+            self._suffix_fn = self._build_suffix()
+        key = self._next_key()
+        observe.note_jit_signature(
+            self._suffix_fn, graph="serving", key="suffix_prefill",
+            signature=observe.signature_of(ids=ids))
+        with observe.tracer().span("serving_prefill", category="serving",
+                                   prompt_len=p_len,
+                                   prefix_hit=match.matched):
+            cache.kv, tok = self._suffix_fn(
+                self.model.params, cache.kv, jnp.asarray(ids),
+                jnp.asarray(match.matched, jnp.int32),
+                jnp.asarray(suffix.size, jnp.int32),
+                jnp.asarray(cache.page_table[slot]), key,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32))
             tok = int(tok)
         return tok

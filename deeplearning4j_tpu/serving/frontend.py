@@ -69,7 +69,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -106,6 +106,11 @@ class ClassPolicy:
     class; ``deadline_s`` is the class default when the caller passes
     none. ``degradable`` classes get trimmed in the ``degraded`` state;
     ``reject_in_shedding`` classes are refused outright in ``shedding``.
+    ``shared_prefix`` (token ids) is this class's shared system prompt:
+    at frontend construction it is run through the engine once and PINNED
+    in the radix prefix cache (docs/SERVING.md § Radix prefix cache), so
+    the class's traffic admits with a prefix hit from the first request
+    and eviction pressure can never drop it.
     """
 
     name: str
@@ -117,6 +122,8 @@ class ClassPolicy:
     deadline_s: Optional[float] = None    # class-default deadline
     degradable: bool = True               # ladder may trim this class
     reject_in_shedding: bool = False      # refused outright in "shedding"
+    shared_prefix: Optional[Sequence[int]] = None  # pre-warmed + pinned
+    #                                     system-prompt token ids
 
     def __post_init__(self):
         if self.priority < 0:
@@ -344,6 +351,25 @@ class SLOFrontend:
         self._g_breaker = m.gauge("dl4j_tpu_slo_breaker_open")
         self._g_state.set(0.0)
         self._g_breaker.set(0.0)
+        self._prewarm_shared_prefixes()
+
+    def _prewarm_shared_prefixes(self) -> None:
+        """Run each class's ``shared_prefix`` through the engine once and
+        pin it in the radix prefix cache (docs/SERVING.md § Radix prefix
+        cache) — per-class system prompts hit from the FIRST real
+        request, and eviction can never drop them."""
+        for pol in self.classes.values():
+            if pol.shared_prefix is None:
+                continue
+            if getattr(self.engine, "prefix", None) is None:
+                logger.info(
+                    "class %r declares shared_prefix but the engine's "
+                    "prefix cache is disabled (prefix_pages=0) — skipping "
+                    "pre-warm", pol.name)
+                continue
+            self.engine.prewarm_prefix(pol.shared_prefix, pin=True)
+            observe.log_event("prefix_prewarm", slo_class=pol.name,
+                              tokens=int(np.asarray(pol.shared_prefix).size))
 
     # ----------------------------------------------------------------- admit
     def submit(self, prompt, *, slo_class: str = "standard",

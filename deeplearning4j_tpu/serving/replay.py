@@ -1,0 +1,138 @@
+"""Shared-prompt traffic replay — the prefix-cache acceptance harness.
+
+One harness, three consumers (``BENCH_MODEL=generate BENCH_PREFIX=1`` in
+bench.py, ``tools/prefix.py`` / the ``prefix`` gate stage, and the
+prefix tests): drive a fresh :class:`GenerativeEngine` with the traffic
+shape the radix prefix cache exists for — a handful of shared "system
+prompts" each followed by a short unique tail — and measure what the
+cache buys:
+
+* **TTFT** (submit -> first token): with the cache, admission prefills
+  only the suffix (``suffix_bucket`` tokens against the cached prefix)
+  instead of the whole ``max_prompt`` bucket — the p50 should drop hard;
+* **hit accounting**: ``GenerationResult.prefix_hit_tokens`` per request
+  plus the ``dl4j_tpu_prefix_*`` counters;
+* **correctness**: both legs run GREEDY, so the caller can assert the
+  cache-on outputs are token-for-token identical to cache-off;
+* **compile-once**: the RecompileLedger must show ZERO ``new_shape``
+  serving events — prefix hits ride a fourth compiled function, they
+  never change a jit signature.
+
+Requests run CLOSED-LOOP, one at a time on an inline engine (no worker
+thread): TTFT then measures prefill service time, not queueing — the
+queueing story under load belongs to ``serving/overload.py``. The warm
+rounds populate the tree AND compile every path (full prefill, suffix
+prefill, decode) on both legs, so the timed window is compile-free.
+
+The default model is deliberately bigger than ``GptConfig.tiny`` (hidden
+256, 4 layers): the TTFT comparison must be dominated by prefill compute,
+not by per-call dispatch overhead, to be meaningful on a CPU host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# one definition of "a serving recompile" for every gate harness
+from deeplearning4j_tpu.serving.overload import _serving_new_shape_count
+
+
+def _pct(sorted_xs: List[float], q: float) -> Optional[float]:
+    if not sorted_xs:
+        return None
+    return sorted_xs[min(len(sorted_xs) - 1, int(q * len(sorted_xs)))]
+
+
+def run_prefix_replay(*, prefix_on: bool, n_requests: int = 12,
+                      n_prefixes: int = 3, sys_len: int = 88,
+                      tail_max: int = 5, gen_tokens: int = 4,
+                      max_slots: int = 2, seed: int = 0, vocab: int = 512,
+                      max_prompt: int = 96, page_size: int = 8,
+                      suffix_bucket: int = 16,
+                      prefix_pages: Optional[int] = None,
+                      warm_rounds: int = 2,
+                      model=None) -> Dict[str, Any]:
+    """One replay leg on a fresh engine. Identical ``seed`` on both legs
+    yields an identical request plan, so outputs are comparable
+    token-for-token. Returns TTFT percentiles, per-request outputs, hit
+    accounting, and the serving ``new_shape`` delta."""
+    from deeplearning4j_tpu.models.gpt import GptConfig, GptModel
+    from deeplearning4j_tpu.serving import GenerativeEngine
+
+    if model is None:
+        cfg = GptConfig(vocab_size=vocab, hidden=256, layers=4, heads=8,
+                        intermediate=1024, max_position=2 * max_prompt,
+                        eos_token=0)
+        model = GptModel(cfg, seed=0)
+    cfg = model.cfg
+    if sys_len + tail_max > max_prompt:
+        raise ValueError("sys_len + tail_max must fit the max_prompt bucket")
+    pages_per_seq = -(-(max_prompt + gen_tokens + 1) // page_size) + 1
+    if prefix_pages is None:
+        # budget: every shared prefix fully resident plus a few tails
+        prefix_pages = n_prefixes * (-(-max_prompt // page_size))
+    num_pages = max_slots * pages_per_seq + (prefix_pages if prefix_on
+                                             else 0)
+    eng = GenerativeEngine(
+        model, max_slots=max_slots, page_size=page_size,
+        num_pages=num_pages, max_pages_per_seq=pages_per_seq,
+        max_prompt=max_prompt, seed=0,
+        prefix_pages=prefix_pages if prefix_on else 0,
+        suffix_bucket=suffix_bucket)
+    new_shape_before = _serving_new_shape_count()
+
+    r = np.random.RandomState(seed)
+    prefixes = [r.randint(1, cfg.vocab_size, size=sys_len).astype(np.int32)
+                for _ in range(n_prefixes)]
+    plan = []
+    for _ in range(n_requests):
+        pfx = prefixes[int(r.randint(n_prefixes))]
+        tail = r.randint(1, cfg.vocab_size,
+                         size=int(r.randint(1, tail_max + 1))) \
+            .astype(np.int32)
+        plan.append(np.concatenate([pfx, tail]))
+
+    def run_one(prompt):
+        fut = eng.submit(prompt, max_new_tokens=gen_tokens, eos_token=-1)
+        while eng.scheduler.has_work():
+            eng.step()
+        return fut.result(timeout=0)
+
+    # warm: round 0 inserts each shared prefix; round 1 HITS it on the
+    # cache-on leg, compiling the suffix-prefill path — so the timed
+    # window below pays zero XLA compiles on either leg
+    for round_ in range(warm_rounds):
+        for pfx in prefixes:
+            run_one(np.concatenate(
+                [pfx, np.asarray([1 + round_], np.int32)]))
+
+    results = [run_one(p) for p in plan]
+
+    ttfts = sorted(res.ttft_s for res in results if res.ttft_s is not None)
+    hit_tokens = sum(res.prefix_hit_tokens for res in results)
+    reasons: Dict[str, int] = {}
+    for res in results:
+        reasons[res.finish_reason] = reasons.get(res.finish_reason, 0) + 1
+    out: Dict[str, Any] = {
+        "prefix_on": prefix_on,
+        "requests": n_requests,
+        "outputs": [res.tokens.tolist() for res in results],
+        "prompts": [p.tolist() for p in plan],
+        "reasons": dict(sorted(reasons.items())),
+        "all_terminal": all(res.finish_reason in ("eos", "length")
+                            for res in results),
+        "ttft_p50_ms": round(_pct(ttfts, 0.50) * 1e3, 3) if ttfts else None,
+        "ttft_p99_ms": round(_pct(ttfts, 0.99) * 1e3, 3) if ttfts else None,
+        "prefix_hit_tokens": int(hit_tokens),
+        "hit_requests": sum(1 for res in results
+                            if res.prefix_hit_tokens > 0),
+        "new_shape_events": max(
+            0, _serving_new_shape_count() - new_shape_before),
+    }
+    if prefix_on and eng.prefix is not None:
+        eng.check_invariants()
+        out["tree_pages"] = eng.prefix.tree_pages
+        out["pinned_pages"] = eng.prefix.pinned_pages
+    return out

@@ -123,6 +123,8 @@ class GenerationResult:
     intertoken_s: List[float]        # successive decode-token gaps
     slo_class: str = "standard"      # the request's admission class
     degraded: bool = False           # True: the ladder trimmed this answer
+    prefix_hit_tokens: int = 0       # prompt tokens served from the radix
+    #                                  prefix cache (0 = full prefill)
 
 
 @dataclasses.dataclass
@@ -135,6 +137,7 @@ class _Slot:
     ttft_s: Optional[float] = None
     intertoken_s: List[float] = dataclasses.field(default_factory=list)
     last_token_t: Optional[float] = None
+    prefix_hit_tokens: int = 0
 
 
 class SlotScheduler:
@@ -222,11 +225,16 @@ class SlotScheduler:
     # ------------------------------------------------------------- lifecycle
     def admit(self, slot: int, request: GenerationRequest,
               future: "Future[GenerationResult]", submit_t: float,
-              first_token: int, now: float) -> None:
+              first_token: int, now: float,
+              prefix_hit_tokens: int = 0) -> None:
         """Install a prefilled request into ``slot`` with its first sampled
-        token (TTFT is measured here: prefill produced a token)."""
+        token (TTFT is measured here: prefill produced a token).
+        ``prefix_hit_tokens`` records how much of the prompt the radix
+        prefix cache served — it rides into the GenerationResult so
+        callers and the replay bench can account hits per request."""
         st = _Slot(request=request, future=future, submit_t=submit_t,
-                   prompt_len=int(request.prompt.size))
+                   prompt_len=int(request.prompt.size),
+                   prefix_hit_tokens=int(prefix_hit_tokens))
         st.tokens.append(int(first_token))
         st.ttft_s = now - submit_t
         st.last_token_t = now
@@ -261,7 +269,8 @@ class SlotScheduler:
             tokens=np.asarray(toks, np.int32), finish_reason=reason,
             prompt_len=st.prompt_len, ttft_s=st.ttft_s,
             intertoken_s=list(st.intertoken_s),
-            slo_class=st.request.slo_class, degraded=st.request.degraded)
+            slo_class=st.request.slo_class, degraded=st.request.degraded,
+            prefix_hit_tokens=st.prefix_hit_tokens)
         if not st.future.done():
             st.future.set_result(result)
         return result

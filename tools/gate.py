@@ -34,6 +34,10 @@ Stages:
      goodput must be >= frontend-off under an identical past-capacity
      schedule, with every request terminal and zero new_shape events
      (docs/SERVING.md § SLO admission frontend)
+ 11. prefix smoke: tools/prefix.py shared-prompt replay — prefix hit
+     tokens > 0, TTFT p50 >= 30% better than cache-off, greedy outputs
+     bit-identical both legs, zero new_shape events
+     (docs/SERVING.md § Radix prefix cache)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -348,6 +352,44 @@ def slo_stage() -> bool:
     return bool(ok)
 
 
+def prefix_stage() -> bool:
+    """Prefix-cache smoke (docs/SERVING.md § Radix prefix cache): the
+    shared-prompt replay must report ok — prefix hit tokens > 0, TTFT p50
+    >= 30% better than cache-off (median of paired trials), greedy
+    outputs bit-identical on both legs, zero new_shape events. One JSON
+    line, like lint/check/obs/chaos/slo."""
+    print("== gate: prefix-smoke (shared-prompt replay, cache on/off) ==",
+          flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_FAULTS", None)  # an ambient schedule would distort
+    try:                              # the paired TTFT comparison
+        proc = subprocess.run(
+            [sys.executable, "tools/prefix.py", "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (prefix-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (prefix-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    ok = (bool(rec.get("ok"))
+          and (rec.get("prefix_hit_tokens") or 0) > 0
+          and rec.get("outputs_identical")
+          and rec.get("new_shape_events") == 0)
+    print(f"   {'ok' if ok else 'FAIL'} (prefix-smoke: TTFT p50 "
+          f"{rec.get('ttft_p50_ms_on')}/{rec.get('ttft_p50_ms_off')} ms "
+          f"on/off (x{rec.get('ttft_speedup')}), "
+          f"{rec.get('prefix_hit_tokens')} hit tokens, identical="
+          f"{rec.get('outputs_identical')})")
+    return bool(ok)
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -420,6 +462,7 @@ def main() -> int:
         results["tune"] = tune_stage()
         results["chaos"] = chaos_stage()
         results["slo"] = slo_stage()
+        results["prefix"] = prefix_stage()
         results["multichip"] = multichip_stage()
 
     failed = [k for k, v in results.items() if not v]

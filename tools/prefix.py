@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Prefix-cache smoke — shared-prompt replay, cache on vs off.
+
+The ROADMAP 2(a) gate stage (docs/SERVING.md § Radix prefix cache): run
+the shared-prompt replay harness (``serving/replay.py``) twice — once
+with the radix prefix cache, once without, IDENTICAL request plan — and
+assert the cache earns its place instead of trusting it:
+
+  * prefix **hit tokens > 0** (a replay that never hit proved nothing);
+  * **TTFT p50 improves >= 30%** vs cache-off (median of paired trials —
+    host-load spikes hit single trials);
+  * greedy outputs **bit-identical** on both legs — suffix prefill
+    against cached pages must reproduce the full prefill token-for-token;
+  * ZERO ``new_shape`` RecompileLedger serving events on either leg —
+    prefix hits ride a fourth compiled function, they never recompile;
+  * allocator + tree invariants hold after every leg (checked inside the
+    harness) and every request retires complete.
+
+Contract (same as lint/check/obs/tune/chaos/slo): ONE JSON summary line
+on stdout with ``"tool": "prefix"``; exit 0 iff ``ok``. ``make
+prefix-smoke`` pins JAX_PLATFORMS=cpu; ``tools/gate.py``'s ``prefix``
+stage parses the line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: The acceptance bar: cache-on TTFT p50 must be <= 70% of cache-off.
+MIN_IMPROVEMENT = 0.30
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable: exactly one JSON line on stdout")
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--prefixes", type=int, default=3)
+    ap.add_argument("--sys-len", type=int, default=88)
+    ap.add_argument("--tokens", type=int, default=4)
+    ap.add_argument("--trials", type=int, default=3,
+                    help="paired on/off trials; MEDIAN TTFT p50s are "
+                         "compared (host-load spikes hit single trials)")
+    args = ap.parse_args()
+
+    from deeplearning4j_tpu.serving.replay import run_prefix_replay
+
+    t0 = time.perf_counter()
+    ons, offs = [], []
+    for trial in range(max(1, args.trials)):
+        ons.append(run_prefix_replay(
+            prefix_on=True, n_requests=args.requests,
+            n_prefixes=args.prefixes, sys_len=args.sys_len,
+            gen_tokens=args.tokens, seed=trial))
+        offs.append(run_prefix_replay(
+            prefix_on=False, n_requests=args.requests,
+            n_prefixes=args.prefixes, sys_len=args.sys_len,
+            gen_tokens=args.tokens, seed=trial))
+
+    p50_on = statistics.median(r["ttft_p50_ms"] for r in ons)
+    p50_off = statistics.median(r["ttft_p50_ms"] for r in offs)
+    speedup = p50_off / p50_on if p50_on else 0.0
+    improvement = 1.0 - (p50_on / p50_off) if p50_off else 0.0
+    hit_tokens = sum(r["prefix_hit_tokens"] for r in ons)
+    identical = all(a["outputs"] == b["outputs"]
+                    for a, b in zip(ons, offs))
+    all_terminal = all(r["all_terminal"] for r in ons + offs)
+    new_shape = sum(r["new_shape_events"] for r in ons + offs)
+
+    ok = (hit_tokens > 0
+          and identical
+          and all_terminal
+          and improvement >= MIN_IMPROVEMENT
+          and new_shape == 0)
+
+    on, off = ons[-1], offs[-1]  # full detail from the last pair
+    rec = {
+        "tool": "prefix", "ok": ok,
+        "ttft_p50_ms_on": p50_on, "ttft_p50_ms_off": p50_off,
+        "ttft_speedup": round(speedup, 3),
+        "ttft_improvement_pct": round(100.0 * improvement, 1),
+        "min_improvement_pct": round(100.0 * MIN_IMPROVEMENT, 1),
+        "prefix_hit_tokens": hit_tokens,
+        "hit_requests": sum(r["hit_requests"] for r in ons),
+        "requests_per_leg": args.requests,
+        "trials": len(ons),
+        "p50_on_trials": [r["ttft_p50_ms"] for r in ons],
+        "p50_off_trials": [r["ttft_p50_ms"] for r in offs],
+        "outputs_identical": identical,
+        "all_terminal": all_terminal,
+        "new_shape_events": new_shape,
+        "tree_pages": on.get("tree_pages"),
+        "reasons_on": on["reasons"], "reasons_off": off["reasons"],
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }
+    print(json.dumps(rec), flush=True)
+    if not args.json:
+        print(f"prefix: {'OK' if ok else 'FAIL'} — TTFT p50 "
+              f"{p50_on}/{p50_off} ms on/off (x{rec['ttft_speedup']}, "
+              f"{rec['ttft_improvement_pct']}% better), {hit_tokens} hit "
+              f"tokens, identical={identical}, new_shape={new_shape}",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
