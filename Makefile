@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke slo-smoke prefix-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke slo-smoke prefix-smoke spec-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -72,6 +72,17 @@ slo-smoke:
 # ONE JSON line like lint/check/obs/chaos/slo.
 prefix-smoke:
 	JAX_PLATFORMS=cpu python tools/prefix.py --json
+
+# speculative-decoding smoke (docs/SERVING.md § Speculative decoding):
+# the greedy replay, spec on vs off with an identical request plan under
+# the deterministic slow_decode target-step floor — fails unless draft
+# tokens were accepted, tokens/sec >= spec-off (median of paired
+# trials), greedy outputs are bit-identical on both legs, the ledger
+# shows exactly the expected first_compile events (draft decode +
+# verify join the family), and zero new_shape events were paid for it.
+# ONE JSON line like lint/check/obs/chaos/slo/prefix.
+spec-smoke:
+	JAX_PLATFORMS=cpu python tools/spec.py --json
 
 # generative-serving smoke (docs/SERVING.md): continuous-batching
 # generation, smoke-sized, CPU-pinned — ONE JSON line with tokens/sec,

@@ -414,6 +414,44 @@ def _bench_generate_prefix(n_requests: int, n_prefixes: int, sys_len: int,
     return speedup, "generate_prefix_ttft_p50_speedup", extra
 
 
+def _bench_generate_spec(n_requests: int, gen_tokens: int, spec_k: int):
+    """Speculative-decoding benchmark (BENCH_MODEL=generate +
+    BENCH_SPEC=1): the replay harness (serving/replay.py, docs/SERVING.md
+    § Speculative decoding) run twice — spec on, then spec off with the
+    IDENTICAL greedy request plan, both under the deterministic 50ms
+    slow_decode target-step floor (the slo-gate measurement model). Value
+    = the decode tokens/sec speedup speculation buys (on/off); the JSON
+    line carries both legs' rates, the proposal/acceptance accounting,
+    and the bit-identical check — losslessness fails the bench, not just
+    a test."""
+    from deeplearning4j_tpu.serving.replay import run_spec_replay
+
+    on = run_spec_replay(spec_on=True, n_requests=n_requests,
+                         gen_tokens=gen_tokens, spec_k=spec_k)
+    off = run_spec_replay(spec_on=False, n_requests=n_requests,
+                          gen_tokens=gen_tokens, spec_k=spec_k)
+    identical = on["outputs"] == off["outputs"]
+    assert identical, (
+        "speculative outputs diverged from the spec-off oracle — the "
+        "verify/rollback path is numerically wrong")
+    assert on["accepted_tokens"] > 0, "replay accepted zero draft tokens"
+    speedup = (on["tokens_per_sec"] / off["tokens_per_sec"]
+               if off["tokens_per_sec"] else 0.0)
+    extra = {
+        "tokens_per_sec_on": on["tokens_per_sec"],
+        "tokens_per_sec_off": off["tokens_per_sec"],
+        "spec_k": on["spec_k"],
+        "proposed_tokens": on["proposed_tokens"],
+        "accepted_tokens": on["accepted_tokens"],
+        "acceptance_rate": on["acceptance_rate"],
+        "requests": on["requests"],
+        "outputs_identical": identical,
+        "first_compile_keys_on": on["first_compile_keys"],
+        "new_shape_events": on["new_shape_events"] + off["new_shape_events"],
+    }
+    return speedup, "generate_spec_tokens_per_sec_speedup", extra
+
+
 def _bench_bert_import(layers: int, seq: int, d: int, heads: int, ff: int,
                        iters: int):
     """Imported-BERT forward throughput (BENCH_MODEL=bert_import): the
@@ -601,7 +639,8 @@ _UNITS = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
           "generate_open_loop_tokens_per_sec": "tokens/sec",
           "generate_overload_goodput_tokens_per_sec":
               "deadline-met tokens/sec",
-          "generate_prefix_ttft_p50_speedup": "x TTFT p50 vs cache-off"}
+          "generate_prefix_ttft_p50_speedup": "x TTFT p50 vs cache-off",
+          "generate_spec_tokens_per_sec_speedup": "x tokens/sec vs spec-off"}
 
 _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "lenet": "lenet5_mnist_train_images_per_sec",
@@ -613,7 +652,8 @@ _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "generate": "generate_open_loop_tokens_per_sec",
                  "generate_overload":
                      "generate_overload_goodput_tokens_per_sec",
-                 "generate_prefix": "generate_prefix_ttft_p50_speedup"}
+                 "generate_prefix": "generate_prefix_ttft_p50_speedup",
+                 "generate_spec": "generate_spec_tokens_per_sec_speedup"}
 
 
 def main() -> None:
@@ -626,6 +666,8 @@ def main() -> None:
         model = "generate_overload"
     elif model == "generate" and os.environ.get("BENCH_PREFIX") == "1":
         model = "generate_prefix"
+    elif model == "generate" and os.environ.get("BENCH_SPEC") == "1":
+        model = "generate_spec"
     dtype = os.environ.get("BENCH_DTYPE", "mixed")
     smoke = backend == "cpu-fallback"
     # On cpu-fallback, headline workloads at device sizes would run for
@@ -703,6 +745,13 @@ def main() -> None:
             value, metric, extra = _bench_generate_prefix(nreq, npfx, slen,
                                                           gen)
             method = f"n{nreq}p{npfx}s{slen}g{gen}"
+        elif model == "generate_spec":
+            nreq = int(os.environ.get("BENCH_REQUESTS",
+                                      "6" if smoke else "16"))
+            gen = int(os.environ.get("BENCH_GEN_TOKENS", "12"))
+            k = int(os.environ.get("BENCH_SPEC_K", "4"))
+            value, metric, extra = _bench_generate_spec(nreq, gen, k)
+            method = f"n{nreq}g{gen}k{k}"
         elif model == "generate_overload":
             nreq = int(os.environ.get("BENCH_REQUESTS",
                                       "24" if smoke else "64"))

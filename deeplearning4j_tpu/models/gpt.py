@@ -17,6 +17,15 @@ execution split the serving engine needs:
   (Pallas on TPU, gather fallback elsewhere). All shapes are functions of
   the slot capacity, never of the number of active sequences, so the
   serving loop compiles exactly once (docs/SERVING.md).
+* **verify** (:func:`gpt_verify`): the speculative-decoding target pass
+  (docs/SERVING.md § Speculative decoding) — ``K+1`` proposed tokens per
+  sequence in ONE forward against the paged cache, scoring every draft
+  proposal at once. Shapes depend on ``(max_slots, spec_k, page
+  geometry)`` only, so speculation joins the compile-once family.
+
+Draft/target pairing: :func:`draft_config_for` builds the GPT-tiny-sized
+draft config that shares a target's vocab/eos/positions — the pairing the
+zoo exposes as ``models.GPT(preset).init_draft()``.
 
 Tied embeddings: logits project through ``embeddings.word.T`` (the BERT MLM
 head convention), so the checkpoint is exactly the param pytree.
@@ -72,6 +81,20 @@ class GptConfig:
         d = json.loads(s)
         d.pop("@type", None)
         return GptConfig(**d)
+
+
+def draft_config_for(cfg: GptConfig, **overrides) -> "GptConfig":
+    """The paired DRAFT config for speculative decoding against ``cfg``
+    (docs/SERVING.md § Speculative decoding): GPT-tiny-sized transformer
+    dims, but vocab_size/eos_token/max_position copied from the target —
+    draft proposals are target token ids at target positions, so those
+    three must agree (the serving engine validates them again at
+    construction). ``overrides`` widen/narrow the draft dims."""
+    d = dict(vocab_size=cfg.vocab_size, max_position=cfg.max_position,
+             eos_token=cfg.eos_token, hidden=64, layers=2, heads=4,
+             intermediate=128)
+    d.update(overrides)
+    return GptConfig(**d)
 
 
 def init_gpt_params(key, cfg: GptConfig, dtype=jnp.float32) -> Dict[str, Any]:
@@ -221,6 +244,83 @@ def gpt_prefill_suffix(params, ids, prefix_kv, prefix_len, suffix_len,
         x = _ffn(blk, x, cfg.layer_norm_eps)
     logits = x @ emb["word"].T
     return logits, jnp.stack(kvs)
+
+
+def gpt_verify(params, kv_pages, tokens, seq_lens, page_table, write_pages,
+               write_offsets, cfg: GptConfig, *, page_size: int
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Speculative-decoding verification: score ``B = K + 1`` proposed
+    tokens per slot in ONE causal forward against the paged KV cache
+    (docs/SERVING.md § Speculative decoding).
+
+    kv_pages: (L, 2, P, page, H, Dh) — functionally updated (donate it);
+    tokens: (S, B) int32 — per slot, the last committed token followed by
+    the draft's K proposals; seq_lens: (S,) tokens already CACHED for the
+    slot (the fed run occupies absolute positions ``seq_lens + i``);
+    page_table: (S, max_pages) int32; write_pages/write_offsets: (S, B)
+    where each fed token's K/V lands (the engine points inactive slots at
+    its trash page). Fed token ``i`` attends to every cached position
+    ``< seq_lens`` plus fed positions ``<= i`` — the same causal math as
+    :func:`gpt_prefill`, restricted to the B-token window. Returns
+    ``(kv_pages, greedy (S, B) int32)`` — the target's argmax at every
+    fed position, which is all greedy acceptance needs: proposal ``d_i``
+    is accepted iff it equals the argmax at position ``i - 1``, and the
+    argmax after the accepted prefix is the correction/bonus token.
+
+    The K/V of EVERY fed token is scattered (positions past the accepted
+    prefix become garbage beyond the engine's rewound ``seq_lens`` —
+    never read, overwritten by the next pass), so acceptance costs no
+    second write pass.
+    """
+    from deeplearning4j_tpu.ops import exec_op
+
+    emb = params["embeddings"]
+    s_n, b = tokens.shape
+    t_v = page_table.shape[1] * page_size
+    h, dh = cfg.heads, cfg.hidden // cfg.heads
+    pos = jnp.clip(seq_lens[:, None] + jnp.arange(b)[None, :], 0,
+                   cfg.max_position - 1)
+    x = emb["word"][tokens] + emb["position"][pos]
+    x = _layer_norm(x, emb["ln_gamma"], emb["ln_beta"], cfg.layer_norm_eps)
+
+    def split(a):  # (S, B, E) -> (S, H, B, Dh)
+        return a.reshape(s_n, b, h, dh).transpose(0, 2, 1, 3)
+
+    # (S, 1, B, Tv + B) bool: query i -> cached j < seq_lens, then fed
+    # j' <= i (causal within the window). Fed tokens also land in the
+    # gathered page range at positions >= seq_lens, but the cached-side
+    # mask excludes them — their fresh K/V enters via the concat instead.
+    tpos = jnp.arange(t_v)
+    m_ctx = jnp.broadcast_to((tpos[None, None, :]
+                              < seq_lens[:, None, None]), (s_n, b, t_v))
+    qi = jnp.arange(b)[:, None]
+    m_fed = jnp.broadcast_to(jnp.arange(b)[None, :] <= qi, (b, b))
+    m4 = jnp.concatenate(
+        [m_ctx, jnp.broadcast_to(m_fed[None], (s_n, b, b))],
+        axis=2)[:, None]
+    gpage = page_table[:, tpos // page_size]          # (S, Tv)
+    goff = tpos % page_size
+    for li, blk in enumerate(params["blocks"]):
+        a = blk["attn"]
+        q = split(x @ a["Wq"] + a["bq"])
+        k = split(x @ a["Wk"] + a["bk"])
+        v = split(x @ a["Wv"] + a["bv"])
+        # scatter fed K/V token-major; trash-page duplicates are benign
+        kv_pages = kv_pages.at[li, 0, write_pages, write_offsets].set(
+            k.transpose(0, 2, 1, 3))
+        kv_pages = kv_pages.at[li, 1, write_pages, write_offsets].set(
+            v.transpose(0, 2, 1, 3))
+        kc = kv_pages[li, 0][gpage, goff].transpose(0, 2, 1, 3)  # (S,H,Tv,Dh)
+        vc = kv_pages[li, 1][gpage, goff].transpose(0, 2, 1, 3)
+        out = exec_op("dot_product_attention", q,
+                      jnp.concatenate([kc, k], axis=2),
+                      jnp.concatenate([vc, v], axis=2), m4, scaled=True)
+        out = out.transpose(0, 2, 1, 3).reshape(s_n, b, cfg.hidden)
+        x = _layer_norm(x + out @ a["Wo"] + a["bo"],
+                        a["ln_gamma"], a["ln_beta"], cfg.layer_norm_eps)
+        x = _ffn(blk, x, cfg.layer_norm_eps)
+    logits = x @ emb["word"].T
+    return kv_pages, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
 def gpt_decode_step(params, kv_pages, tokens, positions, page_table,

@@ -392,6 +392,18 @@ class GPT(ZooModel):
 
         return GptModel(self.cfg, seed=self.seed)
 
+    def init_draft(self, seed: int = None, **overrides):
+        """The paired DRAFT model for speculative decoding against this
+        target (docs/SERVING.md § Speculative decoding): GPT-tiny dims
+        sharing the target's vocab/eos/max_position —
+        ``GenerativeEngine(model, spec_k=K, draft_model=zoo_gpt.
+        init_draft())`` is the whole wiring. A production draft loads
+        trained weights into the same config via ``restore_gpt``."""
+        from deeplearning4j_tpu.models.gpt import GptModel, draft_config_for
+
+        return GptModel(draft_config_for(self.cfg, **overrides),
+                        seed=self.seed if seed is None else seed)
+
 
 class VGG19(ZooModel):
     """zoo/model/VGG19.java: 16 conv + 3 dense (VGG16 with one extra conv

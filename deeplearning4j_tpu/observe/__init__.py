@@ -130,6 +130,20 @@ def summary() -> Dict[str, Any]:
             "intertoken_p99_ms": _ms(itl["p99"]),
         }
 
+    proposed = m.counter("dl4j_tpu_spec_proposed_tokens_total").value
+    if proposed:
+        accepted = m.counter("dl4j_tpu_spec_accepted_tokens_total").value
+        ratio = m.histogram("dl4j_tpu_spec_accept_ratio").percentiles()
+        out["spec"] = {
+            "proposed_tokens": int(proposed),
+            "accepted_tokens": int(accepted),
+            "rejected_tokens": int(
+                m.counter("dl4j_tpu_spec_rejected_tokens_total").value),
+            "acceptance_rate": round(accepted / proposed, 4),
+            "accept_ratio_p50": None if ratio["p50"] is None
+            else round(ratio["p50"], 3),
+        }
+
     lookups = m.counter("dl4j_tpu_prefix_lookups_total").value
     if lookups:
         hits = m.counter("dl4j_tpu_prefix_hits_total").value

@@ -27,6 +27,16 @@ transformer (``models/gpt.py``) served through
   ``ClassPolicy.shared_prefix``; ``BENCH_PREFIX=1`` / ``make
   prefix-smoke`` measure the TTFT win);
 
+* :class:`SpeculativeDecoder` (``serving/speculative.py``) — speculative
+  decoding (draft-then-verify, lossless): ``GenerativeEngine(spec_k=K,
+  draft_model=...)`` runs a small draft model over a dense per-slot KV
+  cache to propose K greedy tokens per step, verifies all of them in ONE
+  target forward (``models.gpt.gpt_verify``, the fifth compiled fn), and
+  commits the agreed prefix plus the target's correction token —
+  bit-identical outputs at 1..K+1 tokens per target step, rollback as an
+  O(1) length rewind (``BENCH_SPEC=1`` / ``make spec-smoke`` measure the
+  tokens/sec win);
+
 * :class:`SLOFrontend` (``serving/frontend.py``) — the SLO-driven
   admission layer: priority classes over a priority-ordered pending
   queue, token-bucket rate limits, predictive early shed against
@@ -59,11 +69,15 @@ from deeplearning4j_tpu.serving.scheduler import (
     GenerationResult,
     SlotScheduler,
 )
+from deeplearning4j_tpu.serving.speculative import (
+    SpeculativeDecoder,
+    perturbed_draft,
+)
 
 __all__ = [
     "PagedKVCache", "GenerativeEngine", "sample_tokens",
     "GenerationRequest", "GenerationResult", "SlotScheduler",
     "FINISH_REASONS", "SLOFrontend", "ClassPolicy", "LadderThresholds",
     "OVERLOAD_STATES", "default_classes", "RadixPrefixCache",
-    "PrefixMatch",
+    "PrefixMatch", "SpeculativeDecoder", "perturbed_draft",
 ]

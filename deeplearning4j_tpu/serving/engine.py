@@ -27,6 +27,23 @@ and only the prompt's uncached tail (padded to the static
 system prompts admit in O(suffix) instead of O(prompt). All four
 signatures stay config-only — prefix hits never recompile.
 
+With ``spec_k > 0`` (plus a ``draft_model``) a fifth joins —
+**verify** (``models.gpt.gpt_verify``): speculative decoding
+(docs/SERVING.md § Speculative decoding, ``serving/speculative.py``).
+Each step, greedy slots run K draft-model decode steps (one compiled
+``draft_decode`` scan over a dense per-slot draft cache) to propose K
+tokens, then ONE target forward over the ``K+1``-token window scores
+every proposal; the accepted prefix plus the target's correction/bonus
+token commits — 1..K+1 tokens per step per slot, bit-identical to
+non-speculative greedy decoding (scoped to verify/decode argmax
+agreement across kernels — docs/SERVING.md § Speculative decoding,
+"On-device caveat"). A rejection REWINDS the slot's cached length (and
+the draft's) instead of freeing pages, so rollback is O(1) and
+refcount-safe. Slots with ``temperature > 0`` (or
+``spec_disabled`` requests) fall back to the plain decode step. Verify's
+shape depends only on ``(max_slots, spec_k, page geometry)`` — the
+ledger stays at one ``first_compile`` per function, zero ``new_shape``.
+
 Observability (docs/OBSERVABILITY.md catalog additions): admitted/evicted/
 generated-token counters, slot-occupancy gauge, decode-step latency
 histogram, TTFT + inter-token histograms, ``serving_prefill``/
@@ -62,9 +79,10 @@ import numpy as np
 
 from deeplearning4j_tpu import faults, observe
 from deeplearning4j_tpu.models.gpt import (
-    GptModel, gpt_decode_step, gpt_prefill, gpt_prefill_suffix)
+    GptModel, gpt_decode_step, gpt_prefill, gpt_prefill_suffix, gpt_verify)
 from deeplearning4j_tpu.serving.cache import PagedKVCache
 from deeplearning4j_tpu.serving.prefix import PrefixMatch, RadixPrefixCache
+from deeplearning4j_tpu.serving.speculative import SpeculativeDecoder
 from deeplearning4j_tpu.serving.sampling import sample_tokens
 from deeplearning4j_tpu.serving.scheduler import (
     GenerationRequest, GenerationResult, SlotScheduler, count_terminal)
@@ -97,7 +115,9 @@ class GenerativeEngine:
                  default_deadline_s: Optional[float] = None,
                  prefix_pages: int = 0,
                  suffix_bucket: Optional[int] = None,
-                 prefix_min_match: Optional[int] = None):
+                 prefix_min_match: Optional[int] = None,
+                 spec_k: int = 0,
+                 draft_model: Optional[GptModel] = None):
         cfg = model.cfg
         if cfg.hidden % cfg.heads:
             raise ValueError("hidden must be divisible by heads")
@@ -143,6 +163,39 @@ class GenerativeEngine:
             self.prefix = RadixPrefixCache(
                 self.cache, max_pages=int(prefix_pages),
                 min_match=prefix_min_match)
+        # ------------------------------------------ speculative decoding (2b)
+        # spec_k > 0 (plus a draft model sharing the target's vocab) turns
+        # greedy slots speculative: K draft proposals per step, one target
+        # verify pass, 1..K+1 committed tokens (docs/SERVING.md
+        # § Speculative decoding). Off by default — spec_k=0 is the plain
+        # one-token decode loop, byte-for-byte.
+        self.spec: Optional[SpeculativeDecoder] = None
+        self._spec_slots: set = set()
+        self._spec_limit = 0
+        if spec_k:
+            if draft_model is None:
+                raise ValueError("spec_k > 0 requires a draft_model "
+                                 "(models.GPT(...).init_draft() builds the "
+                                 "paired one)")
+            dcfg = draft_model.cfg
+            if dcfg.vocab_size != cfg.vocab_size:
+                # draft proposals are TARGET token ids — a vocab mismatch
+                # would silently verify garbage
+                raise ValueError(
+                    f"draft vocab_size={dcfg.vocab_size} != target "
+                    f"vocab_size={cfg.vocab_size}")
+            if dcfg.eos_token != cfg.eos_token:
+                # eos rides the request, but a config disagreement is a
+                # mispairing worth failing fast on (draft_config_for's
+                # contract: vocab/eos/positions agree)
+                raise ValueError(
+                    f"draft eos_token={dcfg.eos_token} != target "
+                    f"eos_token={cfg.eos_token}")
+            self.spec = SpeculativeDecoder(
+                draft_model, k=int(spec_k), max_slots=max_slots,
+                max_ctx=self.cache.max_context(),
+                max_prompt=self.max_prompt)
+            self._spec_limit = min(cfg.max_position, dcfg.max_position)
         self._key = jax.random.key(seed)
         # key-hygiene audit trail: raw key data of every key handed to a
         # jitted sampler, bounded; tests assert no value ever repeats
@@ -151,6 +204,7 @@ class GenerativeEngine:
         self._write_fn = None
         self._decode_fn = None
         self._suffix_fn = None
+        self._verify_fn = None
         # per-slot prefix match staged between _admit_pages and
         # _prefill_into — set (or cleared) on EVERY admission, so a crash
         # between the two can never leak a stale match into the slot's
@@ -274,6 +328,33 @@ class GenerativeEngine:
             return kv_pages, toks, logits
 
         return decode
+
+    def _build_verify(self):
+        """Speculative verification (docs/SERVING.md § Speculative
+        decoding): ONE target forward over each slot's ``spec_k + 1``
+        fed tokens (last committed + K draft proposals) against the paged
+        cache, returning the target's greedy argmax at every fed
+        position. Inactive/non-speculating slots ride along masked —
+        their writes land on the trash page, their outputs are ignored.
+        Shapes depend only on (max_slots, spec_k, page geometry): ONE
+        first_compile, zero new_shape, same as the other four."""
+        cfg, cache = self.cfg, self.cache
+        page, trash = cache.page_size, cache.trash_page
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def verify(params, kv_pages, tokens, seq_lens, page_table, active):
+            s_n, b = tokens.shape
+            on = active > 0
+            pos = seq_lens[:, None] + jnp.arange(b)[None, :]
+            row = jnp.clip(pos // page, 0, page_table.shape[1] - 1)
+            wpage = jnp.where(
+                on[:, None],
+                page_table[jnp.arange(s_n)[:, None], row], trash)
+            return gpt_verify(params, kv_pages, tokens, seq_lens,
+                              page_table, wpage, pos % page, cfg,
+                              page_size=page)
+
+        return verify
 
     # ------------------------------------------------------------------- api
     def submit(self, prompt, *, max_new_tokens: int = 16,
@@ -471,7 +552,7 @@ class GenerativeEngine:
                 tokens=np.zeros((0,), np.int32), finish_reason=reason,
                 prompt_len=int(req.prompt.size), ttft_s=None,
                 intertoken_s=[], slo_class=req.slo_class,
-                degraded=req.degraded))
+                degraded=req.degraded, spec_disabled=req.spec_disabled))
         count_terminal(reason)
         observe.log_event("serving_terminal", reason=reason,
                           slo_class=req.slo_class)
@@ -513,6 +594,14 @@ class GenerativeEngine:
             # survive — re-inserted pinned prefixes re-pin) and rebuild
             # from live traffic
             self.prefix.clear()
+        if self.spec is not None:
+            # the crash may have died mid-donation of the draft KV buffer
+            # too; same-shape reallocation keeps the compiled draft fns
+            # (zero new_shape across restarts). Retried requests restart
+            # from the prompt, so their draft rows re-prefill — recovery
+            # stays lossless.
+            self._spec_slots.clear()
+            self.spec.reset()
         # the crash may have killed a decode step AFTER the donation of
         # cache.kv; same-shape reallocation keeps the cached jit fns (and
         # therefore the ledger's zero-new_shape property) intact
@@ -623,12 +712,18 @@ class GenerativeEngine:
 
     def check_invariants(self) -> None:
         """Allocator + prefix-tree soundness with EXACT refcount
-        accounting (test/chaos hook)."""
+        accounting, plus draft/target length agreement when speculative
+        decoding is on (test/chaos hook)."""
         if self.prefix is not None:
             self.prefix.check_invariants()
             self.cache.check_invariants(tree_refs=self.prefix.page_refs())
         else:
             self.cache.check_invariants()
+        if self.spec is not None:
+            assert self._spec_slots <= set(self.scheduler.slots), (
+                f"speculating slots {self._spec_slots} outside the active "
+                f"bank {sorted(self.scheduler.slots)}")
+            self.spec.check_invariants(self._spec_slots, self.cache.seq_lens)
 
     # ------------------------------------------------------------ scheduling
     def _retire(self, slot: int, reason: str) -> None:
@@ -642,6 +737,9 @@ class GenerativeEngine:
                                    list(self.cache.owned[slot][:n]))
         self.scheduler.retire(slot, reason)
         self.cache.free_slot(slot)
+        if self.spec is not None:
+            self._spec_slots.discard(slot)
+            self.spec.free(slot)
         count_terminal(reason)
 
     def step(self) -> int:
@@ -768,6 +866,17 @@ class GenerativeEngine:
             self._obs["admitted"].inc()
             self._obs["generated"].inc()
             self._obs["ttft_h"].observe(now - t_sub)
+            if (self.spec is not None and req.temperature <= 0.0
+                    and not req.spec_disabled):
+                # greedy slots speculate: the draft prefills the SAME
+                # prompt (full — the draft cache has no prefix tree) so
+                # draft and target agree on a cached length of p_len.
+                # Sampling (temperature > 0) and spec_disabled requests
+                # stay on the plain decode path. A crash in here is
+                # supervised like any admission crash: the request
+                # already holds its slot, so _recover re-queues it.
+                self.spec.prefill(slot, req.prompt)
+                self._spec_slots.add(slot)
 
         # 4. a just-admitted sequence can already be done (first token was
         #    its eos, or max_new_tokens == 1) — retire before decoding
@@ -781,7 +890,50 @@ class GenerativeEngine:
         if not active:
             return 0
 
-        # 5. one decode iteration over the whole slot bank
+        # 5. one decode iteration over the whole slot bank. With
+        #    speculation on, the bank splits: slots that can take a
+        #    spec_k+1-token verify window this step go the draft+verify
+        #    path; everything else (sampling slots, spec-disabled
+        #    requests, sequences near their context/position limit) rides
+        #    the plain one-token decode. Both dispatches keep config-only
+        #    shapes, so a mixed bank still never recompiles.
+        spec_now: List[int] = []
+        plain: List[int] = []
+        for slot in active:
+            if self.spec is not None and slot in self._spec_slots:
+                need = int(cache.seq_lens[slot]) + self.spec.k + 1
+                if (need <= self._spec_limit
+                        and cache.pages_for(need) <= cache.max_pages_per_seq
+                        and cache.ensure_capacity(slot, need) == "ok"):
+                    spec_now.append(slot)
+                    continue
+                # a slot that cannot host the verify window finishes its
+                # sequence NON-speculatively: one plain step would advance
+                # the target past the draft cache (length drift), so the
+                # draft row is abandoned rather than resynced
+                self._spec_slots.discard(slot)
+                self.spec.free(slot)
+            plain.append(slot)
+
+        # chaos hooks (docs/ROBUSTNESS.md): both fire BEFORE any dispatch
+        # so an injected crash never leaves a donated kv buffer half
+        # consumed inside a real XLA call; _step_speculative arms a
+        # second decode_step_error point between draft and verify (the
+        # mid-speculation state the chaos leg drives)
+        faults.maybe_fail("decode_step_error")
+        faults.maybe_sleep("slow_decode", 0.05)
+
+        produced = 0
+        if plain:
+            produced += self._step_decode(plain)
+        if spec_now:
+            produced += self._step_speculative(spec_now)
+        return produced
+
+    def _step_decode(self, active: List[int]) -> int:
+        """The plain one-token decode iteration over ``active`` (the
+        whole bank when speculation is off)."""
+        cache, sched = self.cache, self.scheduler
         s_n = cache.max_slots
         tokens = np.zeros((s_n,), np.int32)
         act = np.zeros((s_n,), np.int32)
@@ -797,11 +949,6 @@ class GenerativeEngine:
             top_p[slot] = st.request.top_p
         if self._decode_fn is None:
             self._decode_fn = self._build_decode()
-        # chaos hooks (docs/ROBUSTNESS.md): both fire BEFORE the dispatch
-        # so an injected crash never leaves the donated kv buffer half
-        # consumed inside a real XLA call
-        faults.maybe_fail("decode_step_error")
-        faults.maybe_sleep("slow_decode", 0.05)
         key = self._next_key()
         args = (jnp.asarray(cache.page_table), jnp.asarray(cache.seq_lens),
                 jnp.asarray(tokens), jnp.asarray(act))
@@ -830,6 +977,99 @@ class GenerativeEngine:
         observe.log_event("serving_decode", slots=len(active),
                           step_seconds=round(dt, 6))
         return len(active)
+
+    def _step_speculative(self, spec_now: List[int]) -> int:
+        """One speculative iteration for ``spec_now`` (docs/SERVING.md
+        § Speculative decoding): K draft proposals per slot (one compiled
+        scan), ONE target verify pass over the K+1-token window, then
+        greedy exact-match acceptance on the host — commit the agreed
+        draft prefix plus the target's correction/bonus token, REWIND the
+        cached lengths past it (rejected positions become garbage beyond
+        the length: never read, refcount-untouched, overwritten next
+        pass). Capacity for the full window was reserved by the caller.
+
+        Latency accounting is per COMMITTED token: a step that lands m
+        tokens contributes m observations of (step/m) to the decode and
+        inter-token histograms, so spec-on percentiles — and the SLO
+        frontend's rolling decode-p50 built on the decode histogram —
+        price a token, not a step, and stay comparable to spec-off.
+        """
+        spec, cache, sched = self.spec, self.cache, self.scheduler
+        s_n = cache.max_slots
+        pend = np.zeros((s_n,), np.int32)
+        act = np.zeros((s_n,), np.int32)
+        for slot in spec_now:
+            pend[slot] = sched.slots[slot].tokens[-1]
+            act[slot] = 1
+        t0 = time.perf_counter()
+        props = spec.propose(pend, act)          # (S, K) — draft phase
+        # second decode_step_error arm, MID-speculation: the draft KV was
+        # just donated-and-advanced but nothing committed — the exact
+        # state SpeculativeDecoder.reset() exists for; still outside any
+        # XLA call, so no buffer is ever half consumed (chaos-leg-driven)
+        faults.maybe_fail("decode_step_error")
+        vtokens = np.zeros((s_n, spec.k + 1), np.int32)
+        vtokens[:, 0] = pend
+        vtokens[:, 1:] = props
+        if self._verify_fn is None:
+            self._verify_fn = self._build_verify()
+        observe.note_jit_signature(
+            self._verify_fn, graph="serving", key="verify",
+            signature=observe.signature_of(
+                tokens=vtokens, seq_lens=cache.seq_lens,
+                page_table=cache.page_table, active=act))
+        with observe.tracer().span("serving_verify", category="serving",
+                                   slots=len(spec_now)):
+            cache.kv, greedy = self._verify_fn(
+                self.model.params, cache.kv, jnp.asarray(vtokens),
+                jnp.asarray(cache.seq_lens),
+                jnp.asarray(cache.page_table), jnp.asarray(act))
+            greedy = np.asarray(greedy)          # (S, K+1) target argmax
+        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        committed_total = 0
+        accepted_total = 0
+        for slot in spec_now:
+            st = sched.slots[slot]
+            # greedy exact-match acceptance: proposal i is accepted iff
+            # it equals the target's argmax after the previous token
+            j = 0
+            while j < spec.k and props[slot, j] == greedy[slot, j]:
+                j += 1
+            toks = [int(t) for t in props[slot, :j]]
+            toks.append(int(greedy[slot, j]))    # correction / bonus
+            # truncation: never exceed the request's remaining budget,
+            # and never commit past an eos (retire trims the eos itself)
+            rem = st.request.max_new_tokens - len(st.tokens)
+            toks = toks[:max(1, rem)]
+            eos = st.request.eos_token
+            for i, t in enumerate(toks):
+                if t == eos:
+                    toks = toks[:i + 1]
+                    break
+            m = len(toks)
+            # the rewind: t0 and the first m-1 commits are cached (their
+            # K/V was written at seq_lens..seq_lens+m-1); the LAST commit
+            # is the next step's feed, and positions seq_lens+m.. hold
+            # rejected garbage beyond the length
+            cache.seq_lens[slot] += m
+            spec.commit(slot, m)
+            from_draft = min(j, m)               # drafts that landed
+            spec.note_outcome(spec.k, j, from_draft)
+            gap = sched.on_spec_tokens(slot, toks, now, spec.k, from_draft)
+            per_tok = dt / m
+            for _ in range(m):
+                self._obs["decode_h"].observe(per_tok)
+                if gap is not None:
+                    self._obs["itl_h"].observe(gap)
+            committed_total += m
+            accepted_total += from_draft
+        self._obs["generated"].inc(committed_total)
+        observe.log_event(
+            "serving_spec", slots=len(spec_now), proposed=spec.k
+            * len(spec_now), accepted=accepted_total,
+            committed=committed_total, step_seconds=round(dt, 6))
+        return committed_total
 
     def _prefill_into(self, slot: int, req: GenerationRequest) -> int:
         """Run the (bucketed) prefill, scatter K/V into the slot's pages,

@@ -106,6 +106,12 @@ class ClassPolicy:
     class; ``deadline_s`` is the class default when the caller passes
     none. ``degradable`` classes get trimmed in the ``degraded`` state;
     ``reject_in_shedding`` classes are refused outright in ``shedding``.
+    ``disable_spec`` is the speculative-decoding degraded-mode knob
+    (docs/SERVING.md § Speculative decoding): in the ``shedding`` state
+    the class's requests decode NON-speculatively — the draft model's
+    compute goes back to the drowning target — recorded on the result as
+    ``GenerationResult.spec_disabled``, like the existing degraded
+    fields.
     ``shared_prefix`` (token ids) is this class's shared system prompt:
     at frontend construction it is run through the engine once and PINNED
     in the radix prefix cache (docs/SERVING.md § Radix prefix cache), so
@@ -122,6 +128,7 @@ class ClassPolicy:
     deadline_s: Optional[float] = None    # class-default deadline
     degradable: bool = True               # ladder may trim this class
     reject_in_shedding: bool = False      # refused outright in "shedding"
+    disable_spec: bool = False            # "shedding" turns speculation off
     shared_prefix: Optional[Sequence[int]] = None  # pre-warmed + pinned
     #                                     system-prompt token ids
 
@@ -433,6 +440,13 @@ class SLOFrontend:
                 max_new_tokens = min(max_new_tokens,
                                      self.degraded_max_new_tokens)
                 top_k, top_p = 0, 1.0
+            # 6b. speculative-decoding degraded-mode knob: in "shedding"
+            #     a disable_spec class decodes non-speculatively — the
+            #     draft model's compute goes back to the target (recorded
+            #     on the result like the degraded flag; the engine reads
+            #     it off the request at admission)
+            spec_disabled = (self.state == "shedding"
+                             and policy.disable_spec)
 
             # 7. predictive early shed: if the estimated TTFT plus the
             #    time to decode the (possibly trimmed) answer already
@@ -450,7 +464,8 @@ class SLOFrontend:
                     if est > deadline_s * self.shed_margin:
                         return self._deny(policy, "predicted_deadline",
                                           prompt_len=p_len,
-                                          degraded=degraded)
+                                          degraded=degraded,
+                                          spec_disabled=spec_disabled)
 
             # 8. build + validate the request NOW — an invalid submission
             #    must raise to its caller BEFORE it can burn a rate token
@@ -462,7 +477,8 @@ class SLOFrontend:
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_token=eos, deadline_s=deadline_s,
                 max_retries=max_retries, priority=policy.priority,
-                slo_class=policy.name, degraded=degraded)
+                slo_class=policy.name, degraded=degraded,
+                spec_disabled=spec_disabled)
             self.engine.validate_request(req)
 
             # 8b. per-class token bucket — after the cheap caps and the
@@ -472,7 +488,8 @@ class SLOFrontend:
             bucket = self._buckets.get(policy.name)
             if bucket is not None and not bucket.try_take(now):
                 return self._deny(policy, "rate_limit", prompt_len=p_len,
-                                  degraded=degraded)
+                                  degraded=degraded,
+                                  spec_disabled=spec_disabled)
 
             # 9. queue-depth bounds: per-class share first, then the total
             #    bound with shed-lowest-first — an important arrival
@@ -488,7 +505,8 @@ class SLOFrontend:
                     if bucket is not None:
                         bucket.refund()
                     return self._deny(policy, "queue_full", prompt_len=p_len,
-                                      degraded=degraded)
+                                      degraded=degraded,
+                                      spec_disabled=spec_disabled)
             if (self.max_queue_total is not None
                     and len(snapshot) >= self.max_queue_total):
                 victim = sched.steal_lowest_pending(policy.priority)
@@ -498,7 +516,8 @@ class SLOFrontend:
                     if bucket is not None:
                         bucket.refund()
                     return self._deny(policy, "queue_full", prompt_len=p_len,
-                                      degraded=degraded)
+                                      degraded=degraded,
+                                      spec_disabled=spec_disabled)
                 self._shed_victim(victim)
 
             # 10. hand to the engine. Its own max_queue gate may still
@@ -550,15 +569,17 @@ class SLOFrontend:
 
     # ----------------------------------------------------------------- denial
     def _terminal_result(self, reason: str, cls: str, prompt_len: int = 0,
-                         degraded: bool = False) -> GenerationResult:
+                         degraded: bool = False,
+                         spec_disabled: bool = False) -> GenerationResult:
         return GenerationResult(
             tokens=np.zeros((0,), np.int32), finish_reason=reason,
             prompt_len=prompt_len, ttft_s=None, intertoken_s=[],
-            slo_class=cls, degraded=degraded)
+            slo_class=cls, degraded=degraded, spec_disabled=spec_disabled)
 
     def _deny(self, policy: ClassPolicy, slo_reason: str,
               terminal: str = "shed", prompt_len: int = 0,
-              degraded: bool = False) -> "Future[GenerationResult]":
+              degraded: bool = False,
+              spec_disabled: bool = False) -> "Future[GenerationResult]":
         """Complete a denied admission terminally (never an exception:
         overload is an expected state, and callers always get an answer).
         Counts ONCE in the slo_shed family AND once in the shared
@@ -566,7 +587,7 @@ class SLOFrontend:
         fut: "Future[GenerationResult]" = Future()
         fut.set_result(self._terminal_result(
             terminal, policy.name, prompt_len=prompt_len,
-            degraded=degraded))
+            degraded=degraded, spec_disabled=spec_disabled))
         observe.metrics().counter(
             "dl4j_tpu_slo_shed_total",
             **{"class": policy.name, "reason": slo_reason}).inc()

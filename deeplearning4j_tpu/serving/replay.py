@@ -1,10 +1,11 @@
-"""Shared-prompt traffic replay — the prefix-cache acceptance harness.
+"""Serving traffic replay — the prefix-cache AND speculative-decoding
+acceptance harnesses.
 
-One harness, three consumers (``BENCH_MODEL=generate BENCH_PREFIX=1`` in
+:func:`run_prefix_replay` (``BENCH_MODEL=generate BENCH_PREFIX=1`` in
 bench.py, ``tools/prefix.py`` / the ``prefix`` gate stage, and the
-prefix tests): drive a fresh :class:`GenerativeEngine` with the traffic
+prefix tests) drives a fresh :class:`GenerativeEngine` with the traffic
 shape the radix prefix cache exists for — a handful of shared "system
-prompts" each followed by a short unique tail — and measure what the
+prompts" each followed by a short unique tail — and measures what the
 cache buys:
 
 * **TTFT** (submit -> first token): with the cache, admission prefills
@@ -27,10 +28,28 @@ prefill, decode) on both legs, so the timed window is compile-free.
 The default model is deliberately bigger than ``GptConfig.tiny`` (hidden
 256, 4 layers): the TTFT comparison must be dominated by prefill compute,
 not by per-call dispatch overhead, to be meaningful on a CPU host.
+
+:func:`run_spec_replay` is the speculative-decoding sibling
+(``BENCH_SPEC=1``, ``tools/spec.py`` / the ``spec`` gate stage,
+tests/test_speculative.py): the SAME greedy request plan run spec-on and
+spec-off, measuring decode tokens/sec. Like the slo gate it is a
+MECHANISM bench, not a kernel bench: both legs arm the deterministic
+50ms ``slow_decode`` floor (one fire per engine step, i.e. per TARGET
+forward), standing in for the big model's memory-bound step time, while
+the draft's real compute rides on top — so "K accepted tokens amortize
+one target step" is measured against a reproducible service-time model
+instead of host-scheduling jitter. The default draft is
+:func:`~deeplearning4j_tpu.serving.speculative.perturbed_draft` (the
+target's params plus seeded noise — a deterministic distillation
+stand-in with high-but-not-total greedy agreement, so both accepts and
+rejections are exercised); pass ``draft_model`` to measure a real one.
+Outputs must be bit-identical across the legs — losslessness is part of
+the contract, asserted by every consumer.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -136,3 +155,108 @@ def run_prefix_replay(*, prefix_on: bool, n_requests: int = 12,
         out["tree_pages"] = eng.prefix.tree_pages
         out["pinned_pages"] = eng.prefix.pinned_pages
     return out
+
+
+def _serving_first_compile_keys(before: int) -> List[str]:
+    """The serving-graph ``first_compile`` ledger keys recorded after
+    event index ``before`` — the gate's "exactly the expected compiled
+    functions" evidence."""
+    from deeplearning4j_tpu import observe
+
+    evs = observe.ledger().events()
+    return sorted(e.key for e in evs[before:]
+                  if e.graph == "serving" and e.cause == "first_compile")
+
+
+def run_spec_replay(*, spec_on: bool, n_requests: int = 6,
+                    prompt_len: int = 10, gen_tokens: int = 12,
+                    spec_k: int = 4, max_slots: int = 2, seed: int = 0,
+                    vocab: int = 512, page_size: int = 8,
+                    max_prompt: int = 16, draft_model=None,
+                    draft_noise: float = 1e-2, slow_decode: bool = True,
+                    warm_rounds: int = 2, model=None) -> Dict[str, Any]:
+    """One speculative-decoding replay leg on a fresh engine (module
+    docstring has the measurement model). Identical ``seed`` on both
+    legs yields an identical greedy request plan, so outputs are
+    comparable token-for-token. Returns decode tokens/sec over the timed
+    window, per-request outputs, proposal/acceptance accounting, the
+    serving ``new_shape`` delta, and the leg's ``first_compile`` key
+    set."""
+    from deeplearning4j_tpu import faults, observe
+    from deeplearning4j_tpu.models.gpt import GptConfig, GptModel
+    from deeplearning4j_tpu.serving import GenerativeEngine
+    from deeplearning4j_tpu.serving.speculative import perturbed_draft
+
+    if model is None:
+        cfg = GptConfig.tiny(vocab_size=vocab,
+                             max_position=4 * max_prompt)
+        model = GptModel(cfg, seed=0)
+    cfg = model.cfg
+    if spec_on and draft_model is None:
+        draft_model = perturbed_draft(model, scale=draft_noise, seed=1)
+    pages_per_seq = -(-(max_prompt + gen_tokens + spec_k + 1)
+                      // page_size) + 1
+    eng = GenerativeEngine(
+        model, max_slots=max_slots, page_size=page_size,
+        max_pages_per_seq=pages_per_seq, max_prompt=max_prompt, seed=0,
+        spec_k=spec_k if spec_on else 0,
+        draft_model=draft_model if spec_on else None)
+    led_before = len(observe.ledger().events())
+    new_shape_before = _serving_new_shape_count()
+
+    r = np.random.RandomState(seed)
+    plan = [r.randint(1, cfg.vocab_size, size=prompt_len).astype(np.int32)
+            for _ in range(n_requests)]
+
+    def run_one(prompt):
+        fut = eng.submit(prompt, max_new_tokens=gen_tokens, eos_token=-1)
+        while eng.scheduler.has_work():
+            eng.step()
+        return fut.result(timeout=0)
+
+    # warm: compile every path on this leg (prefill + decode or
+    # prefill + draft_prefill + draft_decode + verify) OUTSIDE the timed
+    # window, floor unarmed — the window below measures steps, not XLA
+    for round_ in range(warm_rounds):
+        run_one(r.randint(1, cfg.vocab_size,
+                          size=prompt_len).astype(np.int32))
+
+    if slow_decode:
+        # the deterministic per-target-step service floor (one fire per
+        # engine step — docs/SERVING.md § Speculative decoding)
+        faults.arm("slow_decode", prob=1.0, seed=0)
+    try:
+        t0 = time.perf_counter()
+        results = [run_one(p) for p in plan]
+        wall = time.perf_counter() - t0
+    finally:
+        if slow_decode:
+            faults.disarm("slow_decode")
+
+    eng.check_invariants()
+    n_tokens = sum(len(res.tokens) for res in results)
+    proposed = sum(res.spec_proposed_tokens for res in results)
+    accepted = sum(res.spec_accepted_tokens for res in results)
+    reasons: Dict[str, int] = {}
+    for res in results:
+        reasons[res.finish_reason] = reasons.get(res.finish_reason, 0) + 1
+    return {
+        "spec_on": spec_on,
+        "spec_k": spec_k if spec_on else 0,
+        "requests": n_requests,
+        "outputs": [res.tokens.tolist() for res in results],
+        "prompts": [p.tolist() for p in plan],
+        "reasons": dict(sorted(reasons.items())),
+        "all_terminal": all(res.finish_reason in ("eos", "length")
+                            for res in results),
+        "generated_tokens": int(n_tokens),
+        "tokens_per_sec": round(n_tokens / wall, 3) if wall else None,
+        "wall_s": round(wall, 3),
+        "proposed_tokens": int(proposed),
+        "accepted_tokens": int(accepted),
+        "acceptance_rate": round(accepted / proposed, 4) if proposed
+        else None,
+        "new_shape_events": max(
+            0, _serving_new_shape_count() - new_shape_before),
+        "first_compile_keys": _serving_first_compile_keys(led_before),
+    }

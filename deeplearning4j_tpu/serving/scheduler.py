@@ -87,6 +87,12 @@ class GenerationRequest:
     priority: int = 1                # 0 = most important
     slo_class: str = "standard"      # frontend class name (label value)
     degraded: bool = False           # ladder trimmed max_new_tokens/extras
+    # ``spec_disabled``: the frontend's ``ClassPolicy.disable_spec``
+    # degraded-mode knob turned speculative decoding off for this request
+    # (shedding state frees the draft model's compute for the target);
+    # the engine then decodes it non-speculatively even when spec is on.
+    # Rides into the GenerationResult like ``degraded``.
+    spec_disabled: bool = False
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -125,6 +131,13 @@ class GenerationResult:
     degraded: bool = False           # True: the ladder trimmed this answer
     prefix_hit_tokens: int = 0       # prompt tokens served from the radix
     #                                  prefix cache (0 = full prefill)
+    # speculative-decoding accounting (docs/SERVING.md § Speculative
+    # decoding): draft tokens proposed / committed for THIS request, and
+    # whether the frontend's degraded-mode knob disabled speculation for
+    # it. Zero/False on non-speculative requests.
+    spec_proposed_tokens: int = 0
+    spec_accepted_tokens: int = 0
+    spec_disabled: bool = False
 
 
 @dataclasses.dataclass
@@ -138,6 +151,8 @@ class _Slot:
     intertoken_s: List[float] = dataclasses.field(default_factory=list)
     last_token_t: Optional[float] = None
     prefix_hit_tokens: int = 0
+    spec_proposed_tokens: int = 0
+    spec_accepted_tokens: int = 0
 
 
 class SlotScheduler:
@@ -247,6 +262,30 @@ class SlotScheduler:
             st.intertoken_s.append(now - st.last_token_t)
         st.last_token_t = now
 
+    def on_spec_tokens(self, slot: int, tokens: List[int], now: float,
+                       proposed: int, accepted: int) -> Optional[float]:
+        """Commit a verify pass's tokens for ``slot`` — possibly several
+        per engine step. Inter-token latency is accounted PER COMMITTED
+        TOKEN (the step gap divided by the tokens it committed), not per
+        step: a speculative step that lands 4 tokens in 50ms must read as
+        12.5ms/token, or spec-on percentiles (and the SLO frontend's
+        rolling decode estimate built on them) would overstate per-token
+        latency by the acceptance factor. Returns the per-token gap (None
+        on the first tokens after admission) so the engine can mirror the
+        same value into the process histograms."""
+        st = self.slots[slot]
+        m = max(1, len(tokens))
+        gap = (None if st.last_token_t is None
+               else (now - st.last_token_t) / m)
+        for t in tokens:
+            st.tokens.append(int(t))
+            if gap is not None:
+                st.intertoken_s.append(gap)
+        st.last_token_t = now
+        st.spec_proposed_tokens += int(proposed)
+        st.spec_accepted_tokens += int(accepted)
+        return gap
+
     def should_finish(self, slot: int) -> Optional[str]:
         """``"eos"``/``"length"`` when the slot's sequence is complete."""
         st = self.slots[slot]
@@ -270,7 +309,10 @@ class SlotScheduler:
             prompt_len=st.prompt_len, ttft_s=st.ttft_s,
             intertoken_s=list(st.intertoken_s),
             slo_class=st.request.slo_class, degraded=st.request.degraded,
-            prefix_hit_tokens=st.prefix_hit_tokens)
+            prefix_hit_tokens=st.prefix_hit_tokens,
+            spec_proposed_tokens=st.spec_proposed_tokens,
+            spec_accepted_tokens=st.spec_accepted_tokens,
+            spec_disabled=st.request.spec_disabled)
         if not st.future.done():
             st.future.set_result(result)
         return result

@@ -21,7 +21,12 @@ supervised-degradation contract instead of trusting it:
   * ``page_oom`` routed through the PREFIX admission path (shared pages
     already mapped when the injected pool pressure fires) leaves every
     request terminal and the refcounted allocator + radix tree invariants
-    intact (docs/SERVING.md § Radix prefix cache).
+    intact (docs/SERVING.md § Radix prefix cache);
+  * ``decode_step_error`` fired inside the SPECULATIVE verify step
+    leaves every request terminal with greedy outputs still equal to the
+    non-speculative oracle (supervised retries restart from the prompt —
+    lossless), draft/target lengths in agreement, and zero ``new_shape``
+    (docs/SERVING.md § Speculative decoding).
 
 Contract (same as lint/check/obs/tune): ONE JSON summary line on stdout
 with ``"tool": "chaos"``; exit 0 iff ``ok``. ``make chaos-smoke`` pins
@@ -239,6 +244,117 @@ def run_prefix_chaos():
     }
 
 
+def run_spec_chaos():
+    """The speculative-decoding leg (docs/SERVING.md § Speculative
+    decoding): greedy traffic on a spec-enabled engine with
+    ``decode_step_error`` firing INSIDE the verify step. The contract:
+    the supervisor's retries keep every request terminal AND lossless
+    (token-for-token equal to an undisturbed spec-off engine), the
+    draft/target length invariant holds after recovery, and zero
+    ``new_shape`` ledger events were paid — the compiled draft/verify
+    functions survive the restart like the target's."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import faults, observe
+    from deeplearning4j_tpu.models.gpt import GptConfig, GptModel, gpt_prefill
+    from deeplearning4j_tpu.serving import GenerativeEngine
+    from deeplearning4j_tpu.serving.scheduler import FINISH_REASONS
+    from deeplearning4j_tpu.serving.speculative import perturbed_draft
+
+    cfg = GptConfig.tiny(vocab_size=256)
+    model = GptModel(cfg, seed=0)
+    # a PARTIALLY-agreeing draft: tiny random GPTs fall into constant
+    # attractors, so even an unrelated-seed model greedily agrees with
+    # the target almost everywhere — heavy perturbation (scale tuned
+    # empirically, disagreement self-checked below) is what actually
+    # makes rejections interleave with the injected crashes
+    draft = perturbed_draft(model, scale=0.1, seed=1)
+    r = np.random.RandomState(11)
+    prompts = [r.randint(1, cfg.vocab_size, size=r.randint(2, 10))
+               .astype(np.int32) for _ in range(6)]
+
+    def build(spec):
+        return GenerativeEngine(
+            model, max_slots=2, page_size=8, max_pages_per_seq=6,
+            max_prompt=16, seed=0, max_restarts=8, restart_backoff_s=0.01,
+            spec_k=3 if spec else 0, draft_model=draft if spec else None)
+
+    # the undisturbed spec-off oracle outputs
+    ref = build(spec=False)
+    want = [res.tokens.tolist() for res in ref.generate(
+        prompts, max_new_tokens=6, eos_token=-1, max_retries=0)]
+
+    # self-check the draft actually DISAGREES along these trajectories —
+    # an accept-all draft would render the rejection×crash interaction
+    # this leg exists for untested (and the leg not-ok)
+    disagreements = 0
+    for p, w in zip(prompts, want):
+        if not w:
+            continue
+        seq = np.concatenate([p, np.asarray(w, np.int32)])
+        logits, _ = gpt_prefill(draft.params,
+                                jnp.asarray(seq[None], jnp.int32), cfg)
+        pred = np.asarray(jnp.argmax(logits[0], -1))
+        disagreements += int((pred[len(p) - 1:-1] != seq[len(p):]).sum())
+
+    eng = build(spec=True)
+    eng.generate([prompts[0][:2]], max_new_tokens=2, eos_token=-1)  # warm
+    new_shape_before = sum(
+        1 for e in observe.ledger().events()
+        if e.graph == "serving" and e.cause == "new_shape")
+    m = observe.metrics()
+    err_before = int(m.counter("dl4j_tpu_faults_injected_total",
+                               point="decode_step_error").value)
+    faults.arm("decode_step_error", prob=0.6, seed=13, max_fires=4)
+    eng.start()
+    try:
+        futs = [eng.submit(p, max_new_tokens=6, eos_token=-1,
+                           max_retries=6) for p in prompts]
+        results = [f.result(timeout=600) for f in futs]
+    finally:
+        eng.stop()
+        faults.reset()
+    eng.check_invariants()  # allocator + draft/target agreement
+    err_fired = int(m.counter("dl4j_tpu_faults_injected_total",
+                              point="decode_step_error").value) - err_before
+    reasons: dict = {}
+    for res in results:
+        reasons[res.finish_reason] = reasons.get(res.finish_reason, 0) + 1
+    unresolved = sum(1 for f in futs if not f.done())
+    bad = [k for k in reasons if k not in FINISH_REASONS]
+    completed = sum(1 for res in results
+                    if res.finish_reason in ("eos", "length"))
+    # vacuous-truth guard: "lossless" over zero completions proves
+    # nothing — the leg must show at least one request that actually
+    # FINISHED through the crashes, with accepted draft tokens
+    lossless = completed > 0 and all(
+        res.tokens.tolist() == w
+        for res, w in zip(results, want)
+        if res.finish_reason in ("eos", "length"))
+    accepted = sum(res.spec_accepted_tokens for res in results)
+    new_shape = sum(
+        1 for e in observe.ledger().events()
+        if e.graph == "serving"
+        and e.cause == "new_shape") - new_shape_before
+    return {
+        "submitted": len(futs),
+        "completed": completed,
+        "reasons": reasons,
+        "unresolved": unresolved,
+        "bad_reasons": bad,
+        "restarts": eng.restarts,
+        "errors_fired_in_verify": err_fired,
+        "lossless": lossless,
+        "spec_accepted_tokens": int(accepted),
+        "draft_disagreements": int(disagreements),
+        "new_shape_events": max(0, new_shape),
+        "invariants_ok": True,  # check_invariants above would have raised
+        "ok": (unresolved == 0 and not bad and lossless
+               and accepted > 0 and disagreements > 0
+               and err_fired > 0 and new_shape <= 0),
+    }
+
+
 def run_checkpoint_chaos():
     """The durability leg: three saves, the newest torn; restore must fall
     back to the last intact checkpoint with the right parameters."""
@@ -280,6 +396,7 @@ def main() -> int:
     ckpt = run_checkpoint_chaos()
     frontend = run_frontend_chaos()
     prefix = run_prefix_chaos()
+    spec = run_spec_chaos()
     m = observe.metrics()
     faults_total = int(m.family_total("dl4j_tpu_faults_injected_total"))
     by_point = {}
@@ -304,6 +421,7 @@ def main() -> int:
           and frontend["all_terminal"]
           and frontend["new_shape_events"] == 0
           and prefix["ok"]
+          and spec["ok"]
           and faults_total > 0
           and not missing)
 
@@ -316,6 +434,7 @@ def main() -> int:
         "checkpoint": ckpt,
         "frontend": frontend,
         "prefix": prefix,
+        "spec": spec,
         "elapsed_s": round(time.perf_counter() - t0, 2),
     }
     print(json.dumps(rec), flush=True)

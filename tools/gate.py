@@ -38,6 +38,10 @@ Stages:
      tokens > 0, TTFT p50 >= 30% better than cache-off, greedy outputs
      bit-identical both legs, zero new_shape events
      (docs/SERVING.md § Radix prefix cache)
+ 12. spec smoke: tools/spec.py speculative-decoding replay — accepted
+     draft tokens > 0, tokens/sec >= spec-off, greedy outputs
+     bit-identical both legs, exactly the expected first_compile events
+     and zero new_shape (docs/SERVING.md § Speculative decoding)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -390,6 +394,46 @@ def prefix_stage() -> bool:
     return bool(ok)
 
 
+def spec_stage() -> bool:
+    """Speculative-decoding smoke (docs/SERVING.md § Speculative
+    decoding): the greedy replay must report ok — accepted draft tokens
+    > 0, tokens/sec >= spec-off (median of paired trials), greedy
+    outputs bit-identical on both legs, exactly the expected
+    first_compile ledger events, zero new_shape. One JSON line, like
+    lint/check/obs/chaos/slo/prefix."""
+    print("== gate: spec-smoke (speculative replay, spec on/off) ==",
+          flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_FAULTS", None)  # an ambient schedule would distort
+    try:                              # the paired throughput comparison
+        proc = subprocess.run(
+            [sys.executable, "tools/spec.py", "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (spec-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (spec-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    ok = (bool(rec.get("ok"))
+          and (rec.get("accepted_tokens") or 0) > 0
+          and rec.get("outputs_identical")
+          and rec.get("new_shape_events") == 0
+          and rec.get("first_compiles_ok"))
+    print(f"   {'ok' if ok else 'FAIL'} (spec-smoke: "
+          f"{rec.get('tokens_per_sec_on')}/{rec.get('tokens_per_sec_off')} "
+          f"tok/s on/off (x{rec.get('speedup')}), "
+          f"{rec.get('accepted_tokens')}/{rec.get('proposed_tokens')} "
+          f"accepted, identical={rec.get('outputs_identical')})")
+    return bool(ok)
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -463,6 +507,7 @@ def main() -> int:
         results["chaos"] = chaos_stage()
         results["slo"] = slo_stage()
         results["prefix"] = prefix_stage()
+        results["spec"] = spec_stage()
         results["multichip"] = multichip_stage()
 
     failed = [k for k, v in results.items() if not v]

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Speculative-decoding smoke — greedy replay, spec on vs off.
+
+The ROADMAP 2(b) gate stage (docs/SERVING.md § Speculative decoding):
+run the replay harness (``serving/replay.py``) twice — once with
+draft-propose/target-verify speculation, once with the plain one-token
+decode loop, IDENTICAL greedy request plan, both under the deterministic
+50ms ``slow_decode`` target-step floor — and assert speculation earns
+its place instead of trusting it:
+
+  * **accepted draft tokens > 0** (a replay that never accepted proved
+    nothing — and would have LOST throughput to draft overhead);
+  * **tokens/sec >= spec-off** (median of paired trials — host-load
+    spikes hit single trials);
+  * greedy outputs **bit-identical** on both legs — acceptance, the
+    correction token, and rollback must reproduce non-speculative greedy
+    decoding token-for-token (the lossless property);
+  * EXACTLY the expected ``first_compile`` ledger events on each leg
+    (on: prefill + draft_prefill + draft_decode + verify; off: prefill +
+    decode) and ZERO ``new_shape`` events — speculation rides two extra
+    compiled functions, it never recompiles across admits/evicts/
+    rejections;
+  * allocator + draft/target length invariants hold after every leg
+    (checked inside the harness) and every request retires complete.
+
+Contract (same as lint/check/obs/tune/chaos/slo/prefix): ONE JSON
+summary line on stdout with ``"tool": "spec"``; exit 0 iff ``ok``.
+``make spec-smoke`` pins JAX_PLATFORMS=cpu; ``tools/gate.py``'s ``spec``
+stage parses the line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: the ledger contract per leg — any drift (a surprise recompile, a
+#: silently-dead path) fails the stage
+EXPECTED_ON = ["draft_decode", "draft_prefill", "prefill", "verify"]
+EXPECTED_OFF = ["decode", "prefill"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable: exactly one JSON line on stdout")
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--tokens", type=int, default=12)
+    ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--trials", type=int, default=3,
+                    help="paired on/off trials; MEDIAN tokens/sec are "
+                         "compared (host-load spikes hit single trials)")
+    args = ap.parse_args()
+
+    from deeplearning4j_tpu.serving.replay import run_spec_replay
+
+    t0 = time.perf_counter()
+    ons, offs = [], []
+    for trial in range(max(1, args.trials)):
+        ons.append(run_spec_replay(
+            spec_on=True, n_requests=args.requests,
+            gen_tokens=args.tokens, spec_k=args.spec_k, seed=trial))
+        offs.append(run_spec_replay(
+            spec_on=False, n_requests=args.requests,
+            gen_tokens=args.tokens, spec_k=args.spec_k, seed=trial))
+
+    tps_on = statistics.median(r["tokens_per_sec"] for r in ons)
+    tps_off = statistics.median(r["tokens_per_sec"] for r in offs)
+    speedup = tps_on / tps_off if tps_off else 0.0
+    accepted = sum(r["accepted_tokens"] for r in ons)
+    proposed = sum(r["proposed_tokens"] for r in ons)
+    identical = all(a["outputs"] == b["outputs"]
+                    for a, b in zip(ons, offs))
+    all_terminal = all(r["all_terminal"] for r in ons + offs)
+    new_shape = sum(r["new_shape_events"] for r in ons + offs)
+    compiles_ok = (all(r["first_compile_keys"] == EXPECTED_ON for r in ons)
+                   and all(r["first_compile_keys"] == EXPECTED_OFF
+                           for r in offs))
+
+    ok = (accepted > 0
+          and identical
+          and all_terminal
+          and speedup >= 1.0
+          and new_shape == 0
+          and compiles_ok)
+
+    on = ons[-1]  # full detail from the last pair
+    rec = {
+        "tool": "spec", "ok": ok,
+        "tokens_per_sec_on": tps_on, "tokens_per_sec_off": tps_off,
+        "speedup": round(speedup, 3),
+        "spec_k": args.spec_k,
+        "accepted_tokens": accepted,
+        "proposed_tokens": proposed,
+        "acceptance_rate": round(accepted / proposed, 4) if proposed
+        else None,
+        "requests_per_leg": args.requests,
+        "trials": len(ons),
+        "tps_on_trials": [r["tokens_per_sec"] for r in ons],
+        "tps_off_trials": [r["tokens_per_sec"] for r in offs],
+        "outputs_identical": identical,
+        "all_terminal": all_terminal,
+        "new_shape_events": new_shape,
+        "first_compiles_ok": compiles_ok,
+        "first_compile_keys_on": on["first_compile_keys"],
+        "reasons_on": on["reasons"], "reasons_off": offs[-1]["reasons"],
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }
+    print(json.dumps(rec), flush=True)
+    if not args.json:
+        print(f"spec: {'OK' if ok else 'FAIL'} — {tps_on}/{tps_off} tok/s "
+              f"on/off (x{rec['speedup']}), {accepted}/{proposed} draft "
+              f"tokens accepted, identical={identical}, "
+              f"new_shape={new_shape}, compiles_ok={compiles_ok}",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
