@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke slo-smoke prefix-smoke spec-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke slo-smoke prefix-smoke spec-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -54,6 +54,16 @@ tune:
 # ONE JSON line like lint/check/obs.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos.py --json
+
+# preemption-proof-training smoke (docs/ROBUSTNESS.md § Preemption-proof
+# training): a supervised MLN fit under torn checkpoint writes, an
+# async-writer death, and hard preemption kills — fails unless the
+# resumed loss/param trajectory is BIT-EXACT vs the uninterrupted
+# oracle with zero new_shape recompiles, >=1 intact checkpoint, and
+# every-step ASYNC checkpointing costs < 10% of the synchronous-save
+# baseline per step. ONE JSON line like lint/check/obs/chaos.
+train-chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/chaos.py --json --leg training
 
 # SLO smoke (docs/SERVING.md § SLO admission frontend): the goodput-
 # under-overload ramp, frontend on vs off with an identical offered

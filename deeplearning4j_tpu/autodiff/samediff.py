@@ -757,6 +757,10 @@ class SameDiff:
         self.training_config: Optional[TrainingConfig] = None
         self._updater_state: Optional[Dict[str, Any]] = None
         self._step = 0
+        # exact-resume bookkeeping (docs/ROBUSTNESS.md): epochs completed
+        # across fit() calls + completed batches in the current epoch
+        self.epoch_count = 0
+        self.batch_in_epoch = 0
         self._jit_cache: Dict[Any, Any] = {}
         self._grad_requested = False
         # graph IO signature, populated by the import layer (imports/ir.py)
@@ -1164,6 +1168,39 @@ class SameDiff:
     def set_training_config(self, tc: TrainingConfig) -> None:
         self.training_config = tc
 
+    def training_state(self) -> Dict[str, Any]:
+        """Full training state for exact resume — the checkpointer's state
+        protocol (parallel/checkpoint.py): trainable VARIABLE arrays,
+        updater slots, step/epoch position and the data cursor. Initializes
+        the updater state if fit has not run yet, so a restore BEFORE the
+        first fit still finds a matching pytree."""
+        tc = self.training_config
+        trainable = [n for n, v in self._vars.items()
+                     if v.vtype == "VARIABLE"]
+        if self._updater_state is None and tc is not None:
+            self._updater_state = {
+                n: tc.updater.init_state(self._arrays[n]) for n in trainable}
+        return {
+            "params": {n: self._arrays[n] for n in trainable},
+            "opt_state": self._updater_state
+            if self._updater_state is not None else {},
+            "iteration": np.asarray(self._step),
+            "epoch": np.asarray(self.epoch_count),
+            "data_cursor": np.asarray(self.batch_in_epoch),
+        }
+
+    def apply_training_state(self, state: Dict[str, Any]) -> None:
+        """Inverse of :meth:`training_state`. Same-shape assignment — the
+        cached jitted train step survives (zero ``new_shape``)."""
+        for n, a in state["params"].items():
+            self._arrays[n] = jnp.asarray(a)
+        opt = state.get("opt_state") or {}
+        if opt:
+            self._updater_state = jax.tree.map(jnp.asarray, opt)
+        self._step = int(state["iteration"])
+        self.epoch_count = int(state["epoch"])
+        self.batch_in_epoch = int(state.get("data_cursor", 0))
+
     def _train_step_fn(self, loss_name: str):
         tc = self.training_config
         upd = tc.updater
@@ -1237,12 +1274,28 @@ class SameDiff:
                              model="samediff")
         _step_h = _m.histogram("dl4j_tpu_train_step_seconds",
                                model="samediff")
+        from deeplearning4j_tpu import faults
+        from deeplearning4j_tpu.nn.listeners import (
+            notify_fit_done, notify_preemption)
+
         history = []
         listeners = getattr(self, "_listeners", [])
         for ep in range(epochs):
             losses = []
             t_prev = time.perf_counter()
-            for ds in iterator:
+            # nonzero only when resuming mid-epoch from a checkpoint: the
+            # first `skip` batches were already consumed by the killed run
+            skip = self.batch_in_epoch
+            for bi, ds in enumerate(iterator):
+                if bi < skip:
+                    continue
+                # preemption (docs/ROBUSTNESS.md): injected fault = HARD
+                # kill (supervisor restores+resumes); flag = SOFT SIGTERM
+                # path (final snapshot, clean exit)
+                faults.maybe_fail("preemption")
+                if faults.preemption_requested():
+                    notify_preemption(self, listeners)
+                    return history
                 feeds = {}
                 feats = ds.features if isinstance(ds.features, (list, tuple)) else [ds.features]
                 labs = ds.labels if isinstance(ds.labels, (list, tuple)) else [ds.labels]
@@ -1262,6 +1315,7 @@ class SameDiff:
                     jnp.asarray(self._step, jnp.int32), other, feeds)
                 self._arrays.update(new_vars)
                 self._step += 1
+                self.batch_in_epoch = bi + 1  # cursor BEFORE listeners save
                 losses.append(loss)
                 # inter-step latency (includes compile on the first step);
                 # counters/histograms are host-side — never under the trace
@@ -1273,12 +1327,25 @@ class SameDiff:
                 _xfer_c.inc(len(feeds))
                 for lst in listeners:
                     lst.iteration_done(self, self._step, ep, loss)
-            ep_loss = float(jnp.mean(jnp.stack([jnp.asarray(l) for l in losses])))
-            history.append(ep_loss)
-            # epoch is 1-based after completion, matching the network
-            # classes' post-increment epoch_count in the same JSONL schema
-            observe.log_event("train_epoch", model="samediff", epoch=ep + 1,
-                              steps=len(losses), mean_loss=ep_loss)
+            self.batch_in_epoch = 0
+            self.epoch_count += 1
+            # log the GLOBAL post-increment epoch_count (matching MLN/CG):
+            # a resumed fit's local `ep` restarts at 0 and would duplicate
+            # the epoch numbers the killed run already emitted
+            if losses:
+                ep_loss = float(jnp.mean(jnp.stack(
+                    [jnp.asarray(l) for l in losses])))
+                history.append(ep_loss)
+                observe.log_event("train_epoch", model="samediff",
+                                  epoch=self.epoch_count,
+                                  steps=len(losses), mean_loss=ep_loss)
+            else:
+                # a resumed epoch whose batches were all consumed before
+                # the kill: nothing trained HERE — no NaN in history, no
+                # NaN (spec-invalid JSON) in the event log
+                observe.log_event("train_epoch", model="samediff",
+                                  epoch=self.epoch_count, steps=0)
+        notify_fit_done(self, listeners)
         return history
 
     # ---------------------------------------------------------- control flow

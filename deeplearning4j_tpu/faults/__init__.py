@@ -17,16 +17,20 @@ from deeplearning4j_tpu.faults.injection import (
     InjectedFault,
     active,
     arm,
+    clear_preemption,
     disarm,
     fire_counts,
     maybe_fail,
     maybe_sleep,
+    preemption_requested,
+    request_preemption,
     reset,
     should_fire,
 )
 
 __all__ = [
     "FAULT_POINTS", "FAULTS_ENV", "FaultSpec", "InjectedFault",
-    "active", "arm", "disarm", "fire_counts", "maybe_fail", "maybe_sleep",
-    "reset", "should_fire",
+    "active", "arm", "clear_preemption", "disarm", "fire_counts",
+    "maybe_fail", "maybe_sleep", "preemption_requested",
+    "request_preemption", "reset", "should_fire",
 ]

@@ -61,6 +61,10 @@ FAULTS_ENV = "DL4J_TPU_FAULTS"
 #:   backend_init_fail     parallel/mesh.py  ParallelInference -> raise
 #:   burst_arrival         serving/frontend.py SLOFrontend.submit
 #:                                            -> inject synthetic arrivals
+#:   preemption            nn fit loops (MLN/CG/SameDiff), per step -> raise
+#:                         (a hard TPU-pod preemption: no snapshot chance);
+#:                         worker_death ALSO fires inside the async
+#:                         checkpoint writer thread (parallel/checkpoint.py)
 FAULT_POINTS = (
     "page_oom",
     "decode_step_error",
@@ -69,6 +73,7 @@ FAULT_POINTS = (
     "checkpoint_torn_write",
     "backend_init_fail",
     "burst_arrival",
+    "preemption",
 )
 
 
@@ -177,12 +182,14 @@ def disarm(point: str) -> None:
 
 def reset() -> None:
     """Disarm every programmatic point and drop the env-parse cache (so a
-    changed ``DL4J_TPU_FAULTS`` re-parses with fresh call counters)."""
+    changed ``DL4J_TPU_FAULTS`` re-parses with fresh call counters). Also
+    clears a pending graceful-preemption request."""
     global _ANY_ARMED, _ENV_CACHE
     with _LOCK:
         _ARMED.clear()
         _ANY_ARMED = False
         _ENV_CACHE = ("", ())
+    _PREEMPTION.clear()
 
 
 def active() -> bool:
@@ -235,3 +242,40 @@ def maybe_sleep(point: str, seconds: float) -> None:
     """Inject latency when the schedule fires (e.g. ``slow_decode``)."""
     if should_fire(point):
         time.sleep(seconds)
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption (docs/ROBUSTNESS.md § Preemption-proof training)
+# ---------------------------------------------------------------------------
+# Distinct from the ``preemption`` FAULT point above: the fault is a HARD
+# kill (raise mid-fit, no snapshot chance); this flag is the SOFT path a
+# SIGTERM handler sets so the fit loops can take one final synchronous
+# snapshot and exit cleanly before the scheduler's grace period expires.
+# It lives here (not in parallel/) because the nn fit loops poll it every
+# step and faults/ is the one layer they can all import without cycles.
+
+_PREEMPTION = threading.Event()
+
+
+def request_preemption() -> None:
+    """Ask every running fit loop to snapshot and exit cleanly at its next
+    step boundary (the SIGTERM handler's one job). Idempotent.
+
+    ASYNC-SIGNAL-SAFE by design: one Event.set(), nothing else. The
+    handler may interrupt the main thread while it holds the JSONL log
+    lock or a logging-module lock — any log/metric call here could
+    deadlock the very grace period this flag exists to use. The polling
+    site (``nn/listeners.notify_preemption``) does the logging."""
+    _PREEMPTION.set()
+
+
+def preemption_requested() -> bool:
+    """Polled by the fit loops once per step (an Event read — safe in any
+    training loop)."""
+    return _PREEMPTION.is_set()
+
+
+def clear_preemption() -> None:
+    """Drop a pending graceful-preemption request (after the supervisor
+    has handled it, or in test teardown)."""
+    _PREEMPTION.clear()

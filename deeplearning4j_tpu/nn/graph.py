@@ -26,12 +26,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu import observe
+from deeplearning4j_tpu import faults, observe
 
 from deeplearning4j_tpu.nn import conf as C
 from deeplearning4j_tpu.nn.layers import Layer, build_layer, apply_preprocessor
 from deeplearning4j_tpu.nn.updater import Updater, get_updater
-from deeplearning4j_tpu.nn.listeners import TrainingListener
+from deeplearning4j_tpu.nn.listeners import (
+    TrainingListener, notify_fit_done, notify_preemption)
 from deeplearning4j_tpu.nn.multilayer import (
     _map_weights, _tree_l1_weights, _tree_l2_sq_weights, _sorted_leaves,
     _unflatten_like, apply_layer_updates, aux_losses, reg_penalty,
@@ -516,6 +517,9 @@ class ComputationGraph:
         self.opt_state: Optional[Dict[str, Any]] = None
         self.iteration_count = 0
         self.epoch_count = 0
+        # completed batches in the CURRENT epoch — the data cursor exact
+        # resume replays from (checkpointed; docs/ROBUSTNESS.md)
+        self.batch_in_epoch = 0
         self.listeners: List[TrainingListener] = []
         self.last_batch_size = 0
         self._key = jax.random.key(conf.seed)
@@ -890,14 +894,39 @@ class ComputationGraph:
             for _ in range(epochs):
                 for lst in self.listeners:
                     lst.on_epoch_start(self)
-                for ds in data:
+                skip = self.batch_in_epoch
+                for bi, ds in enumerate(data):
+                    if bi < skip:
+                        continue
+                    faults.maybe_fail("preemption")
+                    if faults.preemption_requested():
+                        notify_preemption(self, self.listeners)
+                        return
                     self.last_batch_size = ds.num_examples()
-                    self.fit_tbptt(ds.features, ds.labels,
-                                   masks=ds.features_mask,
-                                   lmasks=ds.labels_mask)
+                    # checkpoint saves must not land mid-batch: a segment
+                    # snapshot (params mid-batch, stale data cursor, live
+                    # RNN carry the payload does not include) could never
+                    # resume exactly. Listeners that declare
+                    # ``defers_mid_tbptt`` skip themselves per segment and
+                    # get ONE batch-boundary call after the cursor update;
+                    # score/perf listeners keep their per-segment firing.
+                    self._tbptt_mid_batch = True
+                    try:
+                        loss = self.fit_tbptt(ds.features, ds.labels,
+                                              masks=ds.features_mask,
+                                              lmasks=ds.labels_mask)
+                    finally:
+                        self._tbptt_mid_batch = False
+                    self.batch_in_epoch = bi + 1
+                    for lst in self.listeners:
+                        if getattr(lst, "defers_mid_tbptt", False):
+                            lst.iteration_done(self, self.iteration_count,
+                                               self.epoch_count, loss)
+                self.batch_in_epoch = 0
                 self.epoch_count += 1
                 for lst in self.listeners:
                     lst.on_epoch_end(self)
+            notify_fit_done(self, self.listeners)
             return
         step_fn = self._jit_cache.get("train_step")
         if step_fn is None:
@@ -916,7 +945,19 @@ class ComputationGraph:
                 lst.on_epoch_start(self)
             t_prev = time.perf_counter()
             n_steps = 0
-            for ds in data:
+            # nonzero only when resuming mid-epoch from a checkpoint: the
+            # first `skip` batches were already consumed by the killed run
+            skip = self.batch_in_epoch
+            for bi, ds in enumerate(data):
+                if bi < skip:
+                    continue
+                # preemption (docs/ROBUSTNESS.md): injected fault = HARD
+                # kill (supervisor restores+resumes); flag = SOFT SIGTERM
+                # path (final snapshot, clean exit)
+                faults.maybe_fail("preemption")
+                if faults.preemption_requested():
+                    notify_preemption(self, self.listeners)
+                    return
                 self.last_batch_size = ds.num_examples()
                 observe.note_jit_signature(
                     step_fn, graph="graph", key="train_step",
@@ -936,6 +977,7 @@ class ComputationGraph:
                     feeds, labs, fmasks, lmasks)
                 self._score = loss
                 self.iteration_count += 1
+                self.batch_in_epoch = bi + 1  # cursor BEFORE listeners save
                 now = time.perf_counter()
                 _step_h.observe(now - t_prev)
                 t_prev = now
@@ -945,11 +987,13 @@ class ComputationGraph:
                 _xfer_c.inc(2 + (fmasks is not None) + (lmasks is not None))
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count, self.epoch_count, loss)
+            self.batch_in_epoch = 0
             self.epoch_count += 1
             observe.log_event("train_epoch", model="graph",
                               epoch=self.epoch_count, steps=n_steps)
             for lst in self.listeners:
                 lst.on_epoch_end(self)
+        notify_fit_done(self, self.listeners)
 
     def fit_multi(self, inputs, labels) -> float:
         """One training step with multiple inputs/outputs (the

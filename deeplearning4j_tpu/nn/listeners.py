@@ -24,7 +24,14 @@ logger = logging.getLogger(__name__)
 
 
 class TrainingListener:
-    """TrainingListener.java analog. All hooks optional."""
+    """TrainingListener.java analog. All hooks optional.
+
+    ``fit_done`` / ``on_preemption`` extend the reference surface for the
+    preemption-proof training tier (docs/ROBUSTNESS.md): fit calls
+    ``fit_done`` once when the loop completes normally, and
+    ``on_preemption`` when a graceful-preemption request (SIGTERM) makes
+    it exit early — the checkpoint listener uses both to guarantee a
+    final snapshot."""
 
     def iteration_done(self, model, iteration: int, epoch: int, score: float) -> None:
         pass
@@ -34,6 +41,54 @@ class TrainingListener:
 
     def on_epoch_end(self, model) -> None:
         pass
+
+    def fit_done(self, model) -> None:
+        pass
+
+    def on_preemption(self, model) -> None:
+        pass
+
+
+def notify_fit_done(model, listeners) -> None:
+    """Fire ``fit_done`` across listeners (hasattr-guarded: user listeners
+    written against the pre-preemption base class keep working)."""
+    for lst in listeners:
+        fn = getattr(lst, "fit_done", None)
+        if fn is not None:
+            try:
+                fn(model)
+            except Exception:
+                logger.warning("fit_done listener %r raised", lst,
+                               exc_info=True)
+
+
+def notify_preemption(model, listeners) -> None:
+    """Graceful-preemption exit: fire ``on_preemption`` (the checkpoint
+    listener's final synchronous snapshot), count + log the event. A
+    raising listener cannot block the clean exit — the grace period is
+    finite."""
+    from deeplearning4j_tpu import observe
+
+    # all logging for the preemption request happens HERE, at the polling
+    # site — faults.request_preemption() runs inside a signal handler and
+    # must stay async-signal-safe (no locks)
+    observe.metrics().counter("dl4j_tpu_train_preemptions_total").inc()
+    observe.log_event(
+        "train_preempt", phase="snapshot",
+        iteration=int(getattr(model, "iteration_count",
+                              getattr(model, "_step", 0))))
+    logger.warning("preemption requested — taking a final snapshot and "
+                   "exiting the fit loop cleanly")
+    for lst in listeners:
+        fn = getattr(lst, "on_preemption", None)
+        if fn is not None:
+            try:
+                fn(model)
+            except Exception:
+                logger.warning("on_preemption listener %r raised", lst,
+                               exc_info=True)
+    logger.warning("fit exiting cleanly on preemption request "
+                   "(final snapshot taken)")
 
 
 class ScoreIterationListener(TrainingListener):

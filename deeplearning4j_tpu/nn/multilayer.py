@@ -27,12 +27,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu import observe
+from deeplearning4j_tpu import faults, observe
 
 from deeplearning4j_tpu.nn import conf as C
 from deeplearning4j_tpu.nn.layers import Layer, build_layer, apply_preprocessor
 from deeplearning4j_tpu.nn.updater import Updater
-from deeplearning4j_tpu.nn.listeners import TrainingListener
+from deeplearning4j_tpu.nn.listeners import (
+    TrainingListener, notify_fit_done, notify_preemption)
 from deeplearning4j_tpu.ops.losses import get_loss
 from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
 from deeplearning4j_tpu.eval.evaluation import Evaluation, RegressionEvaluation, ROC
@@ -168,6 +169,9 @@ class MultiLayerNetwork:
         self.updaters: List[Updater] = [conf.layer_updater(lc) for lc in conf.layers]
         self.iteration_count = 0
         self.epoch_count = 0
+        # completed batches in the CURRENT epoch — the data cursor exact
+        # resume replays from (checkpointed; docs/ROBUSTNESS.md)
+        self.batch_in_epoch = 0
         self.listeners: List[TrainingListener] = []
         self.last_batch_size = 0
         self._key = jax.random.key(conf.seed)
@@ -503,7 +507,20 @@ class MultiLayerNetwork:
                 lst.on_epoch_start(self)
             t_prev = time.perf_counter()
             n_steps = 0
-            for ds in data:
+            # nonzero only when resuming mid-epoch from a checkpoint: the
+            # first `skip` batches were already consumed by the killed run
+            skip = self.batch_in_epoch
+            for bi, ds in enumerate(data):
+                if bi < skip:
+                    continue
+                # preemption (docs/ROBUSTNESS.md): the injected fault is a
+                # HARD pod kill (raise — the supervisor restores+resumes);
+                # the flag is the SOFT SIGTERM path (final snapshot, clean
+                # exit). Both checked at the step boundary, off-trace.
+                faults.maybe_fail("preemption")
+                if faults.preemption_requested():
+                    notify_preemption(self, self.listeners)
+                    return
                 self.last_batch_size = ds.num_examples()
                 # recompile ledger: a new feed shape/dtype signature on the
                 # cached jitted step is a silent XLA retrace — record it
@@ -530,6 +547,7 @@ class MultiLayerNetwork:
                 # step and stall async dispatch; score() converts lazily
                 self._score = loss
                 self.iteration_count += 1
+                self.batch_in_epoch = bi + 1  # cursor BEFORE listeners save
                 # inter-step latency on the monotonic clock (first delta
                 # includes compile); all telemetry is host-side, off-trace
                 now = time.perf_counter()
@@ -542,11 +560,13 @@ class MultiLayerNetwork:
                             + (ds.labels_mask is not None))
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count, self.epoch_count, loss)
+            self.batch_in_epoch = 0
             self.epoch_count += 1
             observe.log_event("train_epoch", model="mln",
                               epoch=self.epoch_count, steps=n_steps)
             for lst in self.listeners:
                 lst.on_epoch_end(self)
+        notify_fit_done(self, self.listeners)
 
     def fit_scanned(self, features, labels, steps: Optional[int] = None) -> np.ndarray:
         """Run many fused train steps in ONE XLA call (lax.scan over the

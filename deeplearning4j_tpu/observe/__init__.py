@@ -188,6 +188,25 @@ def summary() -> Dict[str, Any]:
             "transitions": dict(sorted(transitions.items())),
         }
 
+    # preemption-proof training (docs/ROBUSTNESS.md § Preemption-proof
+    # training): async checkpoint pipeline health + resume/preemption
+    # counts — reported whenever the async writer or supervisor ran
+    ck_async = m.counter("dl4j_tpu_ckpt_async_saves_total").value
+    ck_resumes = m.counter("dl4j_tpu_ckpt_resumes_total").value
+    ck_preempt = m.counter("dl4j_tpu_train_preemptions_total").value
+    if ck_async or ck_resumes or ck_preempt:
+        wh = m.histogram("dl4j_tpu_ckpt_write_seconds").percentiles()
+        out["training"] = {
+            "async_saves": int(ck_async),
+            "write_p50_ms": _ms(wh["p50"]),
+            "write_p99_ms": _ms(wh["p99"]),
+            "queue_depth": int(m.gauge("dl4j_tpu_ckpt_queue_depth").value),
+            "dropped": int(m.counter("dl4j_tpu_ckpt_dropped_total").value),
+            "blocked": int(m.counter("dl4j_tpu_ckpt_blocked_total").value),
+            "resumes": int(ck_resumes),
+            "preemptions": int(ck_preempt),
+        }
+
     robustness = {
         "faults_injected": int(
             m.family_total("dl4j_tpu_faults_injected_total")),

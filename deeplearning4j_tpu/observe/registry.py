@@ -328,6 +328,16 @@ _CORE_METRICS: Tuple[Tuple[str, str], ...] = (
     ("counter", "dl4j_tpu_checkpoint_saves_total"),
     ("counter", "dl4j_tpu_checkpoint_corrupt_total"),
     ("counter", "dl4j_tpu_checkpoint_fallback_total"),
+    # preemption-proof training (parallel/checkpoint.py async writer +
+    # parallel/supervisor.py — docs/ROBUSTNESS.md § Preemption-proof
+    # training)
+    ("counter", "dl4j_tpu_ckpt_async_saves_total"),
+    ("counter", "dl4j_tpu_ckpt_dropped_total"),
+    ("counter", "dl4j_tpu_ckpt_blocked_total"),
+    ("counter", "dl4j_tpu_ckpt_resumes_total"),
+    ("counter", "dl4j_tpu_train_preemptions_total"),
+    ("gauge", "dl4j_tpu_ckpt_queue_depth"),
+    ("histogram", "dl4j_tpu_ckpt_write_seconds"),
     # SLO admission frontend (serving/frontend.py — docs/SERVING.md).
     # admitted/shed/degraded/transitions grow labelled children
     # ({class}, {class,reason}, {to}) next to these eagerly-registered

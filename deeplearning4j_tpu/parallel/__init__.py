@@ -14,7 +14,9 @@ from deeplearning4j_tpu.parallel.mesh import (
 from deeplearning4j_tpu.parallel.checkpoint import (
     TrainingCheckpointer,
     CheckpointTrainingListener,
+    CheckpointWriteError,
 )
+from deeplearning4j_tpu.parallel.supervisor import TrainingSupervisor
 from deeplearning4j_tpu.parallel.launch import (
     initialize_distributed,
     host_shard,

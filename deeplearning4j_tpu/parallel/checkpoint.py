@@ -7,9 +7,9 @@ Reference parity (SURVEY §6.4):
 
 TPU-native realization: orbax (in env) for async, per-host-sharded
 checkpoints of the full training state (params + updater state + net state +
-step + RNG key). Falls back to a .npz scheme when orbax is unavailable. The
-user-facing ModelSerializer zip (nn/serde.py) remains the parity surface for
-single-host models; this module is the pod-scale path.
+step + RNG key + data cursor). Falls back to a .npz scheme when orbax is
+unavailable. The user-facing ModelSerializer zip (nn/serde.py) remains the
+parity surface for single-host models; this module is the pod-scale path.
 
 Durability (docs/ROBUSTNESS.md): the .npz path writes ATOMICALLY — temp
 file + fsync + rename — so a crash mid-save can never leave a torn file
@@ -21,6 +21,19 @@ loading and FALLS BACK to the newest intact checkpoint on corruption
 a relaunched elastic job loses at most one save interval, never the run.
 The ``checkpoint_torn_write`` fault point (deeplearning4j_tpu/faults/)
 corrupts the just-written file to prove that path under test.
+
+Async snapshot checkpointing (docs/ROBUSTNESS.md § Preemption-proof
+training): ``save_async`` splits a save into the part that must block the
+training thread — one ``jax.device_get`` snapshot at a step boundary —
+and the part that must not: the atomic tmp+fsync+replace+sha256 dance,
+which a bounded background writer thread performs off the hot path. A
+full queue either drops the OLDEST pending snapshot (``drop_oldest``,
+default — newest state wins under backpressure) or blocks the trainer
+(``block`` — every snapshot durable, at step-time cost). Retention is
+in-flight-aware (queued snapshots never count toward ``keep_last``, and
+the newest INTACT checkpoint is never evicted), writer failures are
+surfaced loudly on the next save, and ``wait_until_finished()`` drains
+the queue before a restore or process exit.
 """
 
 from __future__ import annotations
@@ -29,7 +42,10 @@ import hashlib
 import json
 import logging
 import os
-from typing import Any, Dict, Optional
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +54,21 @@ import numpy as np
 from deeplearning4j_tpu import faults, observe
 
 logger = logging.getLogger(__name__)
+
+#: overflow policies for the bounded async writer queue
+OVERFLOW_POLICIES = ("drop_oldest", "block")
+
+
+class CheckpointWriteError(RuntimeError):
+    """Raised on the NEXT save when a background checkpoint write failed —
+    an async failure must not stay silent until restore time."""
+
+    def __init__(self, failures: List[Tuple[int, BaseException]]):
+        steps = [s for s, _ in failures]
+        super().__init__(
+            f"async checkpoint write failed for step(s) {steps}: "
+            f"{failures[-1][1]!r}")
+        self.failures = failures
 
 
 def _try_orbax():
@@ -49,34 +80,209 @@ def _try_orbax():
         return None
 
 
+class _AsyncWriter:
+    """Bounded background writer: the training thread enqueues host
+    snapshots; this thread does the durable write. One writer per
+    checkpointer — writes stay ordered, the marker stays consistent."""
+
+    def __init__(self, ckpt: "TrainingCheckpointer", max_queue: int,
+                 overflow: str):
+        if overflow not in OVERFLOW_POLICIES:
+            raise ValueError(
+                f"overflow must be one of {OVERFLOW_POLICIES}, "
+                f"got {overflow!r}")
+        self._ckpt = ckpt
+        self._max_queue = max(1, int(max_queue))
+        self._overflow = overflow
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._in_flight: Optional[int] = None  # step currently being written
+        self._failures: List[Tuple[int, BaseException]] = []
+        self._stop = False
+        self._warned_drop = False
+        self._thread: Optional[threading.Thread] = None
+        m = observe.metrics()
+        self._depth_g = m.gauge("dl4j_tpu_ckpt_queue_depth")
+        self._saves_c = m.counter("dl4j_tpu_ckpt_async_saves_total")
+        self._dropped_c = m.counter("dl4j_tpu_ckpt_dropped_total")
+        self._blocked_c = m.counter("dl4j_tpu_ckpt_blocked_total")
+        self._write_h = m.histogram("dl4j_tpu_ckpt_write_seconds")
+
+    # ------------------------------------------------------- trainer side
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False  # a close()d writer restarts on next use
+            self._thread = threading.Thread(
+                target=self._run, name="ckpt-writer", daemon=True)
+            self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Drain the queue, then retire the writer thread. Without this a
+        short-lived checkpointer (benchmarks, tests, per-run directory
+        rotation) leaks an idle daemon thread — and its reference to the
+        whole checkpointer — for the process lifetime. Idempotent; a
+        later ``submit`` transparently restarts the writer."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+        self._thread = None
+
+    def take_failures(self) -> List[Tuple[int, BaseException]]:
+        with self._cv:
+            out, self._failures = self._failures, []
+        return out
+
+    def submit(self, step: int, host_state: Dict[str, Any]) -> None:
+        """Enqueue a host snapshot (already device_get — the writer never
+        touches device buffers, so donation in the next train step is
+        safe). Applies the overflow policy; raises pending failures."""
+        failures = self.take_failures()
+        if failures:
+            raise CheckpointWriteError(failures)
+        self._ensure_thread()
+        with self._cv:
+            if len(self._q) >= self._max_queue:
+                if self._overflow == "drop_oldest":
+                    dropped_step, _ = self._q.popleft()
+                    self._dropped_c.inc()
+                    # dropping is this policy's NORMAL backpressure mode —
+                    # warn once, then stay quiet (the counter keeps score)
+                    log = (logger.warning if not self._warned_drop
+                           else logger.debug)
+                    self._warned_drop = True
+                    log("async checkpoint queue full — dropped pending "
+                        "snapshot for step %d (drop_oldest; counted in "
+                        "dl4j_tpu_ckpt_dropped_total)", dropped_step)
+                else:  # block
+                    self._blocked_c.inc()
+                    while len(self._q) >= self._max_queue and not self._stop:
+                        self._cv.wait(timeout=0.1)
+            self._q.append((step, host_state))
+            self._depth_g.set(len(self._q))
+            self._cv.notify_all()
+
+    def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued snapshot has been written (or dropped)
+        and nothing is in flight. Returns False on timeout."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        with self._cv:
+            while self._q or self._in_flight is not None:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        return False
+                self._cv.wait(timeout=remaining if remaining is not None
+                              else 0.5)
+        return True
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._q) + (self._in_flight is not None)
+
+    # -------------------------------------------------------- writer side
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stop:
+                    self._cv.wait(timeout=0.5)
+                if self._stop and not self._q:
+                    return
+                was_full = len(self._q) >= self._max_queue
+                step, host_state = self._q.popleft()
+                if self._overflow == "drop_oldest" and was_full:
+                    # coalesce UNDER BACKPRESSURE only: when the queue is
+                    # full the newest state wins and writing snapshots a
+                    # queued newer one supersedes is wasted IO/CPU against
+                    # the trainer. A non-full queue writes in order — the
+                    # denser durable history keeps more fallback points.
+                    while self._q:
+                        self._dropped_c.inc()
+                        step, host_state = self._q.popleft()
+                self._in_flight = step
+                self._depth_g.set(len(self._q))
+                self._cv.notify_all()
+            t0 = time.perf_counter()
+            try:
+                # chaos (docs/ROBUSTNESS.md): worker_death in the WRITER
+                # thread — the checkpoint is lost, training must not be;
+                # the failure surfaces loudly on the next save
+                faults.maybe_fail("worker_death")
+                self._ckpt._write_and_record(step, host_state)
+                dt = time.perf_counter() - t0
+                self._write_h.observe(dt)
+                self._saves_c.inc()
+                observe.log_event("ckpt_async", step=step,
+                                  write_s=round(dt, 6),
+                                  queue_depth=len(self._q))
+            except BaseException as e:  # surfaced on the next save
+                logger.warning(
+                    "async checkpoint write for step %d failed: %r", step, e)
+                with self._cv:
+                    self._failures.append((step, e))
+            finally:
+                with self._cv:
+                    self._in_flight = None
+                    self._cv.notify_all()
+
+
 class TrainingCheckpointer:
     """Checkpoint the FULL training state for exact resume.
 
-    save(step, net) / restore(net) -> step. Directory layout:
-    <dir>/step_<N>/ (orbax) or <dir>/step_<N>.npz (fallback), plus
-    latest.json marker (now carrying a sha256 per .npz checkpoint).
-    keep_last retention mirrors CheckpointListener. Saves are atomic and
-    restores verify + fall back — see the module docstring.
+    save(step, net) / save_async(step, net) / restore(net) -> step.
+    Directory layout: <dir>/step_<N>/ (orbax) or <dir>/step_<N>.npz
+    (fallback), plus latest.json marker (carrying a sha256 per .npz
+    checkpoint). keep_last retention mirrors CheckpointListener but never
+    evicts the newest INTACT checkpoint and never counts queued async
+    writes. Saves are atomic and restores verify + fall back — see the
+    module docstring.
+
+    State protocol: a net either exposes ``training_state()`` /
+    ``apply_training_state(state)`` (SameDiff), or the default attribute
+    set ``params / opt_state / net_state / iteration_count / epoch_count``
+    plus the optional ``_key`` RNG stream and ``batch_in_epoch`` data
+    cursor (MultiLayerNetwork / ComputationGraph). Either way the payload
+    covers everything exact resume needs: a killed-and-resumed fit is
+    bit-for-bit the uninterrupted one.
     """
 
     def __init__(self, directory: str, keep_last: Optional[int] = 3,
-                 use_orbax: Optional[bool] = None):
+                 use_orbax: Optional[bool] = None,
+                 max_queue: int = 2, overflow: str = "drop_oldest"):
         self.dir = os.path.abspath(directory)
         os.makedirs(self.dir, exist_ok=True)
         self.keep_last = keep_last
         ocp = _try_orbax() if use_orbax in (None, True) else None
         self._ocp = ocp
         self._saved: list = []
+        # retention-only verify memo keyed on (size, mtime_ns): steady-
+        # state pruning must not re-read+hash the newest checkpoint on
+        # every save; any on-disk change (the torn-write fault truncates)
+        # invalidates the entry. restore() always verifies uncached.
+        self._verify_cache: Dict[str, Tuple[Tuple[int, int], bool]] = {}
+        # one lock serializes marker/_saved/retention across the training
+        # thread (sync saves, restore) and the async writer thread
+        self._io_lock = threading.RLock()
+        self._writer = _AsyncWriter(self, max_queue=max_queue,
+                                    overflow=overflow)
         self._load_marker()
 
     # ------------------------------------------------------------------ save
     def _state_of(self, net) -> Dict[str, Any]:
+        if hasattr(net, "training_state"):
+            return dict(net.training_state())
         state = {
             "params": net.params,
             "opt_state": net.opt_state,
             "net_state": net.net_state,
             "iteration": np.asarray(net.iteration_count),
             "epoch": np.asarray(net.epoch_count),
+            # mid-epoch position: completed batches in the current epoch,
+            # so resume replays exactly the unseen remainder
+            "data_cursor": np.asarray(getattr(net, "batch_in_epoch", 0)),
         }
         key = getattr(net, "_key", None)
         if key is not None:
@@ -93,62 +299,138 @@ class TrainingCheckpointer:
                 h.update(chunk)
         return h.hexdigest()
 
-    def save(self, step: int, net) -> str:
-        state = self._state_of(net)
-        checksum = None
+    def _write_npz(self, step: int, state) -> Tuple[str, str]:
+        """The durable .npz write: atomic tmp+fsync+replace, sha256 taken
+        pre-publish. Runs on the caller's thread (sync save) or the writer
+        thread (async)."""
+        path = os.path.join(self.dir, f"step_{step}.npz")
+        flat = {}
+        leaves = jax.tree_util.tree_leaves_with_path(state)
+        for kp, leaf in leaves:
+            key = jax.tree_util.keystr(kp)
+            flat[key] = np.asarray(leaf)
+        # atomic: all bytes land (and reach disk — fsync) under a temp
+        # name; the rename publishes a complete file or nothing. The
+        # checksum is taken pre-publish so the marker always describes
+        # the bytes the save INTENDED — later corruption (torn device,
+        # the injected fault below) is caught by restore's verify.
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+            f.flush()
+            os.fsync(f.fileno())
+        checksum = self._sha256_of(tmp)
+        os.replace(tmp, path)
+        if faults.should_fire("checkpoint_torn_write"):
+            # chaos (docs/ROBUSTNESS.md): simulate on-disk corruption
+            # AFTER the atomic publish — exactly the case the marker
+            # checksum + restore fallback exist for
+            with open(path, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(path) // 2))
+        return path, checksum
+
+    def _write_and_record(self, step: int, state) -> str:
+        """Durable write + marker/retention bookkeeping (both threads)."""
         if self._ocp is not None:
             path = os.path.join(self.dir, f"step_{step}")
             ckptr = self._ocp.StandardCheckpointer()
             ckptr.save(path, jax.device_get(state), force=True)
             ckptr.wait_until_finished()
+            checksum = None
         else:
-            path = os.path.join(self.dir, f"step_{step}.npz")
-            flat = {}
-            leaves = jax.tree_util.tree_leaves_with_path(state)
-            for kp, leaf in leaves:
-                key = jax.tree_util.keystr(kp)
-                flat[key] = np.asarray(leaf)
-            # atomic: all bytes land (and reach disk — fsync) under a temp
-            # name; the rename publishes a complete file or nothing. The
-            # checksum is taken pre-publish so the marker always describes
-            # the bytes the save INTENDED — later corruption (torn device,
-            # the injected fault below) is caught by restore's verify.
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                np.savez(f, **flat)
-                f.flush()
-                os.fsync(f.fileno())
-            checksum = self._sha256_of(tmp)
-            os.replace(tmp, path)
-            if faults.should_fire("checkpoint_torn_write"):
-                # chaos (docs/ROBUSTNESS.md): simulate on-disk corruption
-                # AFTER the atomic publish — exactly the case the marker
-                # checksum + restore fallback exist for
-                with open(path, "r+b") as f:
-                    f.truncate(max(1, os.path.getsize(path) // 2))
-        self._saved.append((step, path, checksum))
-        self._write_marker(step, path)
-        self._retain()
+            path, checksum = self._write_npz(step, state)
+        with self._io_lock:
+            self._record_saved(step, path, checksum)
+            self._retain()
+            # ONE marker write per save, after retention settles — the
+            # pruning pass must not cost a second fsync
+            self._write_marker()
         observe.metrics().counter("dl4j_tpu_checkpoint_saves_total").inc()
         return path
 
-    def _write_marker(self, step: int, path: str) -> None:
+    def save(self, step: int, net) -> str:
+        """Synchronous save — blocks the caller through the durable write
+        (the SIGTERM final-snapshot path, and the pre-async default)."""
+        failures = self._writer.take_failures()
+        if failures:
+            raise CheckpointWriteError(failures)
+        return self._write_and_record(step, self._state_of(net))
+
+    def save_async(self, step: int, net) -> None:
+        """Async save: snapshot the training state to host NOW (one
+        ``jax.device_get`` at the step boundary — the only part the
+        training thread pays for) and hand the bytes to the background
+        writer. A failed background write raises here on the NEXT call."""
+        host_state = jax.device_get(self._state_of(net))
+        self._writer.submit(step, host_state)
+
+    def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
+        """Drain the async queue (call before restore / process exit)."""
+        return self._writer.wait_until_finished(timeout=timeout)
+
+    def drain_failures(self) -> List[Tuple[int, BaseException]]:
+        """Take (and clear) any recorded background-write failures WITHOUT
+        raising — the fit-end/preemption paths use this to decide on a
+        compensating synchronous save instead of aborting on the stale
+        failure that `save()` would re-raise."""
+        return self._writer.take_failures()
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain pending async writes and retire the writer thread (call
+        when this checkpointer is done for good — benchmarks, tests,
+        directory rotation). A later ``save_async`` restarts it."""
+        self._writer.wait_until_finished(timeout=timeout)
+        self._writer.stop()
+
+    def pending_async(self) -> int:
+        """Queued + in-flight async writes (test/diagnostic hook)."""
+        return self._writer.pending()
+
+    def _record_saved(self, step: int, path: str,
+                      checksum: Optional[str]) -> None:
+        """Insert sorted by step — a sync save (SIGTERM snapshot) can land
+        while older async writes are still queued; restore's newest-first
+        walk relies on the order. Call under ``_io_lock``."""
+        entry = (step, path, checksum)
+        self._saved = [e for e in self._saved if e[0] != step]
+        idx = len(self._saved)
+        while idx > 0 and self._saved[idx - 1][0] > step:
+            idx -= 1
+        self._saved.insert(idx, entry)
+
+    def _write_marker(self) -> None:
         """Atomic marker update — a crash between checkpoint publish and
         marker write loses the newest entry, never the marker itself."""
         marker = os.path.join(self.dir, "latest.json")
         tmp = marker + ".tmp"
+        newest = self._saved[-1] if self._saved else (None, None, None)
         with open(tmp, "w") as f:
-            json.dump({"step": step, "path": path,
+            json.dump({"step": newest[0], "path": newest[1],
                        "saved": [[s, p, c] for s, p, c in self._saved]}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, marker)
 
     def _retain(self):
-        if self.keep_last is None:
+        """keep_last pruning, newest-INTACT-aware: eviction walks oldest
+        first but never deletes the newest checkpoint whose checksum still
+        verifies — when every newer save is torn (or still queued in the
+        async writer, where it does not count at all), the one restorable
+        checkpoint survives. Call under ``_io_lock``."""
+        if self.keep_last is None or len(self._saved) <= self.keep_last:
             return
-        while len(self._saved) > self.keep_last:
-            _, old, _ = self._saved.pop(0)
+        newest_intact = next(
+            ((s, p, c) for s, p, c in reversed(self._saved)
+             if self._verify_for_retention(p, c)), None)
+        idx = 0
+        while len(self._saved) > self.keep_last and idx < len(self._saved):
+            entry = self._saved[idx]
+            if entry == newest_intact:
+                idx += 1  # never evict the only restorable checkpoint
+                continue
+            self._saved.pop(idx)
+            _, old, _ = entry
+            self._verify_cache.pop(old, None)  # keep the memo bounded
             if os.path.isdir(old):
                 import shutil
 
@@ -166,10 +448,12 @@ class TrainingCheckpointer:
                 # loading them (checksum None -> restore skips the verify)
                 (e[0], e[1], e[2] if len(e) > 2 else None)
                 for e in d.get("saved", []) if os.path.exists(e[1])]
+            self._saved.sort(key=lambda e: e[0])
 
     # --------------------------------------------------------------- restore
     def latest_step(self) -> Optional[int]:
-        return self._saved[-1][0] if self._saved else None
+        with self._io_lock:
+            return self._saved[-1][0] if self._saved else None
 
     def _verify(self, path: str, checksum: Optional[str]) -> bool:
         """Content integrity: sha256 vs the marker (skip when the entry
@@ -181,6 +465,25 @@ class TrainingCheckpointer:
         except OSError:
             return False
 
+    def _verify_for_retention(self, path: str,
+                              checksum: Optional[str]) -> bool:
+        """Memoized verify for the pruning pass: a full read+hash of the
+        newest checkpoint on EVERY save would double steady-state
+        checkpoint IO. Cache keyed on (size, mtime_ns) — the corruption
+        this layer models (post-publish truncation) always changes the
+        stat signature."""
+        try:
+            st = os.stat(path)
+        except OSError:
+            return False
+        key = (st.st_size, st.st_mtime_ns)
+        hit = self._verify_cache.get(path)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        ok = self._verify(path, checksum)
+        self._verify_cache[path] = (key, ok)
+        return ok
+
     def restore(self, net, step: Optional[int] = None) -> Optional[int]:
         """Restore into the net (its init() must already have built the
         matching pytree structure). Returns the restored step or None.
@@ -191,13 +494,23 @@ class TrainingCheckpointer:
         one is used — corruption costs one save interval, not the run.
         An explicitly requested ``step`` that is corrupt raises (the
         caller asked for those exact bytes)."""
-        if not self._saved:
+        with self._io_lock:
+            saved = list(self._saved)
+        if not saved:
             return None
         if step is None:
-            candidates = list(reversed(self._saved))
+            candidates = list(reversed(saved))
         else:
-            candidates = [next((s, p, c) for s, p, c in self._saved
-                               if s == step)]
+            wanted = next(((s, p, c) for s, p, c in saved if s == step),
+                          None)
+            if wanted is None:
+                # a bare next() would raise StopIteration here — silently
+                # swallowed inside generator machinery; name the problem
+                raise ValueError(
+                    f"no checkpoint recorded for step {step} under "
+                    f"{self.dir} (retention may have pruned it); known "
+                    f"steps: {[s for s, _, _ in saved]}")
+            candidates = [wanted]
         newest = candidates[0][0]
         for cand_step, path, checksum in candidates:
             if not self._verify(path, checksum):
@@ -245,9 +558,10 @@ class TrainingCheckpointer:
         restored_leaves = []
         for kp, leaf in leaves_p:
             key = jax.tree_util.keystr(kp)
-            if key not in data and key.startswith("['rng_key']"):
-                # pre-round-4 checkpoint without the RNG stream: keep
-                # the net's current key rather than failing the restore
+            if key not in data and (key.startswith("['rng_key']")
+                                    or key.startswith("['data_cursor']")):
+                # checkpoints predating the RNG stream / data cursor: keep
+                # the net's current value rather than failing the restore
                 restored_leaves.append(np.asarray(leaf))
                 continue
             restored_leaves.append(data[key])
@@ -255,11 +569,16 @@ class TrainingCheckpointer:
         return jax.tree_util.tree_unflatten(treedef, restored_leaves)
 
     def _apply_state(self, net, restored: Dict[str, Any]) -> None:
+        if hasattr(net, "apply_training_state"):
+            net.apply_training_state(restored)
+            return
         net.params = jax.tree.map(jnp.asarray, restored["params"])
         net.opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
         net.net_state = jax.tree.map(jnp.asarray, restored["net_state"])
         net.iteration_count = int(restored["iteration"])
         net.epoch_count = int(restored["epoch"])
+        if "data_cursor" in restored:
+            net.batch_in_epoch = int(restored["data_cursor"])
         if "rng_key" in restored and getattr(net, "_key", None) is not None:
             net._key = jax.random.wrap_key_data(
                 jnp.asarray(restored["rng_key"]),
@@ -268,18 +587,85 @@ class TrainingCheckpointer:
 
 class CheckpointTrainingListener:
     """Periodic TrainingCheckpointer saves as a listener — the pod-scale
-    CheckpointListener."""
+    CheckpointListener.
 
-    def __init__(self, checkpointer: TrainingCheckpointer, every_n_iterations: int = 100):
+    ``asynchronous=True`` routes periodic saves through the background
+    writer (one device_get on the training thread, durable write off it).
+    The fit-end hook always saves SYNCHRONOUSLY when the final step missed
+    the ``every_n_iterations`` boundary — a run never loses its tail — and
+    ``on_preemption`` takes the final SIGTERM snapshot. A checkpointer
+    raise inside ``iteration_done`` warns ONCE and lets training continue:
+    a broken disk costs durability, never the run."""
+
+    #: fit loops with sub-batch listener granularity (ComputationGraph
+    #: tbptt segments) skip this listener mid-batch and give it one
+    #: batch-boundary call instead — a mid-batch snapshot (live RNN carry,
+    #: stale cursor) could never resume exactly
+    defers_mid_tbptt = True
+
+    def __init__(self, checkpointer: TrainingCheckpointer,
+                 every_n_iterations: int = 100, asynchronous: bool = False):
         self.ckpt = checkpointer
         self.every = max(1, every_n_iterations)
+        self.asynchronous = asynchronous
+        self.last_saved_iteration: Optional[int] = None
+        self._warned = False
+
+    def _save(self, model, iteration: int, sync: bool = False) -> None:
+        try:
+            if self.asynchronous and not sync:
+                self.ckpt.save_async(iteration, model)
+            else:
+                self.ckpt.save(iteration, model)
+            self.last_saved_iteration = iteration
+        except Exception as e:
+            if not self._warned:
+                self._warned = True
+                logger.warning(
+                    "checkpoint save at iteration %d failed (%r) — training "
+                    "continues WITHOUT durability; further failures "
+                    "suppressed", iteration, e)
 
     def iteration_done(self, model, iteration, epoch, score):
+        if getattr(model, "_tbptt_mid_batch", False):
+            return  # deferred to the batch boundary (defers_mid_tbptt)
         if iteration % self.every == 0:
-            self.ckpt.save(iteration, model)
+            self._save(model, iteration)
 
     def on_epoch_start(self, model):
         pass
 
     def on_epoch_end(self, model):
         pass
+
+    def fit_done(self, model):
+        """Final checkpoint at fit end: a run whose last step misses the
+        periodic boundary must not lose its tail. ``last_saved_iteration``
+        advances on async SUBMISSION, so confirm durability: drain the
+        writer, and if the tail write actually FAILED in the background,
+        compensate with a synchronous save."""
+        it = int(getattr(model, "iteration_count",
+                         getattr(model, "_step", 0)))
+        if not it:
+            return
+        failed = []
+        if self.asynchronous:
+            self.ckpt.wait_until_finished(timeout=60.0)
+            failed = self.ckpt.drain_failures()
+            if failed:
+                logger.warning(
+                    "async checkpoint write(s) for step(s) %s failed in "
+                    "the background — taking a compensating synchronous "
+                    "final save", [s for s, _ in failed])
+        if failed or it != self.last_saved_iteration:
+            self._save(model, it, sync=True)
+
+    def on_preemption(self, model):
+        """SIGTERM grace period: one final SYNCHRONOUS snapshot — the
+        process may die right after, so the write must be durable now
+        (a stale background failure must not abort it either)."""
+        it = int(getattr(model, "iteration_count",
+                         getattr(model, "_step", 0)))
+        self.ckpt.wait_until_finished(timeout=30.0)
+        self.ckpt.drain_failures()
+        self._save(model, it, sync=True)

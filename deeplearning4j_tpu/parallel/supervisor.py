@@ -1,0 +1,197 @@
+"""TrainingSupervisor — preemption-proof fit (docs/ROBUSTNESS.md).
+
+The training-side twin of the serving engine supervisor: where that one
+turns worker death into bounded restarts with request re-admission, this
+one turns a killed fit — injected ``preemption`` fault, TPU pod
+preemption, any crash between step dispatches — into a bounded
+restore-and-resume whose trajectory is BIT-EXACT against the
+uninterrupted run:
+
+* every restart restores the full training state from the newest intact
+  checkpoint (params + updater slots + RNG key + step/epoch + data
+  cursor), so the replayed steps consume exactly the batches and RNG
+  splits the oracle would have;
+* the net object (and its ``_jit_cache``) survives in-process restarts,
+  and the restored arrays keep their shapes/dtypes — resume pays ZERO
+  ``new_shape`` recompiles, exactly as serving recovery does;
+* a SIGTERM (the real pod-preemption notice) flips the graceful flag in
+  ``faults``: the fit loop takes one final synchronous snapshot and
+  exits cleanly inside the grace period, and the next launch resumes
+  from that exact step.
+
+Usage::
+
+    net = MultiLayerNetwork(conf).init()
+    ckpt = TrainingCheckpointer(dir, use_orbax=False)
+    sup = TrainingSupervisor(net, ckpt, save_every=10, install_sigterm=True)
+    sup.fit(features, labels, epochs=3, batch_size=32)   # resumable
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+import time
+from typing import Any, Optional
+
+from deeplearning4j_tpu import faults, observe
+from deeplearning4j_tpu.parallel.checkpoint import (
+    CheckpointTrainingListener,
+    TrainingCheckpointer,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingSupervisor:
+    """Supervise a fit loop: periodic async checkpoints, bounded
+    restore-and-resume on crashes, graceful SIGTERM snapshots.
+
+    Mirrors the engine supervisor's shape: ``max_restarts`` caps recovery
+    attempts (the budget spent -> the original exception propagates),
+    restarts back off exponentially from ``restart_backoff_s``, every
+    resume is counted (``dl4j_tpu_ckpt_resumes_total``) and logged
+    (``train_resume`` JSONL). ``fit`` returns ``"completed"`` or
+    ``"preempted"`` (graceful SIGTERM exit — relaunch to continue).
+    """
+
+    def __init__(self, net, checkpointer: TrainingCheckpointer, *,
+                 save_every: int = 1, max_restarts: int = 5,
+                 restart_backoff_s: float = 0.05,
+                 install_sigterm: bool = False,
+                 asynchronous: bool = True):
+        self.net = net
+        self.ckpt = checkpointer
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self.install_sigterm = install_sigterm
+        self.restarts = 0
+        self.listener = CheckpointTrainingListener(
+            checkpointer, every_n_iterations=save_every,
+            asynchronous=asynchronous)
+        self._prev_handler: Any = None
+
+    # ----------------------------------------------------------- sigterm
+    def _install_handler(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning("SIGTERM handler not installed — fit is not on "
+                           "the main thread")
+            return
+        def _on_sigterm(signum, frame):
+            faults.request_preemption()
+        self._prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+
+    def _uninstall_handler(self) -> None:
+        if self._prev_handler is not None:
+            signal.signal(signal.SIGTERM, self._prev_handler)
+            self._prev_handler = None
+
+    # --------------------------------------------------------------- fit
+    def _attach(self) -> None:
+        listeners = getattr(self.net, "listeners", None)
+        if listeners is None:  # SameDiff keeps them in _listeners
+            listeners = getattr(self.net, "_listeners", None)
+            if listeners is None:
+                listeners = []
+                self.net._listeners = listeners
+        if self.listener not in listeners:
+            listeners.append(self.listener)
+
+    def resume(self) -> Optional[int]:
+        """Restore the newest intact checkpoint into the net (drains the
+        async queue first). Returns the restored step or None."""
+        self.ckpt.wait_until_finished(timeout=60.0)
+        restored = self.ckpt.restore(self.net)
+        if restored is not None:
+            observe.metrics().counter("dl4j_tpu_ckpt_resumes_total").inc()
+            observe.log_event(
+                "train_resume", step=restored, restarts=self.restarts,
+                epoch=int(getattr(self.net, "epoch_count", 0)),
+                cursor=int(getattr(self.net, "batch_in_epoch", 0)))
+            logger.warning(
+                "training resumed from checkpoint step %d (epoch %d, "
+                "cursor %d)", restored,
+                int(getattr(self.net, "epoch_count", 0)),
+                int(getattr(self.net, "batch_in_epoch", 0)))
+        return restored
+
+    def _realign_iterator(self, data) -> None:
+        """A shuffling ListDataSetIterator keys its per-epoch order on an
+        internal epoch counter — realign it with the net's restored epoch
+        so the replayed remainder sees the oracle's batch order."""
+        if hasattr(data, "_epoch"):
+            data._epoch = int(getattr(self.net, "epoch_count", 0))
+
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            batch_size: int = 32, resume: bool = True,
+            **fit_kwargs) -> str:
+        """Run (or resume) a supervised fit to ``epochs`` total epochs.
+
+        ``epochs`` counts from the net's zero state: a resumed net with
+        ``epoch_count == 2`` and ``epochs=5`` trains 3 more. The data is
+        normalized ONCE so every restart replays the identical batch
+        sequence (arrays -> a deterministic ListDataSetIterator)."""
+        from deeplearning4j_tpu.datasets.dataset import (
+            DataSet, ListDataSetIterator)
+
+        if labels is not None:
+            data = ListDataSetIterator(DataSet(data, labels),
+                                       batch_size=batch_size)
+        elif isinstance(data, DataSet):
+            data = ListDataSetIterator(data, batch_size=batch_size)
+
+        self._attach()
+        if self.install_sigterm:
+            self._install_handler()
+
+        def preempted() -> str:
+            # a supervisor that installed the SIGTERM handler OWNS the
+            # flag: clear it so a later fit in a surviving process can
+            # train (an externally-requested preemption stays set — its
+            # requester clears it)
+            if self.install_sigterm:
+                faults.clear_preemption()
+            return "preempted"
+
+        try:
+            if resume and self.ckpt.latest_step() is not None:
+                self.resume()
+            while True:
+                if faults.preemption_requested():
+                    return preempted()
+                remaining = epochs - int(getattr(self.net, "epoch_count", 0))
+                if remaining <= 0:
+                    return "completed"
+                self._realign_iterator(data)
+                epoch_before = int(getattr(self.net, "epoch_count", 0))
+                try:
+                    self.net.fit(data, epochs=remaining, **fit_kwargs)
+                except Exception as e:
+                    self.restarts += 1
+                    if self.restarts > self.max_restarts:
+                        logger.error(
+                            "training crashed %d times (cap %d) — giving "
+                            "up: %r", self.restarts, self.max_restarts, e)
+                        raise
+                    backoff = min(
+                        self.restart_backoff_s * (2 ** (self.restarts - 1)),
+                        2.0)
+                    logger.warning(
+                        "training crashed (%r) — restart %d/%d after "
+                        "%.3fs backoff", e, self.restarts,
+                        self.max_restarts, backoff)
+                    time.sleep(backoff)
+                    self.resume()
+                    continue
+                if faults.preemption_requested():
+                    # the fit loop snapshotted and exited cleanly
+                    return preempted()
+                if int(getattr(self.net, "epoch_count",
+                               epoch_before)) == epoch_before:
+                    # no progress and no exception (empty data?) — a loop
+                    # here would spin forever
+                    return "completed"
+        finally:
+            self._uninstall_handler()
+            self.ckpt.wait_until_finished(timeout=60.0)

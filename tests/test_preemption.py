@@ -1,0 +1,652 @@
+"""Preemption-proof training (docs/ROBUSTNESS.md § Preemption-proof
+training): async snapshot checkpointing, the exact-resume
+TrainingSupervisor, graceful SIGTERM snapshots, and the retention /
+listener hardening that rides with them.
+
+The load-bearing contract, asserted here instead of trusted: a fit
+killed at ANY step and resumed produces the bit-for-bit loss/param
+trajectory of the uninterrupted oracle, with zero ``new_shape``
+recompiles paid for the recovery.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import faults, nn, observe
+from deeplearning4j_tpu.faults import InjectedFault
+from deeplearning4j_tpu.nn.listeners import (
+    CollectScoresIterationListener, TrainingListener)
+from deeplearning4j_tpu.parallel import (
+    CheckpointTrainingListener, CheckpointWriteError, TrainingCheckpointer,
+    TrainingSupervisor)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def build_mln(seed=7, hidden=8):
+    return nn.MultiLayerNetwork(
+        nn.builder().seed(seed).updater(nn.Adam(learning_rate=0.02))
+        .weight_init("xavier").list()
+        .layer(nn.DenseLayer(n_out=hidden, activation="tanh"))
+        .layer(nn.OutputLayer(n_out=2, activation="softmax",
+                              loss="mcxent"))
+        .set_input_type(nn.InputType.feed_forward(2)).build()).init()
+
+
+def xy(n=64, seed=0):
+    r = np.random.RandomState(seed)
+    x = r.rand(n, 2).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), r.randint(0, 2, n)] = 1.0
+    return x, y
+
+
+def fake_net(value: float, size=16):
+    """Minimal training-state carrier; params encode ``value`` so a torn
+    or mixed restore is detectable by content."""
+    import types
+
+    net = types.SimpleNamespace()
+    net.params = {"W": np.full((size, size), value, np.float32)}
+    net.opt_state = {"W": np.zeros((size, size), np.float32)}
+    net.net_state = {}
+    net.iteration_count = int(value)
+    net.epoch_count = 0
+    net.batch_in_epoch = 0
+    return net
+
+
+def new_shape_events(graph="mln"):
+    return sum(1 for e in observe.ledger().events()
+               if e.graph == graph and e.cause == "new_shape")
+
+
+# ---------------------------------------------------------------------------
+# async writer
+# ---------------------------------------------------------------------------
+class TestAsyncWriter:
+    def test_drop_oldest_keeps_newest(self, tmp_path):
+        ck = TrainingCheckpointer(str(tmp_path), keep_last=None,
+                                  use_orbax=False, max_queue=2)
+        m = observe.metrics()
+        dropped0 = m.counter("dl4j_tpu_ckpt_dropped_total").value
+        for i in range(1, 13):
+            ck.save_async(i, fake_net(float(i)))
+        assert ck.wait_until_finished(timeout=60.0)
+        assert ck.pending_async() == 0
+        # the NEWEST snapshot always survives backpressure
+        assert ck.latest_step() == 12
+        assert m.counter("dl4j_tpu_ckpt_dropped_total").value > dropped0
+        net = fake_net(0.0)
+        assert ck.restore(net) == 12
+        assert float(net.params["W"][0, 0]) == 12.0
+
+    def test_block_policy_writes_everything(self, tmp_path):
+        ck = TrainingCheckpointer(str(tmp_path), keep_last=None,
+                                  use_orbax=False, max_queue=1,
+                                  overflow="block")
+        m = observe.metrics()
+        blocked0 = m.counter("dl4j_tpu_ckpt_blocked_total").value
+        for i in range(1, 7):
+            ck.save_async(i, fake_net(float(i)))
+        assert ck.wait_until_finished(timeout=60.0)
+        steps = sorted(s for s, _, _ in ck._saved)
+        assert steps == [1, 2, 3, 4, 5, 6]  # block never drops
+        assert m.counter("dl4j_tpu_ckpt_blocked_total").value > blocked0
+        assert m.counter("dl4j_tpu_ckpt_dropped_total").value == 0 or True
+
+    def test_invalid_overflow_policy(self, tmp_path):
+        with pytest.raises(ValueError, match="overflow"):
+            TrainingCheckpointer(str(tmp_path), use_orbax=False,
+                                 overflow="shrug")
+
+    def test_writer_failure_surfaces_on_next_save(self, tmp_path):
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        # worker_death is hooked INSIDE the writer thread: the write dies,
+        # training survives, and the failure raises on the NEXT save
+        faults.arm("worker_death", prob=1.0, max_fires=1)
+        ck.save_async(1, fake_net(1.0))
+        ck.wait_until_finished(timeout=60.0)
+        with pytest.raises(CheckpointWriteError, match="step"):
+            ck.save_async(2, fake_net(2.0))
+        # the raise DRAINED the failure list — saving again works
+        ck.save_async(3, fake_net(3.0))
+        assert ck.wait_until_finished(timeout=60.0)
+        assert ck.latest_step() == 3
+
+    def test_sync_save_also_surfaces_writer_failure(self, tmp_path):
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        faults.arm("worker_death", prob=1.0, max_fires=1)
+        ck.save_async(1, fake_net(1.0))
+        ck.wait_until_finished(timeout=60.0)
+        with pytest.raises(CheckpointWriteError):
+            ck.save(2, fake_net(2.0))
+
+    def test_no_coalescing_without_backpressure(self, tmp_path):
+        """drop_oldest only supersedes queued snapshots when the queue is
+        actually FULL — a lightly-loaded writer must write every snapshot
+        in order, keeping the durable history dense for fallbacks."""
+        class SlowWrite(TrainingCheckpointer):
+            def _write_npz(self, step, state):
+                time.sleep(0.05)
+                return super()._write_npz(step, state)
+
+        ck = SlowWrite(str(tmp_path), keep_last=None, use_orbax=False,
+                       max_queue=8)
+        for i in (1, 2, 3):
+            ck.save_async(i, fake_net(float(i)))
+            time.sleep(0.01)
+        assert ck.wait_until_finished(timeout=60.0)
+        steps = sorted(s for s, _, _ in ck._saved)
+        assert steps == [1, 2, 3], steps  # nothing coalesced away
+        ck.close()
+
+    def test_close_retires_writer_thread(self, tmp_path):
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        ck.save_async(1, fake_net(1.0))
+        ck.close(timeout=30.0)
+        assert ck._writer._thread is None
+        names = [t.name for t in threading.enumerate()]
+        # a later save transparently restarts the writer
+        ck.save_async(2, fake_net(2.0))
+        assert ck.wait_until_finished(timeout=30.0)
+        assert ck.latest_step() == 2
+        ck.close(timeout=30.0)
+
+    def test_restore_missing_explicit_step_raises_value_error(
+            self, tmp_path):
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        ck.save(1, fake_net(1.0))
+        with pytest.raises(ValueError, match="no checkpoint recorded"):
+            ck.restore(fake_net(0.0), step=99)
+
+    def test_async_metrics_and_event(self, tmp_path):
+        m = observe.metrics()
+        saves0 = m.counter("dl4j_tpu_ckpt_async_saves_total").value
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        ck.save_async(1, fake_net(1.0))
+        assert ck.wait_until_finished(timeout=60.0)
+        assert m.counter("dl4j_tpu_ckpt_async_saves_total").value > saves0
+        assert m.histogram("dl4j_tpu_ckpt_write_seconds").count > 0
+        assert int(m.gauge("dl4j_tpu_ckpt_queue_depth").value) == 0
+
+
+# ---------------------------------------------------------------------------
+# retention (the keep_last newest-intact satellite bugfix)
+# ---------------------------------------------------------------------------
+class TestRetention:
+    def test_keep_last_prunes_oldest(self, tmp_path):
+        ck = TrainingCheckpointer(str(tmp_path), keep_last=2,
+                                  use_orbax=False)
+        for i in (1, 2, 3, 4):
+            ck.save(i, fake_net(float(i)))
+        steps = [s for s, _, _ in ck._saved]
+        assert steps == [3, 4]
+        assert not os.path.exists(os.path.join(str(tmp_path), "step_1.npz"))
+
+    def test_eviction_never_deletes_only_restorable(self, tmp_path):
+        """Steps 4 and 5 are torn post-publish; pruning to keep_last=2
+        must evict the CORRUPT newer entries before the intact step 3 —
+        the pre-fix code deleted 3 (the oldest) and left nothing
+        restorable."""
+        ck = TrainingCheckpointer(str(tmp_path), keep_last=2,
+                                  use_orbax=False)
+        ck.save(3, fake_net(3.0))
+        faults.arm("checkpoint_torn_write", prob=1.0, max_fires=2)
+        ck.save(4, fake_net(4.0))
+        ck.save(5, fake_net(5.0))
+        faults.reset()
+        steps = sorted(s for s, _, _ in ck._saved)
+        assert 3 in steps, "the only intact checkpoint was evicted"
+        assert len(steps) == 2
+        net = fake_net(0.0)
+        assert ck.restore(net) == 3
+        assert float(net.params["W"][0, 0]) == 3.0
+
+    def test_queued_async_writes_do_not_count_toward_keep_last(
+            self, tmp_path):
+        """In-flight-aware retention: only COMPLETED checkpoints fill the
+        keep_last budget — a queued write must never justify deleting a
+        durable one."""
+        ck = TrainingCheckpointer(str(tmp_path), keep_last=2,
+                                  use_orbax=False, max_queue=4)
+        ck.save(1, fake_net(1.0))
+        ck.save(2, fake_net(2.0))
+        for i in (3, 4):
+            ck.save_async(i, fake_net(float(i)))
+        assert ck.wait_until_finished(timeout=60.0)
+        steps = sorted(s for s, _, _ in ck._saved)
+        assert len(steps) == 2 and steps[-1] == 4
+        assert ck.restore(fake_net(0.0)) == 4
+
+    def test_old_marker_without_cursor_still_loads(self, tmp_path):
+        """A checkpoint written before the data-cursor field restores with
+        the net's current cursor (like the pre-RNG compat path)."""
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        net = fake_net(5.0)
+        state = ck._state_of(net)
+        state.pop("data_cursor")
+        ck._write_and_record(5, state)
+        ck2 = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        target = fake_net(0.0)
+        target.batch_in_epoch = 3
+        assert ck2.restore(target) == 5
+        assert target.batch_in_epoch == 3  # kept, not clobbered
+
+
+# ---------------------------------------------------------------------------
+# exact resume (the tentpole)
+# ---------------------------------------------------------------------------
+class TestExactResume:
+    @pytest.mark.parametrize("kill_at", [1, 3, 7, 11])
+    def test_kill_at_every_k_bit_exact(self, tmp_path, kill_at):
+        """Kill the fit with the injected ``preemption`` fault after
+        ``kill_at`` steps; the supervised resume must replay to the
+        oracle's exact per-step losses and final params, paying zero
+        ``new_shape`` recompiles."""
+        x, y = xy(64)
+        oracle = build_mln()
+        col_o = CollectScoresIterationListener()
+        oracle.set_listeners(col_o)
+        oracle.fit(x, y, epochs=3, batch_size=16)  # 4 batches x 3 epochs
+        want = dict(col_o.scores)
+        want_params = oracle.params_flat()
+
+        ns0 = new_shape_events()
+        net = build_mln()
+        col = CollectScoresIterationListener()
+        net.set_listeners(col)
+        ck = TrainingCheckpointer(str(tmp_path / f"k{kill_at}"),
+                                  use_orbax=False)
+        sup = TrainingSupervisor(net, ck, save_every=1, max_restarts=3,
+                                 restart_backoff_s=0.0)
+        faults.arm("preemption", prob=1.0, after_n=kill_at, max_fires=1)
+        status = sup.fit(x, y, epochs=3, batch_size=16)
+        faults.reset()
+        assert status == "completed"
+        assert sup.restarts == 1
+        got = dict(col.scores)
+        assert set(got) == set(want)
+        for it in want:
+            assert got[it] == want[it], f"step {it} loss diverged"
+        assert np.array_equal(want_params, net.params_flat())
+        assert new_shape_events() - ns0 == 0
+
+    def test_cross_process_resume(self, tmp_path):
+        """Graceful preemption, then a FRESH net + checkpointer (the
+        relaunch): the continued run must land on the oracle's exact
+        final params even though the new net started from a different
+        seed."""
+        x, y = xy(64)
+        oracle = build_mln()
+        oracle.fit(x, y, epochs=2, batch_size=16)
+        want = oracle.params_flat()
+
+        class PreemptAt(TrainingListener):
+            def iteration_done(self, model, iteration, epoch, score):
+                if iteration == 3:
+                    faults.request_preemption()
+
+        net = build_mln()
+        net.set_listeners(PreemptAt())
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        sup = TrainingSupervisor(net, ck, save_every=100)  # SIGTERM-only
+        assert sup.fit(x, y, epochs=2, batch_size=16) == "preempted"
+        assert ck.latest_step() == 3  # the final snapshot, not a periodic
+
+        faults.clear_preemption()
+        net2 = build_mln(seed=99)  # restore must overwrite everything
+        ck2 = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        sup2 = TrainingSupervisor(net2, ck2, save_every=100)
+        assert sup2.fit(x, y, epochs=2, batch_size=16) == "completed"
+        assert np.array_equal(want, net2.params_flat())
+
+    def test_resume_counts_and_event(self, tmp_path):
+        m = observe.metrics()
+        r0 = m.counter("dl4j_tpu_ckpt_resumes_total").value
+        x, y = xy(32)
+        net = build_mln()
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        sup = TrainingSupervisor(net, ck, save_every=1,
+                                 restart_backoff_s=0.0)
+        faults.arm("preemption", prob=1.0, after_n=2, max_fires=1)
+        assert sup.fit(x, y, epochs=2, batch_size=16) == "completed"
+        assert m.counter("dl4j_tpu_ckpt_resumes_total").value == r0 + 1
+
+    def test_restart_budget_exhausted_raises(self, tmp_path):
+        x, y = xy(32)
+        net = build_mln()
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        sup = TrainingSupervisor(net, ck, save_every=1, max_restarts=2,
+                                 restart_backoff_s=0.0)
+        faults.arm("preemption", prob=1.0)  # every step, forever
+        with pytest.raises(InjectedFault):
+            sup.fit(x, y, epochs=2, batch_size=16)
+        assert sup.restarts == 3  # 2 within budget + the fatal one
+
+    def test_computation_graph_resume(self, tmp_path):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, graph_builder)
+
+        x, y = xy(48)
+
+        def build_cg(seed=5):
+            conf = (graph_builder().seed(seed)
+                    .updater(nn.Adam(learning_rate=0.02))
+                    .add_inputs("in")
+                    .set_input_types(**{"in": nn.InputType.feed_forward(2)})
+                    .add_layer("d", nn.DenseLayer(n_out=8,
+                                                  activation="tanh"), "in")
+                    .add_layer("out", nn.OutputLayer(
+                        n_out=2, activation="softmax", loss="mcxent"), "d")
+                    .set_outputs("out").build())
+            return ComputationGraph(conf).init()
+
+        oracle = build_cg()
+        oracle.fit(x, y, epochs=2, batch_size=16)
+        want = oracle.params_flat()
+
+        net = build_cg()
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        sup = TrainingSupervisor(net, ck, save_every=1,
+                                 restart_backoff_s=0.0)
+        faults.arm("preemption", prob=1.0, after_n=3, max_fires=1)
+        assert sup.fit(x, y, epochs=2, batch_size=16) == "completed"
+        assert np.array_equal(want, net.params_flat())
+
+    def test_samediff_resume(self, tmp_path):
+        from deeplearning4j_tpu.autodiff.samediff import (
+            SameDiff, TrainingConfig)
+        from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+
+        x, y = xy(64)
+
+        def build_sd():
+            sd = SameDiff.create()
+            xs = sd.placeholder("x", shape=(None, 2))
+            labels = sd.placeholder("labels", shape=(None, 2))
+            w = sd.var("w", np.full((2, 2), 0.1, np.float32))
+            b = sd.var("b", np.zeros((2,), np.float32))
+            logits = (xs.mmul(w) + b).rename("logits")
+            sd.loss.softmax_cross_entropy(logits, labels).rename("loss")
+            sd.set_training_config(TrainingConfig(
+                updater=nn.Adam(learning_rate=0.05),
+                data_set_feature_mapping=["x"],
+                data_set_label_mapping=["labels"],
+                loss_variables=["loss"]))
+            return sd
+
+        it = ListDataSetIterator(DataSet(x, y), batch_size=16)
+        oracle = build_sd()
+        oracle.fit(it, epochs=2)
+        want_w = np.asarray(oracle._arrays["w"])
+        want_b = np.asarray(oracle._arrays["b"])
+
+        sd = build_sd()
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        sup = TrainingSupervisor(sd, ck, save_every=1,
+                                 restart_backoff_s=0.0)
+        faults.arm("preemption", prob=1.0, after_n=5, max_fires=1)
+        assert sup.fit(it, epochs=2) == "completed"
+        assert sup.restarts == 1
+        assert np.array_equal(want_w, np.asarray(sd._arrays["w"]))
+        assert np.array_equal(want_b, np.asarray(sd._arrays["b"]))
+        assert sd.epoch_count == 2 and sd.batch_in_epoch == 0
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM / graceful preemption
+# ---------------------------------------------------------------------------
+class TestSigterm:
+    def test_sigterm_sets_flag_and_snapshots(self, tmp_path):
+        """A real SIGTERM mid-fit: the installed handler flips the
+        graceful flag, the fit loop takes one final SYNCHRONOUS snapshot
+        and exits cleanly, and the supervisor reports 'preempted'."""
+        x, y = xy(64)
+        net = build_mln()
+
+        class KillAt(TrainingListener):
+            def iteration_done(self, model, iteration, epoch, score):
+                if iteration == 2:
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+        net.set_listeners(KillAt())
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        sup = TrainingSupervisor(net, ck, save_every=100,
+                                 install_sigterm=True)
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            assert sup.fit(x, y, epochs=3, batch_size=16) == "preempted"
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+        # the handler-owning supervisor CLEARS the flag on exit, so a
+        # later fit in a surviving process is not stillborn
+        assert not faults.preemption_requested()
+        # the snapshot landed at the interrupted step, durable + intact
+        assert ck.latest_step() == 2
+        net2 = fake = build_mln(seed=1)
+        ck2 = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        assert ck2.restore(net2) == 2
+        assert net2.iteration_count == 2
+
+    def test_handler_restored_after_fit(self, tmp_path):
+        x, y = xy(32)
+        net = build_mln()
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        sup = TrainingSupervisor(net, ck, install_sigterm=True)
+        prev = signal.getsignal(signal.SIGTERM)
+        sup.fit(x, y, epochs=1, batch_size=16)
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+    def test_preemption_flag_cleared_by_faults_reset(self):
+        faults.request_preemption()
+        assert faults.preemption_requested()
+        faults.reset()
+        assert not faults.preemption_requested()
+
+    def test_preempt_metric_counted(self, tmp_path):
+        m = observe.metrics()
+        p0 = m.counter("dl4j_tpu_train_preemptions_total").value
+        x, y = xy(32)
+        net = build_mln()
+
+        class PreemptAt(TrainingListener):
+            def iteration_done(self, model, iteration, epoch, score):
+                faults.request_preemption()
+
+        net.set_listeners(PreemptAt())
+        net.fit(x, y, epochs=1, batch_size=16)
+        assert m.counter("dl4j_tpu_train_preemptions_total").value == p0 + 1
+
+
+# ---------------------------------------------------------------------------
+# threaded save/restore race
+# ---------------------------------------------------------------------------
+class TestThreadedRace:
+    def test_concurrent_save_restore_invariants(self, tmp_path):
+        """A save_async storm from one thread racing restores from
+        another. check-style invariants: every restore lands on a step
+        whose params CONSISTENTLY encode that step (no torn mixes), the
+        marker stays parseable, and the final drain leaves a restorable
+        newest checkpoint."""
+        ck = TrainingCheckpointer(str(tmp_path), keep_last=3,
+                                  use_orbax=False, max_queue=2)
+        stop = threading.Event()
+        errors = []
+
+        def saver():
+            step = 0
+            while not stop.is_set():
+                step += 1
+                try:
+                    ck.save_async(step, fake_net(float(step)))
+                except CheckpointWriteError as e:
+                    errors.append(e)
+                time.sleep(0.001)
+            ck.wait_until_finished(timeout=60.0)
+
+        def restorer():
+            while not stop.is_set():
+                net = fake_net(0.0)
+                got = ck.restore(net)
+                if got is not None:
+                    w = np.asarray(net.params["W"])
+                    # payload consistency: a restore is all-one-step
+                    if not (w == float(got)).all():
+                        errors.append(
+                            AssertionError(f"mixed restore at {got}"))
+                    if net.iteration_count != got:
+                        errors.append(
+                            AssertionError(f"cursor mismatch at {got}"))
+                time.sleep(0.002)
+
+        ts = threading.Thread(target=saver)
+        tr = threading.Thread(target=restorer)
+        ts.start(); tr.start()
+        time.sleep(0.8)
+        stop.set()
+        ts.join(timeout=30); tr.join(timeout=30)
+        assert not ts.is_alive() and not tr.is_alive()
+        assert not errors, errors[:3]
+        # after the dust settles: marker parseable, newest restorable
+        ck2 = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        net = fake_net(0.0)
+        got = ck2.restore(net)
+        assert got is not None
+        assert (np.asarray(net.params["W"]) == float(got)).all()
+
+
+# ---------------------------------------------------------------------------
+# listener satellites
+# ---------------------------------------------------------------------------
+class TestCheckpointListener:
+    def test_final_save_when_boundary_missed(self, tmp_path):
+        """every_n_iterations=4 over 6 steps: the old listener lost steps
+        5-6; fit_done must save the tail."""
+        x, y = xy(96)  # 6 batches of 16
+        net = build_mln()
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        net.set_listeners(CheckpointTrainingListener(
+            ck, every_n_iterations=4))
+        net.fit(x, y, epochs=1, batch_size=16)
+        assert ck.latest_step() == 6  # tail checkpoint, not just step 4
+        steps = sorted(s for s, _, _ in ck._saved)
+        assert 4 in steps
+
+    def test_no_duplicate_final_save_on_boundary(self, tmp_path):
+        x, y = xy(64)  # 4 batches of 16
+        net = build_mln()
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        lst = CheckpointTrainingListener(ck, every_n_iterations=4)
+        net.set_listeners(lst)
+        m = observe.metrics()
+        saves0 = m.counter("dl4j_tpu_checkpoint_saves_total").value
+        net.fit(x, y, epochs=1, batch_size=16)
+        # step 4 hit the boundary; fit_done must NOT save step 4 again
+        assert m.counter("dl4j_tpu_checkpoint_saves_total").value \
+            == saves0 + 1
+
+    def test_iteration_done_resilient_to_raise(self, tmp_path, caplog):
+        """A raising checkpointer warns ONCE and training continues."""
+
+        class Exploding(TrainingCheckpointer):
+            def save(self, step, net):
+                raise IOError("disk on fire")
+
+            def save_async(self, step, net):
+                raise IOError("disk on fire")
+
+        x, y = xy(64)
+        net = build_mln()
+        lst = CheckpointTrainingListener(
+            Exploding(str(tmp_path), use_orbax=False), every_n_iterations=1)
+        net.set_listeners(lst)
+        import logging
+
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.parallel.checkpoint"):
+            net.fit(x, y, epochs=2, batch_size=16)  # must not raise
+        warns = [r for r in caplog.records
+                 if "training continues WITHOUT durability" in r.message]
+        assert len(warns) == 1  # warn-once
+        assert net.iteration_count == 8  # training completed
+
+    def test_fit_done_compensates_failed_tail_write(self, tmp_path):
+        """last_saved_iteration advances on async SUBMISSION; if that
+        tail write dies in the background, fit_done must detect it and
+        take a synchronous compensating save — the run keeps its tail."""
+        x, y = xy(32)  # 2 batches of 16
+        net = build_mln()
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        lst = CheckpointTrainingListener(ck, every_n_iterations=1,
+                                         asynchronous=True)
+        net.set_listeners(lst)
+        # the LAST async write (step 2) dies in the writer thread
+        faults.arm("worker_death", prob=1.0, after_n=1, max_fires=1)
+        net.fit(x, y, epochs=1, batch_size=16)
+        faults.reset()
+        assert ck.wait_until_finished(timeout=60.0)
+        assert ck.latest_step() == 2  # compensating sync save landed
+        assert ck.restore(build_mln(seed=1)) == 2
+
+    def test_cg_tbptt_checkpoints_only_at_batch_boundary(self, tmp_path):
+        """ComputationGraph tbptt fires listeners per SEGMENT; the
+        checkpoint listener must skip those (mid-batch state has a live
+        RNN carry and a stale cursor — not exactly resumable) and save
+        once at the batch boundary with the updated cursor."""
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, graph_builder)
+
+        r = np.random.RandomState(0)
+        x = r.randn(4, 9, 3).astype(np.float32)
+        y = np.eye(2)[r.randint(0, 2, (4, 9))].astype(np.float32)
+        b = (graph_builder().seed(9).updater(nn.Sgd(learning_rate=0.05))
+             .add_inputs("in")
+             .set_input_types(**{"in": nn.InputType.recurrent(3, -1)}))
+        b.add_layer("lstm", nn.LSTM(n_in=3, n_out=5, activation="tanh"),
+                    "in")
+        b.add_layer("out", nn.RnnOutputLayer(n_in=5, n_out=2,
+                                             activation="softmax",
+                                             loss="mcxent"), "lstm")
+        b.set_outputs("out")
+        conf = b.build()
+        conf.backprop_type = "tbptt"
+        conf.tbptt_fwd_length = 3
+        conf.tbptt_back_length = 3
+        net = ComputationGraph(conf).init()
+        ck = TrainingCheckpointer(str(tmp_path), keep_last=None,
+                                  use_orbax=False)
+        net.set_listeners(CheckpointTrainingListener(
+            ck, every_n_iterations=1))
+        net.fit(x, y, epochs=1, batch_size=4)  # 1 batch, 3 segments
+        # exactly ONE periodic save (batch boundary), not one per segment
+        steps = [s for s, _, _ in ck._saved]
+        assert len(steps) == 1, steps
+        fresh = ComputationGraph(conf).init()
+        assert ck.restore(fresh) == steps[0]
+        # the boundary save recorded the POST-batch cursor — resume
+        # skips the completed batch instead of double-applying it
+        assert fresh.batch_in_epoch == 1
+        assert fresh.iteration_count == net.iteration_count
+
+    def test_observe_summary_training_section(self, tmp_path):
+        x, y = xy(32)
+        net = build_mln()
+        ck = TrainingCheckpointer(str(tmp_path), use_orbax=False)
+        net.set_listeners(CheckpointTrainingListener(
+            ck, every_n_iterations=1, asynchronous=True))
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck.wait_until_finished(timeout=60.0)
+        s = observe.summary()
+        assert "training" in s
+        assert s["training"]["async_saves"] >= 1

@@ -26,7 +26,14 @@ supervised-degradation contract instead of trusting it:
     leaves every request terminal with greedy outputs still equal to the
     non-speculative oracle (supervised retries restart from the prompt —
     lossless), draft/target lengths in agreement, and zero ``new_shape``
-    (docs/SERVING.md § Speculative decoding).
+    (docs/SERVING.md § Speculative decoding);
+  * TRAINING killed mid-fit (torn checkpoint writes + an async-writer
+    death + hard ``preemption`` kills) resumes to a BIT-EXACT loss/param
+    trajectory vs the uninterrupted oracle with zero ``new_shape``
+    (docs/ROBUSTNESS.md § Preemption-proof training). ``--leg training``
+    runs ONLY this leg plus the async-overhead measurement and emits a
+    ``"tool": "trainchaos"`` line (the ``trainchaos`` gate stage /
+    ``make train-chaos-smoke``).
 
 Contract (same as lint/check/obs/tune): ONE JSON summary line on stdout
 with ``"tool": "chaos"``; exit 0 iff ``ok``. ``make chaos-smoke`` pins
@@ -355,6 +362,222 @@ def run_spec_chaos():
     }
 
 
+def _train_net(seed=7, hidden=16, feat=2, depth=1):
+    from deeplearning4j_tpu import nn
+
+    b = (nn.builder().seed(seed).updater(nn.Adam(learning_rate=0.02))
+         .weight_init("xavier").list())
+    for _ in range(depth):
+        b = b.layer(nn.DenseLayer(n_out=hidden, activation="tanh"))
+    return nn.MultiLayerNetwork(
+        b.layer(nn.OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+        .set_input_type(nn.InputType.feed_forward(feat)).build()).init()
+
+
+def _train_data(n=96, seed=0, feat=2):
+    r = np.random.RandomState(seed)
+    x = r.rand(n, feat).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), r.randint(0, 2, n)] = 1.0
+    return x, y
+
+
+def run_training_chaos():
+    """The preemption-proof-training leg (docs/ROBUSTNESS.md §
+    Preemption-proof training): a supervised MLN fit under torn
+    checkpoint writes, an async-writer worker death, and hard
+    ``preemption`` kills mid-fit. The contract: the resumed loss/param
+    trajectory is BIT-EXACT against the uninterrupted oracle, every
+    checkpoint on disk is intact or detectably corrupt (restore's sha256
+    verify), recovery pays zero ``new_shape`` recompiles, and a writer
+    death costs one checkpoint, never the run."""
+    from deeplearning4j_tpu import faults, observe
+    from deeplearning4j_tpu.nn.listeners import CollectScoresIterationListener
+    from deeplearning4j_tpu.parallel import (
+        TrainingCheckpointer, TrainingSupervisor)
+
+    x, y = _train_data()
+    epochs, batch = 3, 16  # 96/16 = 6 exact batches — one jit signature
+
+    # the uninterrupted oracle trajectory
+    oracle = _train_net()
+    col_o = CollectScoresIterationListener()
+    oracle.set_listeners(col_o)
+    oracle.fit(x, y, epochs=epochs, batch_size=batch)
+    want_scores = dict(col_o.scores)
+    want_params = oracle.params_flat()
+
+    new_shape_before = sum(1 for e in observe.ledger().events()
+                           if e.graph == "mln" and e.cause == "new_shape")
+    m = observe.metrics()
+
+    def fired(point):
+        return int(m.counter("dl4j_tpu_faults_injected_total",
+                             point=point).value)
+
+    before = {p: fired(p) for p in
+              ("preemption", "checkpoint_torn_write", "worker_death")}
+
+    net = _train_net()
+    col = CollectScoresIterationListener()
+    net.set_listeners(col)
+    warned = []
+    with tempfile.TemporaryDirectory(prefix="chaos_train_") as d:
+        ck = TrainingCheckpointer(d, keep_last=3, use_orbax=False)
+        sup = TrainingSupervisor(net, ck, save_every=1, max_restarts=6,
+                                 restart_backoff_s=0.01)
+        # the schedule: the 2nd durable write is torn post-publish, the
+        # 4th write attempt dies in the WRITER thread (surfaced on the
+        # next save — the listener warns once and keeps training), and
+        # two hard preemption kills land mid-fit
+        faults.arm("checkpoint_torn_write", prob=1.0, after_n=1,
+                   max_fires=1)
+        faults.arm("worker_death", prob=1.0, after_n=3, max_fires=1)
+        faults.arm("preemption", prob=1.0, after_n=4, max_fires=2)
+        try:
+            status = sup.fit(x, y, epochs=epochs, batch_size=batch)
+        finally:
+            faults.reset()
+        ck.wait_until_finished(timeout=60.0)
+        entries = list(ck._saved)
+        intact = sum(1 for _, p, c in entries if ck._verify(p, c))
+        detected = len(entries) - intact
+
+    got_scores = dict(col.scores)
+    traj_exact = (set(got_scores) == set(want_scores) and all(
+        got_scores[i] == want_scores[i] for i in want_scores))
+    params_exact = bool(np.array_equal(want_params, net.params_flat()))
+    new_shape = sum(1 for e in observe.ledger().events()
+                    if e.graph == "mln"
+                    and e.cause == "new_shape") - new_shape_before
+    fires = {p: fired(p) - before[p] for p in before}
+    resumes = sup.restarts
+    corrupt_seen = int(m.counter("dl4j_tpu_checkpoint_corrupt_total").value)
+    return {
+        "status": status,
+        "steps": len(got_scores),
+        "resumes": resumes,
+        "fired": fires,
+        "trajectory_bit_exact": traj_exact,
+        "params_bit_exact": params_exact,
+        "new_shape_events": new_shape,
+        "checkpoints_on_disk": len(entries),
+        "checkpoints_intact": intact,
+        "checkpoints_detected_corrupt": detected,
+        "corrupt_total_seen": corrupt_seen,
+        # every surviving checkpoint is intact or DETECTABLY corrupt by
+        # construction (intact + detected == on-disk); the load-bearing
+        # claims are bit-exactness, >=1 restorable checkpoint, and that
+        # all three fault classes actually fired
+        "ok": (status == "completed" and traj_exact and params_exact
+               and new_shape == 0 and resumes >= 1
+               and fires["preemption"] >= 1
+               and fires["checkpoint_torn_write"] >= 1
+               and fires["worker_death"] >= 1
+               and intact >= 1),
+    }
+
+
+def run_training_overhead(steps=16, repeats=3, hidden=384, batch=64,
+                          _retries=1):
+    """The async-checkpoint cost story: per-step overhead of every-step
+    ASYNC checkpointing must be < 10% of every-step SYNCHRONOUS saving on
+    the same workload — the training thread pays one device_get, not the
+    fsync dance. The step must carry real XLA compute (hidden=384,
+    batch=64, two dense layers): a microscopic GIL-bound step would bill
+    writer-thread CPU contention — cost the accelerator never sees — to
+    the async path. Per-step medians within a trial, best-of-N across
+    paired trials, one retry round on a miss (timing gates on shared CI
+    hosts need the same noise armor the other paired-trial stages have);
+    an absolute sub-millisecond floor absorbs fast-disk noise on the
+    sync baseline."""
+    from deeplearning4j_tpu.parallel import (
+        CheckpointTrainingListener, TrainingCheckpointer)
+
+    x, y = _train_data(n=steps * batch, seed=1, feat=16)
+
+    class _StepTimer:
+        """Per-step host sync + per-step timing in EVERY leg: fit
+        pipelines its dispatches, but a checkpoint snapshot forces the
+        step to complete — without the sync the base leg would get the
+        wait for free and the comparison would bill compute time to the
+        checkpoint path. Recording PER-STEP durations (instead of one
+        epoch mean) lets the median discard GC pauses and ambient-load
+        spikes."""
+
+        def __init__(self):
+            self.durations = []
+            self._prev = None
+
+        def iteration_done(self, model, iteration, epoch, score):
+            float(score)
+            now = time.perf_counter()
+            if self._prev is not None:
+                self.durations.append(now - self._prev)
+            self._prev = now
+
+        def on_epoch_start(self, model):
+            self._prev = None
+
+        def on_epoch_end(self, model):
+            pass
+
+    def timed_epoch(listener):
+        net = _train_net(hidden=hidden, feat=16, depth=2)
+        timer = _StepTimer()
+        listeners = [timer]
+        if listener is not None:
+            listeners.append(listener)
+        net.set_listeners(*listeners)
+        net.fit(x, y, epochs=1,
+                batch_size=batch)  # warm: compile + first saves
+        timer.durations = []
+        net.fit(x, y, epochs=1, batch_size=batch)
+        d = sorted(timer.durations)
+        return d[len(d) // 2]  # median step time within the trial
+
+    base_s, sync_s, async_s = [], [], []
+    for _ in range(repeats):
+        with tempfile.TemporaryDirectory(prefix="chaos_ovh_") as d:
+            base_s.append(timed_epoch(None))
+            ck_sync = TrainingCheckpointer(
+                os.path.join(d, "sync"), use_orbax=False)
+            sync_s.append(timed_epoch(CheckpointTrainingListener(
+                ck_sync, every_n_iterations=1, asynchronous=False)))
+            ck_async = TrainingCheckpointer(
+                os.path.join(d, "async"), use_orbax=False)
+            async_s.append(timed_epoch(CheckpointTrainingListener(
+                ck_async, every_n_iterations=1, asynchronous=True)))
+            ck_async.close(timeout=60.0)  # no leaked writer per trial
+    # best-of-N across trials: the least-contended trial is the closest
+    # estimate of the true cost — ambient load only ever inflates
+    base = min(base_s)
+    sync = min(sync_s)
+    asy = min(async_s)
+    ovh_sync = max(0.0, sync - base)
+    ovh_async = max(0.0, asy - base)
+    ratio = (ovh_async / ovh_sync) if ovh_sync > 0 else None
+    ok = ovh_async < 0.10 * ovh_sync or ovh_async < 5e-4
+    if not ok and _retries > 0:
+        again = run_training_overhead(steps=steps, repeats=repeats,
+                                      hidden=hidden, batch=batch,
+                                      _retries=_retries - 1)
+        if again["ok"]:
+            again["retried"] = True
+            return again
+    return {
+        "steps_per_trial": steps, "trials": repeats,
+        "base_step_ms": round(base * 1e3, 3),
+        "sync_step_ms": round(sync * 1e3, 3),
+        "async_step_ms": round(asy * 1e3, 3),
+        "sync_overhead_ms": round(ovh_sync * 1e3, 3),
+        "async_overhead_ms": round(ovh_async * 1e3, 3),
+        "overhead_ratio": None if ratio is None else round(ratio, 4),
+        "ok": bool(ok),
+    }
+
+
 def run_checkpoint_chaos():
     """The durability leg: three saves, the newest torn; restore must fall
     back to the last intact checkpoint with the right parameters."""
@@ -387,16 +610,42 @@ def main() -> int:
                     help="machine-readable: exactly one JSON line on stdout")
     ap.add_argument("--requests", type=int, default=10)
     ap.add_argument("--tokens", type=int, default=8)
+    ap.add_argument("--leg", choices=("all", "training"), default="all",
+                    help="'training' runs ONLY the preemption-proof "
+                         "training leg and emits a \"tool\": "
+                         "\"trainchaos\" line (the trainchaos gate stage)")
     args = ap.parse_args()
 
     from deeplearning4j_tpu import faults, observe
 
     t0 = time.perf_counter()
+    if args.leg == "training":
+        training = run_training_chaos()
+        overhead = run_training_overhead()
+        ok = bool(training["ok"] and overhead["ok"])
+        rec = {
+            "tool": "trainchaos", "ok": ok,
+            "training": training, "overhead": overhead,
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }
+        print(json.dumps(rec), flush=True)
+        if not args.json:
+            print(f"trainchaos: {'OK' if ok else 'FAIL'} — "
+                  f"{training['steps']} steps, {training['resumes']} "
+                  f"resumes, fired {training['fired']}, bit-exact "
+                  f"{training['trajectory_bit_exact']}, async overhead "
+                  f"{overhead['async_overhead_ms']}ms vs sync "
+                  f"{overhead['sync_overhead_ms']}ms "
+                  f"(ratio {overhead['overhead_ratio']})",
+                  file=sys.stderr)
+        return 0 if ok else 1
+
     serving = run_serving_chaos(args.requests, args.tokens)
     ckpt = run_checkpoint_chaos()
     frontend = run_frontend_chaos()
     prefix = run_prefix_chaos()
     spec = run_spec_chaos()
+    training = run_training_chaos()
     m = observe.metrics()
     faults_total = int(m.family_total("dl4j_tpu_faults_injected_total"))
     by_point = {}
@@ -404,10 +653,10 @@ def main() -> int:
         if inst.name == "dl4j_tpu_faults_injected_total" and inst.labels:
             by_point[dict(inst.labels).get("point")] = int(inst.value)
     # the acceptance-criterion points must all have actually fired — a
-    # chaos run that never hit the pool, the decode step, the checkpoint
-    # AND the frontend's burst hook proved nothing
+    # chaos run that never hit the pool, the decode step, the checkpoint,
+    # the frontend's burst hook AND the training preemption proved nothing
     required = ("page_oom", "decode_step_error", "checkpoint_torn_write",
-                "burst_arrival")
+                "burst_arrival", "preemption")
     missing = [p for p in required if not by_point.get(p)]
 
     ok = (serving["unresolved"] == 0
@@ -422,6 +671,7 @@ def main() -> int:
           and frontend["new_shape_events"] == 0
           and prefix["ok"]
           and spec["ok"]
+          and training["ok"]
           and faults_total > 0
           and not missing)
 
@@ -435,6 +685,7 @@ def main() -> int:
         "frontend": frontend,
         "prefix": prefix,
         "spec": spec,
+        "training": training,
         "elapsed_s": round(time.perf_counter() - t0, 2),
     }
     print(json.dumps(rec), flush=True)
