@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke slo-smoke prefix-smoke spec-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke cluster-chaos-smoke slo-smoke prefix-smoke spec-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -64,6 +64,17 @@ chaos-smoke:
 # baseline per step. ONE JSON line like lint/check/obs/chaos.
 train-chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos.py --json --leg training
+
+# cluster-failure-domain smoke (docs/ROBUSTNESS.md § Cluster failure
+# domains): three engines behind the ClusterRouter under a past-capacity
+# burst, one hard-killed mid-flight by engine_death — fails unless every
+# request reaches a terminal state, >= 1 in-flight request migrates with
+# its greedy output token-for-token identical to the single-engine
+# oracle, goodput degrades no worse than proportionally to the capacity
+# lost, and survivors show zero new_shape ledger events. ONE JSON line
+# like lint/check/obs/chaos.
+cluster-chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/chaos.py --json --leg cluster
 
 # SLO smoke (docs/SERVING.md § SLO admission frontend): the goodput-
 # under-overload ramp, frontend on vs off with an identical offered

@@ -65,6 +65,11 @@ FAULTS_ENV = "DL4J_TPU_FAULTS"
 #:                         (a hard TPU-pod preemption: no snapshot chance);
 #:                         worker_death ALSO fires inside the async
 #:                         checkpoint writer thread (parallel/checkpoint.py)
+#:   engine_death          serving/engine.py _serve_loop     -> raise with the
+#:                         restart budget spent first: a HARD unrestartable
+#:                         kill of the whole engine (vs worker_death, which
+#:                         the supervisor absorbs). The cluster router's
+#:                         failure domain (serving/cluster.py).
 FAULT_POINTS = (
     "page_oom",
     "decode_step_error",
@@ -74,6 +79,7 @@ FAULT_POINTS = (
     "backend_init_fail",
     "burst_arrival",
     "preemption",
+    "engine_death",
 )
 
 

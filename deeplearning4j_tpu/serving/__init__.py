@@ -45,6 +45,15 @@ transformer (``models/gpt.py``) served through
   overload becomes goodput management instead of a failure mode
   (``serving/overload.py`` measures it; ``make slo-smoke`` gates it).
 
+* :class:`ClusterRouter` (``serving/cluster.py``) — N engines behind one
+  health- and prefix-affinity-routed front: whole-engine death (restart
+  budget spent, or the hard ``engine_death`` fault) becomes a managed
+  failure domain — in-flight retryable requests migrate to survivors at
+  queue front with their original submit time and priority, pinned
+  prefixes re-warm on the destination, and the frontend's per-engine
+  circuit breaker quarantines only the dead engine (``make
+  cluster-chaos-smoke`` gates it).
+
 Serve it directly or through the ``ParallelInference.generative`` facade
 (``parallel/mesh.py``). ``BENCH_MODEL=generate`` (bench.py) measures
 tokens/sec with p50/p99 TTFT and inter-token latency;
@@ -53,6 +62,7 @@ tokens/sec with p50/p99 TTFT and inter-token latency;
 """
 
 from deeplearning4j_tpu.serving.cache import PagedKVCache
+from deeplearning4j_tpu.serving.cluster import ClusterRouter
 from deeplearning4j_tpu.serving.engine import GenerativeEngine
 from deeplearning4j_tpu.serving.prefix import PrefixMatch, RadixPrefixCache
 from deeplearning4j_tpu.serving.frontend import (
@@ -75,7 +85,7 @@ from deeplearning4j_tpu.serving.speculative import (
 )
 
 __all__ = [
-    "PagedKVCache", "GenerativeEngine", "sample_tokens",
+    "PagedKVCache", "GenerativeEngine", "ClusterRouter", "sample_tokens",
     "GenerationRequest", "GenerationResult", "SlotScheduler",
     "FINISH_REASONS", "SLOFrontend", "ClassPolicy", "LadderThresholds",
     "OVERLOAD_STATES", "default_classes", "RadixPrefixCache",

@@ -71,7 +71,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -117,7 +117,8 @@ class GenerativeEngine:
                  suffix_bucket: Optional[int] = None,
                  prefix_min_match: Optional[int] = None,
                  spec_k: int = 0,
-                 draft_model: Optional[GptModel] = None):
+                 draft_model: Optional[GptModel] = None,
+                 engine_id: int = 0):
         cfg = model.cfg
         if cfg.hidden % cfg.heads:
             raise ValueError("hidden must be divisible by heads")
@@ -223,6 +224,14 @@ class GenerativeEngine:
         self.default_deadline_s = default_deadline_s
         self.restarts = 0            # lifetime crash recoveries (<= cap)
         self.stopped_cleanly = True  # last stop() joined its worker in time
+        # ------------------------------------------------- cluster membership
+        # engine_id names this engine inside a ClusterRouter
+        # (serving/cluster.py); on_unrecoverable, when set, is called ONCE
+        # from the dying worker thread after the restart budget is spent —
+        # the router's hook drains this scheduler and migrates retryable
+        # requests to a surviving engine BEFORE fail_all retires the rest.
+        self.engine_id = int(engine_id)
+        self.on_unrecoverable: Optional[Callable[[Exception], None]] = None
         self._lifecycle = threading.Lock()  # guards _worker hand-off
         m = observe.metrics()
         self._obs = {
@@ -451,8 +460,7 @@ class GenerativeEngine:
                 self.step()
             except Exception as e:
                 if not self._recover(e):
-                    self._error = e
-                    self.scheduler.fail_all(e)
+                    self._die(e)
                     raise
         return [f.result() for f in futs]
 
@@ -521,6 +529,13 @@ class GenerativeEngine:
                 time.sleep(1e-3)
                 continue
             try:
+                if faults.should_fire("engine_death"):
+                    # a HARD whole-engine kill: spend the restart budget
+                    # first so _recover cannot resurrect the worker — the
+                    # cluster router (serving/cluster.py) owns this
+                    # failure domain, not the supervisor
+                    self.restarts = self.max_restarts
+                    raise faults.InjectedFault("engine_death")
                 faults.maybe_fail("worker_death")
                 self.step()
             except Exception as e:
@@ -538,11 +553,53 @@ class GenerativeEngine:
                         self._worker.start()
                     return
                 logger.exception("serving loop died (unrecoverable)")
-                self._error = e
-                self.scheduler.fail_all(e)
+                self._die(e)
                 return
 
     # ------------------------------------------------------------ supervisor
+    def _die(self, exc: Exception) -> None:
+        """Unrecoverable escalation: mark the engine dead, give a cluster
+        router's ``on_unrecoverable`` hook one shot at migrating this
+        scheduler's requests onto a surviving engine (the hook runs on the
+        dying worker thread, after the last step — nothing races it), then
+        fail whatever the hook left behind. Without a hook this is exactly
+        the old fail-everything path."""
+        self._error = exc
+        observe.log_event("engine_dead", engine=self.engine_id,
+                          restarts=self.restarts, error=repr(exc))
+        hook = self.on_unrecoverable
+        if hook is not None:
+            try:
+                hook(exc)
+            except Exception:
+                logger.exception("on_unrecoverable hook failed; failing "
+                                 "the remaining requests terminally")
+        self.scheduler.fail_all(exc)
+
+    def adopt_requests(self, items: Sequence[tuple]) -> None:
+        """Splice migrated ``(request, future, submit_t)`` tuples — a dead
+        sibling's in-flight and queued work, handed over by the cluster
+        router — onto the FRONT of the pending queue, preserving their
+        order. The tuples keep their ORIGINAL futures, submit times and
+        priorities: deadlines keep counting across the migration and
+        ``peek_best_pending`` ordering never inverts (the PR-10/11
+        re-admission discipline, now cluster-wide). Mirrors
+        :meth:`submit_request`'s post-enqueue race handling so an adopted
+        future can never hang on an engine that died or stopped under us."""
+        items = list(items)
+        if not items:
+            return
+        sched = self.scheduler
+        with sched._plock:
+            # appendleft reverses; iterate reversed so items[0] ends up
+            # at the very front (it was the oldest in-flight request)
+            for item in reversed(items):
+                sched.pending.appendleft(item)
+        if self._error is not None:
+            sched.fail_all(RuntimeError("engine loop died"))
+        elif self._stop_flag:
+            sched.fail_pending(RuntimeError("engine stopped"))
+
     def _finish_unslotted(self, req, fut, reason: str) -> None:
         """Complete a future that never held (or no longer holds) a slot
         with a terminal result: shed at admission, deadline in queue,

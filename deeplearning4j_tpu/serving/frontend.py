@@ -342,10 +342,20 @@ class SLOFrontend:
         # starts (no decode samples yet -> no estimate -> no early shed)
         self._est_tokens = float(est_tokens_per_request)
         self._est_decode_s = est_decode_s
-        # circuit breaker
-        self._seen_restarts = int(getattr(engine, "restarts", 0))
-        self._restart_times: "deque[float]" = deque()
-        self._breaker_open_until = -1.0
+        # circuit breaker — keyed by ENGINE id (docs/ROBUSTNESS.md
+        # § Cluster failure domains): behind a ClusterRouter one thrashing
+        # engine must not fast-fail admissions a healthy sibling could
+        # serve, so window/cooldown state is per engine and the fast-fail
+        # fires only when EVERY routable engine's breaker is open. For a
+        # single engine this reduces exactly to the pre-cluster behavior.
+        self._seen_restarts: Dict[int, int] = {}
+        self._restart_times: Dict[int, "deque[float]"] = {}
+        self._breaker_open_until: Dict[int, float] = {}
+        for i, eng in enumerate(self._cluster_engines()):
+            eid = int(getattr(eng, "engine_id", i))
+            self._seen_restarts[eid] = int(getattr(eng, "restarts", 0))
+            self._restart_times[eid] = deque()
+            self._breaker_open_until[eid] = -1.0
         self.breaker_opens = 0
         # burst_arrival bookkeeping: the injected synthetic arrivals'
         # futures, so harnesses can assert they too reach terminal states.
@@ -409,8 +419,10 @@ class SLOFrontend:
 
             # 1. circuit breaker: a thrashing engine gets NO new work —
             #    fast-fail terminally as "error" instead of queueing into
-            #    a supervisor that keeps dying
-            if now < self._breaker_open_until:
+            #    a supervisor that keeps dying. Per-engine: only when
+            #    EVERY routable engine is open (a cluster with one
+            #    healthy sibling keeps admitting)
+            if self._breaker_open_fraction(now) >= 1.0:
                 return self._deny(policy, "circuit_open", terminal="error",
                                   prompt_len=p_len)
 
@@ -707,35 +719,63 @@ class SLOFrontend:
                     "n/a" if p99 is None else f"{p99 * 1e3:.1f}ms")
 
     # ------------------------------------------------------- circuit breaker
+    def _cluster_engines(self) -> list:
+        """The engines the breaker watches: a ClusterRouter's LIVE
+        members (a dead engine can never restart again — its stale
+        window must not veto the all-open fast-fail), the router's full
+        list when nothing is live, or the single engine itself."""
+        live = getattr(self.engine, "live_engines", None)
+        if callable(live):
+            engs = live()
+            if engs:
+                return list(engs)
+        engs = getattr(self.engine, "engines", None)
+        return list(engs) if engs else [self.engine]
+
+    def _breaker_open_fraction(self, now: float) -> float:
+        engs = self._cluster_engines()
+        n_open = sum(
+            1 for i, e in enumerate(engs)
+            if now < self._breaker_open_until.get(
+                int(getattr(e, "engine_id", i)), -1.0))
+        return n_open / max(1, len(engs))
+
     def _update_breaker(self, now: float) -> None:
-        cur = int(getattr(self.engine, "restarts", 0))
-        if cur > self._seen_restarts:
-            self._restart_times.extend([now] * (cur - self._seen_restarts))
-            self._seen_restarts = cur
-        while (self._restart_times
-               and now - self._restart_times[0] > self.breaker_window_s):
-            self._restart_times.popleft()
-        was_open = now < self._breaker_open_until
-        if (not was_open
-                and len(self._restart_times) >= self.breaker_restarts):
-            self._breaker_open_until = now + self.breaker_cooldown_s
-            self.breaker_opens += 1
-            # consume the window: the breaker re-opens only on NEW
-            # restarts after the cooldown, not on the same thrash burst
-            self._restart_times.clear()
-            observe.log_event(
-                "slo_breaker", action="open",
-                restarts_in_window=self.breaker_restarts,
-                cooldown_s=self.breaker_cooldown_s)
-            logger.warning(
-                "SLO circuit breaker OPEN: %d engine restarts inside %.0fs "
-                "— fast-failing admissions for %.1fs", self.breaker_restarts,
-                self.breaker_window_s, self.breaker_cooldown_s)
-        self._g_breaker.set(1.0 if now < self._breaker_open_until else 0.0)
+        for i, eng in enumerate(self._cluster_engines()):
+            eid = int(getattr(eng, "engine_id", i))
+            times = self._restart_times.setdefault(eid, deque())
+            cur = int(getattr(eng, "restarts", 0))
+            seen = self._seen_restarts.setdefault(eid, cur)
+            if cur > seen:
+                times.extend([now] * (cur - seen))
+            self._seen_restarts[eid] = cur
+            while times and now - times[0] > self.breaker_window_s:
+                times.popleft()
+            was_open = now < self._breaker_open_until.get(eid, -1.0)
+            if not was_open and len(times) >= self.breaker_restarts:
+                self._breaker_open_until[eid] = now + self.breaker_cooldown_s
+                self.breaker_opens += 1
+                # consume the window: the breaker re-opens only on NEW
+                # restarts after the cooldown, not on the same thrash burst
+                times.clear()
+                observe.log_event(
+                    "slo_breaker", action="open", engine=eid,
+                    restarts_in_window=self.breaker_restarts,
+                    cooldown_s=self.breaker_cooldown_s)
+                logger.warning(
+                    "SLO circuit breaker OPEN for engine %d: %d restarts "
+                    "inside %.0fs — fast-failing admissions for %.1fs",
+                    eid, self.breaker_restarts, self.breaker_window_s,
+                    self.breaker_cooldown_s)
+        # the gauge reports the open FRACTION (1.0 == full fast-fail);
+        # single-engine keeps the historical 0.0/1.0 values
+        self._g_breaker.set(self._breaker_open_fraction(now))
 
     @property
     def breaker_open(self) -> bool:
-        return self._clock() < self._breaker_open_until
+        """True when admissions fast-fail: EVERY routable engine's
+        breaker is open (the single-engine degenerate case is unchanged)."""
+        return self._breaker_open_fraction(self._clock()) >= 1.0
 
     # ---------------------------------------------------------- chaos: burst
     def _inject_burst(self) -> None:

@@ -98,6 +98,21 @@ class TestFaultRegistry:
                          point="page_oom").value == 3
         assert m.family_total("dl4j_tpu_faults_injected_total") == 3
 
+    def test_engine_death_point_registered(self):
+        """The 9th catalog entry (serving/cluster.py's failure domain):
+        armable, schedulable, counted like every other point."""
+        assert "engine_death" in faults.FAULT_POINTS
+        faults.arm("engine_death", prob=1.0, max_fires=1)
+        assert faults.should_fire("engine_death")
+        assert not faults.should_fire("engine_death")
+        with pytest.raises(InjectedFault, match="engine_death") as ei:
+            faults.arm("engine_death", prob=1.0)
+            faults.maybe_fail("engine_death")
+        assert ei.value.point == "engine_death"
+        m = observe.metrics()
+        assert m.counter("dl4j_tpu_faults_injected_total",
+                         point="engine_death").value >= 2
+
     def test_invalid_spec_rejected(self):
         with pytest.raises(ValueError, match="prob"):
             FaultSpec(point="page_oom", prob=1.5)

@@ -33,7 +33,16 @@ supervised-degradation contract instead of trusting it:
     (docs/ROBUSTNESS.md § Preemption-proof training). ``--leg training``
     runs ONLY this leg plus the async-overhead measurement and emits a
     ``"tool": "trainchaos"`` line (the ``trainchaos`` gate stage /
-    ``make train-chaos-smoke``).
+    ``make train-chaos-smoke``);
+  * a whole ENGINE hard-killed mid-flight (``engine_death``) inside a
+    3-engine ClusterRouter leaves every request terminal, migrates >= 1
+    in-flight request with greedy output token-for-token identical to
+    the single-engine oracle, degrades goodput no worse than
+    proportionally to the capacity lost, and shows zero ``new_shape``
+    on survivors (docs/ROBUSTNESS.md § Cluster failure domains).
+    ``--leg cluster`` runs ONLY this leg and emits a ``"tool":
+    "cluster"`` line (the ``cluster`` gate stage /
+    ``make cluster-chaos-smoke``).
 
 Contract (same as lint/check/obs/tune): ONE JSON summary line on stdout
 with ``"tool": "chaos"``; exit 0 iff ``ok``. ``make chaos-smoke`` pins
@@ -604,16 +613,129 @@ def run_checkpoint_chaos():
     }
 
 
+def run_cluster_chaos(n_engines=3, n_requests=18, gen_tokens=8):
+    """The cluster leg (docs/ROBUSTNESS.md § Cluster failure domains):
+    ``n_engines`` engines behind a ClusterRouter under a past-capacity
+    burst with a deterministic slow-decode service floor, one engine
+    hard-killed mid-flight by the ``engine_death`` fault. ok iff every
+    request reaches a terminal state, at least one in-flight request
+    migrates, every finished greedy output is token-for-token identical
+    to the single-engine oracle (migrated ones included), goodput
+    degrades no worse than proportionally to the capacity lost (with a
+    CI-noise margin), and survivors show zero ``new_shape`` events."""
+    from deeplearning4j_tpu import faults, observe
+    from deeplearning4j_tpu.models.gpt import (
+        GptConfig, GptModel, reference_generate)
+    from deeplearning4j_tpu.serving import ClusterRouter, GenerativeEngine
+    from deeplearning4j_tpu.serving.scheduler import FINISH_REASONS
+
+    cfg = GptConfig.tiny(vocab_size=256)
+    model = GptModel(cfg, seed=0)
+    r = np.random.RandomState(0)
+    prompts = [r.randint(1, cfg.vocab_size, size=r.randint(2, 10))
+               .astype(np.int32) for _ in range(n_requests)]
+    oracle = [np.asarray(reference_generate(model.params, cfg, p,
+                                            gen_tokens))
+              for p in prompts]
+
+    def serving_new_shape():
+        return sum(1 for e in observe.ledger().events()
+                   if e.graph == "serving" and e.cause == "new_shape")
+
+    def run_leg(kill: bool):
+        engines = [GenerativeEngine(
+            model, max_slots=2, page_size=8, max_pages_per_seq=6,
+            max_prompt=16, seed=0, default_deadline_s=300.0,
+            max_restarts=3, restart_backoff_s=0.01)
+            for _ in range(n_engines)]
+        router = ClusterRouter(engines)
+        for e in engines:  # compile BEFORE the clock (and the kill) start
+            e.generate([prompts[0][:2]], max_new_tokens=2, eos_token=-1)
+        new_shape0 = serving_new_shape()
+        # slow_decode at prob 1.0: a deterministic 50ms service floor on
+        # both legs, so the single-trial goodput comparison is stable
+        faults.arm("slow_decode", prob=1.0, seed=2)
+        if kill:
+            # fires on the (3*n_engines+1)-th busy loop iteration across
+            # the cluster — mid-flight, while slots are held
+            faults.arm("engine_death", prob=1.0, after_n=3 * n_engines,
+                       max_fires=1)
+        router.start()
+        t0 = time.perf_counter()
+        futs = [router.submit(p, max_new_tokens=gen_tokens, eos_token=-1,
+                              max_retries=4) for p in prompts]
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        live_after = len(router.live_engines())  # before stop() flags them
+        router.stop()
+        faults.reset()
+        reasons: dict = {}
+        for res in results:
+            reasons[res.finish_reason] = reasons.get(res.finish_reason,
+                                                     0) + 1
+        done_tokens = sum(len(res.tokens) for res in results
+                          if res.finish_reason in ("eos", "length"))
+        bit_exact = all(
+            res.finish_reason not in ("eos", "length")
+            or np.array_equal(res.tokens, oracle[i][:len(res.tokens)])
+            for i, res in enumerate(results))
+        router.check_invariants()
+        return {
+            "submitted": len(futs),
+            "terminal": len(results),
+            "unresolved": sum(1 for f in futs if not f.done()),
+            "reasons": reasons,
+            "bad_reasons": [k for k in reasons if k not in FINISH_REASONS],
+            "deaths": router.deaths,
+            "migrations": router.migrations,
+            "live_engines": live_after,
+            "bit_exact": bool(bit_exact),
+            "goodput_tokens_per_sec": round(done_tokens / max(wall, 1e-9),
+                                            2),
+            "new_shape_events": serving_new_shape() - new_shape0,
+        }
+
+    full = run_leg(kill=False)
+    killed = run_leg(kill=True)
+    share_left = (n_engines - 1) / n_engines
+    margin = 0.7  # CI-noise allowance under the proportionality bound
+    goodput_ok = (killed["goodput_tokens_per_sec"]
+                  >= share_left * margin * full["goodput_tokens_per_sec"])
+    ok = (full["unresolved"] == 0 and killed["unresolved"] == 0
+          and not full["bad_reasons"] and not killed["bad_reasons"]
+          and full["deaths"] == 0
+          and killed["deaths"] == 1
+          and killed["migrations"] >= 1
+          and killed["live_engines"] == n_engines - 1
+          and full["bit_exact"] and killed["bit_exact"]
+          and full["new_shape_events"] == 0
+          and killed["new_shape_events"] == 0
+          and goodput_ok)
+    return {
+        "ok": bool(ok),
+        "n_engines": n_engines,
+        "full": full,
+        "killed": killed,
+        "share_left": round(share_left, 3),
+        "goodput_margin": margin,
+        "goodput_proportional_ok": bool(goodput_ok),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--json", action="store_true",
                     help="machine-readable: exactly one JSON line on stdout")
     ap.add_argument("--requests", type=int, default=10)
     ap.add_argument("--tokens", type=int, default=8)
-    ap.add_argument("--leg", choices=("all", "training"), default="all",
+    ap.add_argument("--leg", choices=("all", "training", "cluster"),
+                    default="all",
                     help="'training' runs ONLY the preemption-proof "
                          "training leg and emits a \"tool\": "
-                         "\"trainchaos\" line (the trainchaos gate stage)")
+                         "\"trainchaos\" line (the trainchaos gate stage); "
+                         "'cluster' runs ONLY the multi-engine "
+                         "kill-one-engine leg and emits a \"tool\": "
+                         "\"cluster\" line (the cluster gate stage)")
     args = ap.parse_args()
 
     from deeplearning4j_tpu import faults, observe
@@ -637,6 +759,27 @@ def main() -> int:
                   f"{overhead['async_overhead_ms']}ms vs sync "
                   f"{overhead['sync_overhead_ms']}ms "
                   f"(ratio {overhead['overhead_ratio']})",
+                  file=sys.stderr)
+        return 0 if ok else 1
+
+    if args.leg == "cluster":
+        cluster = run_cluster_chaos()
+        ok = bool(cluster["ok"])
+        rec = {
+            "tool": "cluster", "ok": ok, "cluster": cluster,
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }
+        print(json.dumps(rec), flush=True)
+        if not args.json:
+            k = cluster["killed"]
+            print(f"cluster: {'OK' if ok else 'FAIL'} — "
+                  f"{k['submitted']} submitted, {k['deaths']} death, "
+                  f"{k['migrations']} migrated, bit-exact "
+                  f"{k['bit_exact']}, goodput "
+                  f"{k['goodput_tokens_per_sec']} vs full "
+                  f"{cluster['full']['goodput_tokens_per_sec']} tok/s "
+                  f"(proportional ok {cluster['goodput_proportional_ok']}"
+                  f"), new_shape {k['new_shape_events']}",
                   file=sys.stderr)
         return 0 if ok else 1
 

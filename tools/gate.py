@@ -492,6 +492,58 @@ def trainchaos_stage() -> bool:
     return bool(ok)
 
 
+def cluster_stage() -> bool:
+    """Cluster-failure-domain smoke (docs/ROBUSTNESS.md § Cluster
+    failure domains): three engines behind the ClusterRouter under a
+    past-capacity burst, one hard-killed mid-flight by ``engine_death``
+    — fails unless every request reaches a terminal state on both legs,
+    at least one in-flight request migrates with its greedy output
+    token-for-token identical to the single-engine oracle, goodput
+    degrades no worse than proportionally to the capacity lost, and
+    survivors show zero ``new_shape`` ledger events. One JSON line,
+    like lint/check/obs/chaos."""
+    print("== gate: cluster-chaos-smoke (kill one engine, migrate) ==",
+          flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_FAULTS", None)  # an ambient schedule would double-
+    try:                              # inject on top of the harness's own
+        proc = subprocess.run(
+            [sys.executable, "tools/chaos.py", "--json", "--leg",
+             "cluster"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (cluster-chaos-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (cluster-chaos-smoke exit {proc.returncode})\n"
+              f"{tail}")
+        return False
+    rec = json.loads(line)
+    cl = rec.get("cluster") or {}
+    kd = cl.get("killed") or {}
+    ok = (bool(rec.get("ok"))
+          and kd.get("deaths") == 1
+          and (kd.get("migrations") or 0) >= 1
+          and kd.get("bit_exact")
+          and kd.get("unresolved") == 0
+          and kd.get("new_shape_events") == 0
+          and cl.get("goodput_proportional_ok"))
+    full = cl.get("full") or {}
+    print(f"   {'ok' if ok else 'FAIL'} (cluster-chaos-smoke: "
+          f"{kd.get('submitted')} submitted, {kd.get('deaths')} death, "
+          f"{kd.get('migrations')} migrated, bit-exact="
+          f"{kd.get('bit_exact')}, goodput "
+          f"{kd.get('goodput_tokens_per_sec')} vs full "
+          f"{full.get('goodput_tokens_per_sec')} tok/s, new_shape "
+          f"{kd.get('new_shape_events')})")
+    return bool(ok)
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -564,6 +616,7 @@ def main() -> int:
         results["tune"] = tune_stage()
         results["chaos"] = chaos_stage()
         results["trainchaos"] = trainchaos_stage()
+        results["cluster"] = cluster_stage()
         results["slo"] = slo_stage()
         results["prefix"] = prefix_stage()
         results["spec"] = spec_stage()
