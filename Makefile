@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke cluster-chaos-smoke slo-smoke prefix-smoke spec-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke cluster-chaos-smoke slo-smoke prefix-smoke spec-smoke locktrace-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -84,6 +84,15 @@ cluster-chaos-smoke:
 # ONE JSON line like lint/check/obs/chaos.
 slo-smoke:
 	JAX_PLATFORMS=cpu python tools/slo.py --json
+
+# locktrace smoke (docs/LINT.md § graftlock): runtime shadow-lock
+# cross-validation of the static lock-order graph — fails unless the
+# static graph is acyclic, every lock-order edge observed under the
+# threaded serving + checkpoint workload is inside its transitive
+# closure, and the combined graph stays acyclic.
+# ONE JSON line like lint/check/obs/chaos/slo.
+locktrace-smoke:
+	JAX_PLATFORMS=cpu python tools/locktrace.py
 
 # prefix-cache smoke (docs/SERVING.md § Radix prefix cache): the shared-
 # prompt replay, cache on vs off with an identical request plan — fails

@@ -16,8 +16,10 @@ from deeplearning4j_tpu.lint.core import (  # noqa: F401
     AST_RULES, Finding, diff_baseline, iter_py_files, lint_paths,
     lint_source, load_baseline, write_baseline)
 
-# register the AST rules on import
+# register the AST rules on import (graftlock — the GL011-GL014 lock
+# discipline tier — rides the same registry; see rules_concurrency)
 from deeplearning4j_tpu.lint import rules_ast  # noqa: F401
+from deeplearning4j_tpu.lint import rules_concurrency  # noqa: F401
 
 __all__ = ["AST_RULES", "Finding", "diff_baseline", "iter_py_files",
            "lint_paths", "lint_source", "load_baseline", "write_baseline"]

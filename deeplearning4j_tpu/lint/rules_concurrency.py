@@ -1,0 +1,851 @@
+"""graftlock — static lock-discipline rules for the threaded serving stack.
+
+GL011  lock-order inversion: a cycle in the module's lock-order graph
+       (built from ``with <lock>:`` nesting plus the intra-module call
+       graph) is a potential deadlock
+GL012  inconsistently-guarded shared state: an attribute *mostly* accessed
+       under its class's lock is touched without it on a path reachable
+       from a thread entry point; also read-modify-write (``self.x += 1``)
+       on a thread-entry path outside any lock in a lock-owning class
+GL013  blocking call while holding a lock: ``.join()``, queue ``.get()`` /
+       ``future.result()`` / ``.wait()`` without a timeout, ``time.sleep``,
+       ``jax.device_get`` / ``.block_until_ready()`` inside a lock body
+GL014  external callback invoked under a held lock: ``set_result`` /
+       ``set_exception`` / ``add_done_callback`` and listener/``on_*``/
+       hook calls run arbitrary foreign code while the lock is held —
+       the cluster-migration re-entrancy hazard
+
+Same house rules as ``rules_ast``: deliberately conservative (a static
+pass that cries wolf gets deleted from the gate), blind spots documented
+in docs/LINT.md. A true positive the code *means* is suppressed inline
+with ``# graftlock: justified(GL01x): <reason>`` — the reason is
+mandatory; a bare marker does not suppress.
+
+Beyond the per-file rules this module exports the repo-wide static
+lock-order graph (:func:`static_lock_order`) that the runtime shadow-lock
+tracer (``testing/locktrace.py``) cross-validates: every lock-order edge
+actually observed under the threaded test suites must already be an edge
+here, and the combined graph must stay acyclic.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.lint.core import Finding, ast_rule, iter_py_files
+
+# ---------------------------------------------------------------------------
+# inline justification (the graftlock analog of "graftlint: disable")
+# ---------------------------------------------------------------------------
+
+_JUSTIFIED_RE = re.compile(
+    r"graftlock:\s*justified\((GL\d{3})\)\s*:\s*(\S.*)")
+
+
+def _justified_lines(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """1-based line -> rule ids justified there. Only matches carrying a
+    nonempty written reason suppress — acceptance requires every justified
+    site to say WHY."""
+    out: Dict[int, Set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        for m in _JUSTIFIED_RE.finditer(text):
+            out.setdefault(i, set()).add(m.group(1))
+    return out
+
+
+def _apply_justified(findings: List[Finding],
+                     lines: Sequence[str]) -> List[Finding]:
+    """A justification suppresses a finding on its own line or on the
+    line directly below (comment-above form, for statements too long to
+    carry a trailing comment)."""
+    just = _justified_lines(lines)
+    return [f for f in findings
+            if f.rule not in just.get(f.line, ())
+            and f.rule not in just.get(f.line - 1, ())]
+
+
+# ---------------------------------------------------------------------------
+# lock model: which attributes ARE locks, and what a method acquires
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+# method names that run on a worker thread even without an explicit
+# Thread(target=...) in the same class (the codebase's worker idioms)
+_ENTRY_NAMES = {"run", "_run", "_serve_loop", "_worker", "_worker_loop"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """True for ``threading.Lock()`` / ``Lock()`` / ``threading.Condition(
+    ...)`` — the expression creates a lock-like object."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    return name in _LOCK_CTORS
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'_lock' for ``self._lock``, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _Method:
+    """One function/method with its lock acquisitions and call sites."""
+
+    def __init__(self, cls: Optional[str], name: str, node: ast.AST):
+        self.cls = cls
+        self.name = name
+        self.node = node
+        # direct acquisitions: (lock node name, with-stmt line)
+        self.acquires: List[Tuple[str, int]] = []
+        # edges (held -> acquired, line) from literal with-nesting
+        self.nest_edges: List[Tuple[str, str, int]] = []
+        # call sites: (callee name, line, held locks at the call)
+        self.calls: List[Tuple[str, int, Tuple[str, ...]]] = []
+        # every statement line range inside a held-lock body, with the
+        # lock name — GL013/GL014 scan these
+        self.lock_bodies: List[Tuple[str, ast.With, int]] = []
+
+
+class _ModuleModel:
+    """Per-module lock/call model shared by GL011-GL014 (built once per
+    tree, cached on the tree object)."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.path = path
+        # class -> {lock attr}
+        self.class_locks: Dict[str, Set[str]] = {}
+        # lock attr -> {classes defining it} (module-wide, for other.X)
+        self.attr_owners: Dict[str, Set[str]] = {}
+        # (cls or None, method name) -> _Method
+        self.methods: Dict[Tuple[Optional[str], str], _Method] = {}
+        # class -> thread-entry method names
+        self.entries: Dict[str, Set[str]] = {}
+        self._collect_locks(tree)
+        self._collect_methods(tree)
+        self._collect_entries(tree)
+
+    # -- pass 1: find every ``self.X = threading.Lock()``-style definition
+    def _collect_locks(self, tree: ast.Module) -> None:
+        for cls in (n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)):
+            locks: Set[str] = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr:
+                            locks.add(attr)
+                elif (isinstance(node, ast.AnnAssign) and node.value is not None
+                        and _is_lock_ctor(node.value)):
+                    attr = _self_attr(node.target)
+                    if attr:
+                        locks.add(attr)
+            if locks:
+                self.class_locks[cls.name] = locks
+                for attr in locks:
+                    self.attr_owners.setdefault(attr, set()).add(cls.name)
+
+    # -- naming: a lock expression -> stable node name ("Cls.attr")
+    def lock_name(self, expr: ast.AST, cls: Optional[str]) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None:
+            if cls and attr in self.class_locks.get(cls, ()):
+                return f"{cls}.{attr}"
+            return None
+        if isinstance(expr, ast.Attribute):  # other.X — resolve by attr
+            owners = self.attr_owners.get(expr.attr, set())
+            if len(owners) == 1:
+                return f"{next(iter(owners))}.{expr.attr}"
+            if owners:
+                return f"?.{expr.attr}"
+        return None
+
+    # -- pass 2: per-method acquisitions, nesting edges, call sites
+    def _collect_methods(self, tree: ast.Module) -> None:
+        def visit_fn(fn, cls: Optional[str]) -> None:
+            m = _Method(cls, fn.name, fn)
+            self.methods[(cls, fn.name)] = m
+
+            def walk(node, held: Tuple[str, ...]) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        continue  # nested defs get their own _Method? no —
+                        # closures run later, outside the held context
+                    if isinstance(child, ast.With):
+                        names = []
+                        for item in child.items:
+                            nm = self.lock_name(item.context_expr, cls)
+                            if nm is None and isinstance(
+                                    item.context_expr, ast.Call):
+                                # ``with self._cv:`` never calls; a Call
+                                # (e.g. ``with open(...)``) is not a lock
+                                nm = None
+                            if nm:
+                                m.acquires.append((nm, child.lineno))
+                                for h in held:
+                                    if h != nm:
+                                        m.nest_edges.append(
+                                            (h, nm, child.lineno))
+                                names.append(nm)
+                        if names:
+                            m.lock_bodies.append(
+                                (names[-1], child, child.lineno))
+                        walk(child, held + tuple(names))
+                        continue
+                    if isinstance(child, ast.Call):
+                        callee = None
+                        f = child.func
+                        if isinstance(f, ast.Name):
+                            callee = f.id
+                        elif isinstance(f, ast.Attribute):
+                            callee = f.attr
+                        if callee:
+                            m.calls.append((callee, child.lineno, held))
+                    walk(child, held)
+
+            walk(fn, ())
+
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_fn(node, None)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        visit_fn(sub, node.name)
+
+    # -- pass 3: thread entry points
+    def _collect_entries(self, tree: ast.Module) -> None:
+        for cls in self.class_locks:
+            self.entries[cls] = set()
+        for node in ast.walk(tree):
+            # threading.Thread(target=self.X) — X runs on a worker thread
+            if isinstance(node, ast.Call):
+                fn = node.func
+                fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if fname == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            attr = _self_attr(kw.value)
+                            if attr:
+                                for cls in self._classes_with_method(attr):
+                                    self.entries.setdefault(cls,
+                                                            set()).add(attr)
+                # f.add_done_callback(self.X) / reg.add_listener(self.X):
+                # X runs on whatever thread completes/fires
+                if fname in ("add_done_callback", "add_listener",
+                             "register_callback"):
+                    for arg in node.args:
+                        attr = _self_attr(arg)
+                        if attr:
+                            for cls in self._classes_with_method(attr):
+                                self.entries.setdefault(cls, set()).add(attr)
+            # obj.on_death = self.X (or a lambda closing over self.X) —
+            # registered callback, runs on a foreign thread
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and tgt.attr.startswith("on_")):
+                        for attr in self._callback_targets(node.value):
+                            for cls in self._classes_with_method(attr):
+                                self.entries.setdefault(cls, set()).add(attr)
+        for (cls, name) in self.methods:
+            if cls and name in _ENTRY_NAMES:
+                self.entries.setdefault(cls, set()).add(name)
+
+    @staticmethod
+    def _callback_targets(value: ast.AST) -> List[str]:
+        """Method names a registered-callback expression hands out:
+        ``self.X`` directly, or any ``self.X(...)`` a wrapping lambda
+        calls."""
+        attr = _self_attr(value)
+        if attr:
+            return [attr]
+        if isinstance(value, ast.Lambda):
+            out = []
+            for node in ast.walk(value.body):
+                if isinstance(node, ast.Call):
+                    a = _self_attr(node.func)
+                    if a:
+                        out.append(a)
+            return out
+        return []
+
+    def _classes_with_method(self, name: str) -> List[str]:
+        return [c for (c, n) in self.methods if c is not None and n == name]
+
+    # -- intra-class reachability from the thread entry points
+    def entry_reachable(self, cls: str) -> Set[str]:
+        """Method names of ``cls`` reachable (intra-class call graph) from
+        its thread entry points."""
+        seen: Set[str] = set()
+        todo = list(self.entries.get(cls, ()))
+        while todo:
+            name = todo.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            m = self.methods.get((cls, name))
+            if m is None:
+                continue
+            for callee, _line, _held in m.calls:
+                if (cls, callee) in self.methods and callee not in seen:
+                    todo.append(callee)
+        return seen
+
+    # -- transitive lock acquisitions per method (intra-module fixpoint)
+    def transitive_acquires(self) -> Dict[Tuple[Optional[str], str],
+                                          Set[str]]:
+        acq = {key: {a for a, _ in m.acquires}
+               for key, m in self.methods.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, m in self.methods.items():
+                for callee, _line, _held in m.calls:
+                    for ckey in ((m.cls, callee), (None, callee)):
+                        if ckey in acq and not acq[ckey] <= acq[key]:
+                            acq[key] |= acq[ckey]
+                            changed = True
+        return acq
+
+    # -- the module's lock-order graph: (a, b, line, via) edges
+    def lock_edges(self) -> List[Tuple[str, str, int, str]]:
+        edges: List[Tuple[str, str, int, str]] = []
+        trans = self.transitive_acquires()
+        for key, m in self.methods.items():
+            where = f"{m.cls}.{m.name}" if m.cls else m.name
+            for a, b, line in m.nest_edges:
+                edges.append((a, b, line, where))
+            for callee, line, held in m.calls:
+                if not held:
+                    continue
+                for ckey in ((m.cls, callee), (None, callee)):
+                    for b in trans.get(ckey, ()):
+                        for a in held:
+                            if a != b:
+                                edges.append(
+                                    (a, b, line, f"{where} -> {callee}"))
+        return edges
+
+
+def _model(tree: ast.Module, path: str) -> _ModuleModel:
+    cached = getattr(tree, "_graftlock_model", None)
+    if cached is None:
+        cached = _ModuleModel(tree, path)
+        tree._graftlock_model = cached
+    return cached
+
+
+def _find_cycle(edges: Iterable[Tuple[str, str]]
+                ) -> Optional[List[str]]:
+    """One cycle as a node list [a, b, ..., a], or None if acyclic."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GREY
+        stack.append(n)
+        for nb in sorted(graph[n]):
+            if color[nb] == GREY:
+                return stack[stack.index(nb):] + [nb]
+            if color[nb] == WHITE:
+                cyc = dfs(nb)
+                if cyc:
+                    return cyc
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc:
+                return cyc
+    return None
+
+
+# ---------------------------------------------------------------------------
+# GL011 — lock-order inversion
+# ---------------------------------------------------------------------------
+
+
+@ast_rule("GL011", "lock-order inversion: cycle in the module's lock-order "
+                   "graph (with-nesting + intra-module calls) — potential "
+                   "deadlock")
+def rule_lock_order(tree, lines, path) -> List[Finding]:
+    model = _model(tree, path)
+    edges = model.lock_edges()
+    findings: List[Finding] = []
+    cyc = _find_cycle({(a, b) for a, b, _l, _w in edges})
+    if cyc:
+        # name both acquisition paths: for each edge of the cycle, the
+        # earliest site establishing it
+        sites = []
+        for a, b in zip(cyc, cyc[1:]):
+            best = min((e for e in edges if e[0] == a and e[1] == b),
+                       key=lambda e: e[2])
+            sites.append(f"{a}->{b} at line {best[2]} ({best[3]})")
+        findings.append(Finding(
+            path=path, line=min(e[2] for e in edges
+                                if (e[0], e[1]) in set(zip(cyc, cyc[1:]))),
+            rule="GL011", severity="error",
+            message=("lock-order cycle " + " -> ".join(cyc)
+                     + "; acquisition paths: " + "; ".join(sites)
+                     + " — two threads taking these in opposite order "
+                       "deadlock")))
+    return _apply_justified(findings, lines)
+
+
+# ---------------------------------------------------------------------------
+# GL012 — inconsistently-guarded shared state
+# ---------------------------------------------------------------------------
+
+
+class _AttrAccess:
+    __slots__ = ("attr", "line", "store", "guarded", "method", "augmented")
+
+    def __init__(self, attr, line, store, guarded, method, augmented):
+        self.attr = attr
+        self.line = line
+        self.store = store
+        self.guarded = guarded
+        self.method = method
+        self.augmented = augmented
+
+
+def _locked_only_methods(model: _ModuleModel, cls: str) -> Set[str]:
+    """Methods of ``cls`` that are ONLY ever called with a class lock
+    already held (the ``_health_check``-from-``_routable`` /
+    ``*_locked`` helper convention): every intra-class call site carries
+    a held lock of this class, and there is at least one call site.
+    Their accesses count as guarded. Blind spot: call sites in OTHER
+    modules are invisible — a cross-module unlocked caller defeats
+    this."""
+    locks = {f"{cls}.{a}" for a in model.class_locks.get(cls, ())}
+    # callee -> [(caller, lock held at the call site)]
+    sites: Dict[str, List[Tuple[str, bool]]] = {}
+    for (c, name), m in model.methods.items():
+        if c != cls:
+            continue
+        for callee, _line, held in m.calls:
+            if (cls, callee) in model.methods:
+                sites.setdefault(callee, []).append(
+                    (name, bool(set(held) & locks)))
+    out: Set[str] = set()
+    changed = True
+    while changed:  # fixpoint: a locked-only caller's sites count as held
+        changed = False
+        for callee, ss in sites.items():
+            if callee not in out and all(held or caller in out
+                                         for caller, held in ss):
+                out.add(callee)
+                changed = True
+    return out
+
+
+def _class_attr_accesses(model: _ModuleModel, tree: ast.Module,
+                         cls_node: ast.ClassDef) -> List[_AttrAccess]:
+    """Every ``self.X`` load/store in the class's methods, tagged with
+    whether a lock of THIS class was held (literal with-nesting, or the
+    method is only ever called under the lock) at the access.
+    ``__init__``/``__del__`` are construction/teardown — single-threaded
+    by contract, excluded entirely."""
+    cls = cls_node.name
+    locks = model.class_locks.get(cls, set())
+    locked_only = _locked_only_methods(model, cls)
+    out: List[_AttrAccess] = []
+    for sub in cls_node.body:
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if sub.name in ("__init__", "__new__", "__del__"):
+            continue
+
+        def walk(node, held: bool, method=sub.name) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                now_held = held
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr in locks:
+                            now_held = True
+                if isinstance(child, ast.AugAssign):
+                    attr = _self_attr(child.target)
+                    if attr is not None and attr not in locks:
+                        out.append(_AttrAccess(attr, child.lineno, True,
+                                               now_held, method, True))
+                elif isinstance(child, ast.Attribute):
+                    attr = _self_attr(child)
+                    if attr is not None and attr not in locks:
+                        out.append(_AttrAccess(
+                            attr, child.lineno,
+                            isinstance(child.ctx, (ast.Store, ast.Del)),
+                            now_held, method, False))
+                walk(child, now_held, method)
+
+        walk(sub, sub.name in locked_only)
+    return out
+
+
+@ast_rule("GL012", "inconsistently-guarded shared state: attribute mostly "
+                   "accessed under the class lock touched without it on a "
+                   "thread-entry path (or read-modify-write off-lock)")
+def rule_guarded_state(tree, lines, path) -> List[Finding]:
+    model = _model(tree, path)
+    findings: List[Finding] = []
+    for cls_node in (n for n in ast.walk(tree)
+                     if isinstance(n, ast.ClassDef)):
+        cls = cls_node.name
+        if cls not in model.class_locks:
+            continue
+        if not model.entries.get(cls):
+            continue  # no thread ever enters this class — no data race
+        reachable = model.entry_reachable(cls)
+        accesses = _class_attr_accesses(model, tree, cls_node)
+        by_attr: Dict[str, List[_AttrAccess]] = {}
+        for a in accesses:
+            by_attr.setdefault(a.attr, []).append(a)
+        for attr, accs in sorted(by_attr.items()):
+            guarded = [a for a in accs if a.guarded]
+            unguarded = [a for a in accs if not a.guarded]
+            # arm (a): "mostly guarded" inference — private attrs only;
+            # >= 2 guarded accesses and a guarded majority make the lock
+            # the attribute's de-facto owner
+            if (attr.startswith("_") and len(guarded) >= 2
+                    and len(guarded) > len(unguarded)):
+                for a in unguarded:
+                    if a.method in reachable or any(
+                            g.method in reachable for g in guarded):
+                        findings.append(Finding(
+                            path=path, line=a.line, rule="GL012",
+                            severity="error",
+                            message=(f"{cls}.{attr} is lock-guarded at "
+                                     f"{len(guarded)} sites but "
+                                     f"{'written' if a.store else 'read'} "
+                                     f"without the lock in {a.method}() — "
+                                     f"racy against the guarded accesses")))
+            # arm (b): read-modify-write on a worker-thread path with no
+            # lock held — a lost update even when no access is guarded
+            for a in accs:
+                if (a.augmented and not a.guarded
+                        and a.method in reachable):
+                    findings.append(Finding(
+                        path=path, line=a.line, rule="GL012",
+                        severity="error",
+                        message=(f"{cls}.{attr} += ... in {a.method}() runs "
+                                 f"on a thread-entry path without the class "
+                                 f"lock — concurrent increments lose "
+                                 f"updates")))
+    return _apply_justified(sorted(set(findings)), lines)
+
+
+# ---------------------------------------------------------------------------
+# GL013 — blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _walk_no_defs(node: ast.AST):
+    """ast.walk that does NOT descend into nested function/lambda bodies —
+    a closure defined under a lock runs later, without it."""
+    todo = [node]
+    while todo:
+        n = todo.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            todo.append(child)
+
+
+def _dotted_tail(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+_QUEUEISH = re.compile(r"(^q$|queue|_q$)", re.I)
+
+
+@ast_rule("GL013", "blocking call while holding a lock: join/get/result/"
+                   "wait without timeout, time.sleep, device_get — every "
+                   "other waiter stalls behind it")
+def rule_blocking_under_lock(tree, lines, path) -> List[Finding]:
+    model = _model(tree, path)
+    findings: List[Finding] = []
+    for key, m in model.methods.items():
+        for lock_name, with_node, _line in m.lock_bodies:
+            lock_attr = lock_name.split(".")[-1]
+            for node in _walk_no_defs(with_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                dotted = None
+                if isinstance(fn, ast.Attribute):
+                    dotted = _dotted_tail(fn.value)
+                msg = None
+                if fname == "sleep" and dotted in (None, "time"):
+                    msg = "time.sleep holds the lock for the whole nap"
+                elif fname == "join" and not node.args and \
+                        not _has_timeout(node):
+                    msg = (".join() with no timeout can wait forever "
+                           "while the lock starves every other thread")
+                elif fname == "device_get" or fname == "block_until_ready":
+                    msg = (f".{fname}() synchronizes with the device "
+                           f"under the lock — host threads stall for "
+                           f"device time")
+                elif fname in ("get", "result", "wait") and \
+                        not node.args and not _has_timeout(node):
+                    recv = dotted
+                    if fname == "wait" and recv == lock_attr:
+                        continue  # Condition.wait on the HELD lock
+                        # releases it — the CV pattern, not a block
+                    if fname == "get" and not (
+                            recv and _QUEUEISH.search(recv)):
+                        continue  # dict.get() noise — only queue-ish
+                        # receivers are credible blockers
+                    msg = (f".{fname}() without a timeout blocks "
+                           f"indefinitely while holding the lock")
+                if msg:
+                    findings.append(Finding(
+                        path=path, line=node.lineno, rule="GL013",
+                        severity="error",
+                        message=f"blocking call under {lock_name}: {msg}"))
+    return _apply_justified(sorted(set(findings)), lines)
+
+
+# ---------------------------------------------------------------------------
+# GL014 — external callback invoked under a held lock
+# ---------------------------------------------------------------------------
+
+_CB_NAME = re.compile(r"(^on_[a-z0-9_]+$|callback|listener|^hook$|_cb$|"
+                      r"_hook$)", re.I)
+_FUTURE_COMPLETERS = {"set_result", "set_exception", "add_done_callback"}
+
+
+def _callback_calls(m: _Method, with_node: ast.With
+                    ) -> List[Tuple[int, str]]:
+    """(line, description) for every direct callback invocation inside
+    ``with_node``'s body."""
+    out: List[Tuple[int, str]] = []
+    for node in _walk_no_defs(with_node):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if fname is None:
+            continue
+        if fname in _FUTURE_COMPLETERS:
+            out.append((node.lineno,
+                        f"{fname}() runs the future's done-callbacks "
+                        f"synchronously on this thread"))
+        elif _CB_NAME.search(fname):
+            out.append((node.lineno,
+                        f"{fname}() is a listener/callback — foreign code "
+                        f"runs while the lock is held"))
+    return out
+
+
+@ast_rule("GL014", "external callback (listener/on_*/set_result/"
+                   "add_done_callback) invoked while a lock is held — "
+                   "re-entrancy and cross-lock deadlock hazard")
+def rule_callback_under_lock(tree, lines, path) -> List[Finding]:
+    model = _model(tree, path)
+    findings: List[Finding] = []
+    # which methods invoke callbacks OUTSIDE any of their own lock bodies
+    # (so a locked caller inherits the hazard through the call)
+    cb_methods: Dict[Tuple[Optional[str], str], List[Tuple[int, str]]] = {}
+    for key, m in model.methods.items():
+        in_lock_lines: Set[int] = set()
+        for _nm, wnode, _l in m.lock_bodies:
+            for n in ast.walk(wnode):
+                ln = getattr(n, "lineno", None)
+                if ln is not None:
+                    in_lock_lines.add(ln)
+        hits = []
+        for node in _walk_no_defs(m.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if fname and (fname in _FUTURE_COMPLETERS
+                          or _CB_NAME.search(fname)) \
+                    and node.lineno not in in_lock_lines:
+                hits.append((node.lineno, fname))
+        if hits:
+            cb_methods[key] = hits
+
+    for key, m in model.methods.items():
+        for lock_name, with_node, _line in m.lock_bodies:
+            for line, desc in _callback_calls(m, with_node):
+                findings.append(Finding(
+                    path=path, line=line, rule="GL014", severity="error",
+                    message=f"callback under {lock_name}: {desc}"))
+            # call-graph propagation: a call under the lock into a method
+            # that completes futures / fires listeners
+            for node in _walk_no_defs(with_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                callee = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if callee is None:
+                    continue
+                for ckey in ((m.cls, callee), (None, callee)):
+                    if ckey in cb_methods and ckey != key:
+                        cl, cn = cb_methods[ckey][0]
+                        findings.append(Finding(
+                            path=path, line=node.lineno, rule="GL014",
+                            severity="error",
+                            message=(f"callback under {lock_name}: "
+                                     f"{callee}() reaches {cn}() at line "
+                                     f"{cl} — foreign code runs while the "
+                                     f"lock is held")))
+    return _apply_justified(sorted(set(findings)), lines)
+
+
+# ---------------------------------------------------------------------------
+# repo-wide static lock-order graph (the locktrace cross-validation leg)
+# ---------------------------------------------------------------------------
+
+
+class LockGraph:
+    """Union of the per-module lock-order graphs with cross-module call
+    propagation: node names are ``Class.attr``; an edge a->b means "some
+    path acquires b while holding a"."""
+
+    def __init__(self):
+        self.edges: Set[Tuple[str, str]] = set()
+        self.sites: Dict[Tuple[str, str], str] = {}
+        self.nodes: Set[str] = set()
+
+    def add(self, a: str, b: str, site: str) -> None:
+        if a == b or a.startswith("?.") or b.startswith("?."):
+            return  # self-edges are RLock re-entry; unresolved owners
+            # ("?.attr") would alias distinct locks into false edges
+        self.edges.add((a, b))
+        self.sites.setdefault((a, b), site)
+        self.nodes.update((a, b))
+
+    def cycle(self) -> Optional[List[str]]:
+        return _find_cycle(self.edges)
+
+    def closure(self) -> Set[Tuple[str, str]]:
+        """Transitive closure — the runtime tracer records an edge for
+        EVERY held lock at each acquisition, so held-through-two-levels
+        shows up as the composed edge."""
+        reach: Dict[str, Set[str]] = {n: set() for n in self.nodes}
+        for a, b in self.edges:
+            reach[a].add(b)
+        changed = True
+        while changed:
+            changed = False
+            for a in reach:
+                new = set()
+                for b in reach[a]:
+                    new |= reach.get(b, set())
+                if not new <= reach[a]:
+                    reach[a] |= new
+                    changed = True
+        return {(a, b) for a, bs in reach.items() for b in bs}
+
+
+# names the cross-module propagation must NOT resolve by-name: they are
+# methods of builtin containers / threading primitives (or builtins), so
+# `self.events.clear()` would otherwise alias into SOME class's `clear`
+# and fabricate lock edges (observed: SpanTracer.clear -> list.clear
+# matched RadixPrefixCache.clear, closing a false deadlock cycle)
+_GENERIC_CALLEES: Set[str] = (
+    set(dir(list)) | set(dir(dict)) | set(dir(set)) | set(dir(str))
+    | set(dir(bytes)) | {"min", "max", "sum", "len", "abs", "sorted",
+                         "start", "run", "join", "is_alive",
+                         "acquire", "release", "wait", "notify",
+                         "notify_all", "locked", "popleft", "appendleft"})
+
+
+def static_lock_order(repo_root: str,
+                      roots: Sequence[str] = ("deeplearning4j_tpu",)
+                      ) -> LockGraph:
+    """Build the repo-wide lock-order graph. Per-module edges come from
+    :meth:`_ModuleModel.lock_edges`; cross-module edges from calls made
+    while holding a lock into a method NAME that any indexed class
+    defines (union over owners when ambiguous — over-approximation is
+    the safe direction for a graph whose job is to stay acyclic), except
+    for :data:`_GENERIC_CALLEES`, whose by-name matches are noise."""
+    models: List[_ModuleModel] = []
+    for rel in iter_py_files(roots, repo_root):
+        with open(os.path.join(repo_root, rel), "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError:
+            continue
+        models.append(_ModuleModel(tree, rel))
+
+    graph = LockGraph()
+    # global method index: name -> transitive lock acquisitions (repo-wide
+    # fixpoint so frontend -> engine.submit_request -> scheduler.submit
+    # composes into frontend._lock -> SlotScheduler._plock)
+    acq: Dict[Tuple[str, Optional[str], str], Set[str]] = {}
+    calls: Dict[Tuple[str, Optional[str], str],
+                List[Tuple[str, int, Tuple[str, ...]]]] = {}
+    by_name: Dict[str, List[Tuple[str, Optional[str], str]]] = {}
+    for model in models:
+        for (cls, name), m in model.methods.items():
+            key = (model.path, cls, name)
+            acq[key] = {a for a, _ in m.acquires}
+            calls[key] = [c for c in m.calls
+                          if c[0] not in _GENERIC_CALLEES]
+            by_name.setdefault(name, []).append(key)
+
+    changed = True
+    while changed:
+        changed = False
+        for key, csites in calls.items():
+            for callee, _line, _held in csites:
+                for ckey in by_name.get(callee, ()):
+                    if not acq[ckey] <= acq[key]:
+                        acq[key] |= acq[ckey]
+                        changed = True
+
+    for model in models:
+        for a, b, line, where in model.lock_edges():
+            graph.add(a, b, f"{model.path}:{line} ({where})")
+        for (cls, name), m in model.methods.items():
+            key = (model.path, cls, name)
+            for callee, line, held in m.calls:
+                if not held or callee in _GENERIC_CALLEES:
+                    continue
+                for ckey in by_name.get(callee, ()):
+                    for b in acq[ckey]:
+                        for a in held:
+                            graph.add(a, b,
+                                      f"{model.path}:{line} "
+                                      f"({cls}.{name} -> {callee})")
+    return graph

@@ -110,11 +110,16 @@ class _AsyncWriter:
 
     # ------------------------------------------------------- trainer side
     def _ensure_thread(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
-            self._stop = False  # a close()d writer restarts on next use
-            self._thread = threading.Thread(
-                target=self._run, name="ckpt-writer", daemon=True)
-            self._thread.start()
+        with self._cv:
+            # _stop is read under _cv by the writer's wait loops; writing
+            # it bare here could race a concurrent stop() and leave a
+            # freshly started thread believing it should exit (or a
+            # stopping one believing it should not)
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = False  # a close()d writer restarts on next use
+                self._thread = threading.Thread(
+                    target=self._run, name="ckpt-writer", daemon=True)
+                self._thread.start()
 
     def stop(self, timeout: float = 10.0) -> None:
         """Drain the queue, then retire the writer thread. Without this a
@@ -203,7 +208,8 @@ class _AsyncWriter:
                         self._dropped_c.inc()
                         step, host_state = self._q.popleft()
                 self._in_flight = step
-                self._depth_g.set(len(self._q))
+                depth = len(self._q)
+                self._depth_g.set(depth)
                 self._cv.notify_all()
             t0 = time.perf_counter()
             try:
@@ -217,7 +223,7 @@ class _AsyncWriter:
                 self._saves_c.inc()
                 observe.log_event("ckpt_async", step=step,
                                   write_s=round(dt, 6),
-                                  queue_depth=len(self._q))
+                                  queue_depth=depth)
             except BaseException as e:  # surfaced on the next save
                 logger.warning(
                     "async checkpoint write for step %d failed: %r", step, e)

@@ -382,7 +382,10 @@ class ClusterRouter:
                 return
             self._dead.add(eng.engine_id)
             n_live = len({e.engine_id for e in self.engines} - self._dead)
-        self.deaths += 1
+            # two engines can die concurrently, each on its own worker
+            # thread — the counter bump must share the de-dup critical
+            # section or increments are lost
+            self.deaths += 1
         self._obs["deaths"].inc()
         self._obs["live"].set(float(n_live))
         observe.log_event("cluster_quarantine", engine=eng.engine_id,
@@ -426,10 +429,12 @@ class ClusterRouter:
         for eid, group in groups.items():
             dest = dests[eid]
             dest.adopt_requests(group)
-            self.migrations += len(group)
+            with self._lock:
+                # concurrent deaths migrate on separate threads; keep the
+                # tally and the audit list consistent with each other
+                self.migrations += len(group)
+                self.migrated_requests.extend(item[0] for item in group)
             self._obs["migrated"].inc(len(group))
-            for item in group:
-                self.migrated_requests.append(item[0])
             observe.log_event("cluster_migrate", from_engine=eng.engine_id,
                               to_engine=eid, n=len(group))
             self._rewarm_pins(dest)
@@ -460,6 +465,10 @@ class ClusterRouter:
         fire-and-forget: record the pin intent now (so the insert
         re-pins), skip prefixes the destination already holds, and let a
         1-token generation carry the pages in behind the migrated work."""
+        # racy emptiness pre-check is benign: a concurrent pin either lands
+        # before the locked copy below (re-warmed now) or is re-warmed by
+        # the NEXT migration; never dropped, only possibly delayed.
+        # graftlock: justified(GL012): advisory fast-path read; locked copy below is authoritative
         if dest.prefix is None or not self._pin_intents:
             return
         with self._lock:

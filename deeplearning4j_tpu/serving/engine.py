@@ -450,6 +450,7 @@ class GenerativeEngine:
         too (same supervisor, no worker thread): a step that dies inside
         the retry budget re-admits and continues; past the budget the
         original exception propagates to the caller."""
+        # graftlock: justified(GL012): advisory mode check — start_serving/stop are caller-serialized
         if self._worker is not None:
             raise RuntimeError("generate() is the inline mode — the engine "
                                "is already running a serving loop; use "
@@ -623,6 +624,7 @@ class GenerativeEngine:
         restart budget is spent — the caller escalates to fail_all."""
         if not self.supervise or self.restarts >= self.max_restarts:
             return False
+        # graftlock: justified(GL012): single-writer — only the (one) worker/inline step thread recovers
         self.restarts += 1
         self._obs["restarts"].inc()
         logger.warning("engine worker died (%r) — restart %d/%d",
@@ -754,6 +756,7 @@ class GenerativeEngine:
                 "min_match=%d — it will never produce a hit (use a longer "
                 "prefix or lower prefix_min_match)", prompt.size,
                 self.prefix.min_match)
+        # graftlock: justified(GL012): advisory mode check — serving mode does not flip mid-prewarm
         if self._worker is None:
             res = self.generate([prompt], max_new_tokens=1, eos_token=-1)[0]
         else:

@@ -69,7 +69,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Callable, Dict, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -412,151 +412,183 @@ class SLOFrontend:
                temperature: float, top_k: int, top_p: float,
                eos_token: Optional[int], deadline_s: Optional[float],
                max_retries: int) -> "Future[GenerationResult]":
-        with self._lock:
-            now = self._clock()
-            p_len = int(np.asarray(prompt).size)  # honest prompt_len on
-            self._update_state(now)               # denied-result metadata
+        # Completing a caller-visible future (_deny / _shed_victim) runs
+        # its done-callbacks synchronously on THIS thread — foreign code
+        # inside our critical section if it happened under self._lock
+        # (graftlock GL014: a callback that blocks on another thread
+        # needing this lock deadlocks the frontend). Denial/displacement
+        # completions are therefore DEFERRED until the lock is released.
+        deferred: List[Callable[[], None]] = []
+        try:
+            with self._lock:
+                # The only completer reached under the lock is
+                # add_done_callback on a FRESH, not-yet-completed future —
+                # it registers, never invokes, the callback; denial paths
+                # defer their set_result into `deferred` below.
+                # graftlock: justified(GL014): registers a cb on an incomplete future; never invokes foreign code
+                return self._admit_locked(
+                    prompt, policy, max_new_tokens, temperature, top_k,
+                    top_p, eos_token, deadline_s, max_retries, deferred)
+        finally:
+            for complete in deferred:
+                complete()
 
-            # 1. circuit breaker: a thrashing engine gets NO new work —
-            #    fast-fail terminally as "error" instead of queueing into
-            #    a supervisor that keeps dying. Per-engine: only when
-            #    EVERY routable engine is open (a cluster with one
-            #    healthy sibling keeps admitting)
-            if self._breaker_open_fraction(now) >= 1.0:
-                return self._deny(policy, "circuit_open", terminal="error",
-                                  prompt_len=p_len)
+    def _admit_locked(self, prompt, policy: ClassPolicy,
+                      max_new_tokens: int, temperature: float, top_k: int,
+                      top_p: float, eos_token: Optional[int],
+                      deadline_s: Optional[float], max_retries: int,
+                      deferred: List[Callable[[], None]]
+                      ) -> "Future[GenerationResult]":
+        now = self._clock()
+        p_len = int(np.asarray(prompt).size)  # honest prompt_len on
+        self._update_state(now)               # denied-result metadata
 
-            # 2. shedding state refuses the classes configured for it
-            if self.state == "shedding" and policy.reject_in_shedding:
-                return self._deny(policy, "shedding_state", prompt_len=p_len)
+        # 1. circuit breaker: a thrashing engine gets NO new work —
+        #    fast-fail terminally as "error" instead of queueing into
+        #    a supervisor that keeps dying. Per-engine: only when
+        #    EVERY routable engine is open (a cluster with one
+        #    healthy sibling keeps admitting)
+        if self._breaker_open_fraction(now) >= 1.0:
+            return self._deny(policy, "circuit_open", terminal="error",
+                              prompt_len=p_len, deferred=deferred)
 
-            # 3. per-class in-flight concurrency cap (queued + active)
-            cap = policy.max_concurrent
-            if cap is not None and self._inflight[policy.name] >= cap:
-                return self._deny(policy, "concurrency", prompt_len=p_len)
+        # 2. shedding state refuses the classes configured for it
+        if self.state == "shedding" and policy.reject_in_shedding:
+            return self._deny(policy, "shedding_state", prompt_len=p_len,
+                              deferred=deferred)
 
-            # 5. effective deadline: request > class default > engine
-            #    default (None = no deadline, no predictive shed)
-            if deadline_s is None:
-                deadline_s = policy.deadline_s
-            if deadline_s is None:
-                deadline_s = getattr(self.engine, "default_deadline_s", None)
+        # 3. per-class in-flight concurrency cap (queued + active)
+        cap = policy.max_concurrent
+        if cap is not None and self._inflight[policy.name] >= cap:
+            return self._deny(policy, "concurrency", prompt_len=p_len,
+                              deferred=deferred)
 
-            # 6. degradation ladder: trim degradable classes FIRST, so the
-            #    predictive estimate below prices the trimmed answer (the
-            #    degraded counter increments only on actual ADMISSION —
-            #    a trimmed-then-denied request was shed, not degraded)
-            degraded = False
-            if self.state != "ok" and policy.degradable:
-                degraded = True
-                max_new_tokens = min(max_new_tokens,
-                                     self.degraded_max_new_tokens)
-                top_k, top_p = 0, 1.0
-            # 6b. speculative-decoding degraded-mode knob: in "shedding"
-            #     a disable_spec class decodes non-speculatively — the
-            #     draft model's compute goes back to the target (recorded
-            #     on the result like the degraded flag; the engine reads
-            #     it off the request at admission)
-            spec_disabled = (self.state == "shedding"
-                             and policy.disable_spec)
+        # 5. effective deadline: request > class default > engine
+        #    default (None = no deadline, no predictive shed)
+        if deadline_s is None:
+            deadline_s = policy.deadline_s
+        if deadline_s is None:
+            deadline_s = getattr(self.engine, "default_deadline_s", None)
 
-            # 7. predictive early shed: if the estimated TTFT plus the
-            #    time to decode the (possibly trimmed) answer already
-            #    blows the deadline, shedding NOW costs nothing —
-            #    admitting costs queue space and decode steps the SLO can
-            #    never recover, and a completion that lands PAST its
-            #    deadline is worth exactly as little as a shed
-            if deadline_s is not None:
-                est = self.estimate_ttft_s(priority=policy.priority)
-                if est is not None:
-                    p50 = self._rolling.p50
-                    if p50 is None:
-                        p50 = self._est_decode_s or 0.0
-                    est += max_new_tokens * p50
-                    if est > deadline_s * self.shed_margin:
-                        return self._deny(policy, "predicted_deadline",
-                                          prompt_len=p_len,
-                                          degraded=degraded,
-                                          spec_disabled=spec_disabled)
+        # 6. degradation ladder: trim degradable classes FIRST, so the
+        #    predictive estimate below prices the trimmed answer (the
+        #    degraded counter increments only on actual ADMISSION —
+        #    a trimmed-then-denied request was shed, not degraded)
+        degraded = False
+        if self.state != "ok" and policy.degradable:
+            degraded = True
+            max_new_tokens = min(max_new_tokens,
+                                 self.degraded_max_new_tokens)
+            top_k, top_p = 0, 1.0
+        # 6b. speculative-decoding degraded-mode knob: in "shedding"
+        #     a disable_spec class decodes non-speculatively — the
+        #     draft model's compute goes back to the target (recorded
+        #     on the result like the degraded flag; the engine reads
+        #     it off the request at admission)
+        spec_disabled = (self.state == "shedding"
+                         and policy.disable_spec)
 
-            # 8. build + validate the request NOW — an invalid submission
-            #    must raise to its caller BEFORE it can burn a rate token
-            #    or displace a queued victim it will never replace
-            eos = (self.engine.cfg.eos_token if eos_token is None
-                   else eos_token)
-            req = GenerationRequest(
-                prompt=prompt, max_new_tokens=max_new_tokens,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_token=eos, deadline_s=deadline_s,
-                max_retries=max_retries, priority=policy.priority,
-                slo_class=policy.name, degraded=degraded,
-                spec_disabled=spec_disabled)
-            self.engine.validate_request(req)
-
-            # 8b. per-class token bucket — after the cheap caps and the
-            #     predictive check so denials there never burn rate
-            #     budget, but BEFORE the queue bounds so a rate-limited
-            #     arrival cannot displace a queued victim for nothing
-            bucket = self._buckets.get(policy.name)
-            if bucket is not None and not bucket.try_take(now):
-                return self._deny(policy, "rate_limit", prompt_len=p_len,
-                                  degraded=degraded,
-                                  spec_disabled=spec_disabled)
-
-            # 9. queue-depth bounds: per-class share first, then the total
-            #    bound with shed-lowest-first — an important arrival
-            #    displaces the worst queued request instead of being
-            #    refused behind it. A denial here refunds the rate token.
-            sched = self.engine.scheduler
-            snapshot = sched.pending_snapshot()
-            eff_quota = self._class_quota(policy)
-            if eff_quota is not None:
-                queued = sum(1 for it in snapshot
-                             if it[0].slo_class == policy.name)
-                if queued >= eff_quota:
-                    if bucket is not None:
-                        bucket.refund()
-                    return self._deny(policy, "queue_full", prompt_len=p_len,
+        # 7. predictive early shed: if the estimated TTFT plus the
+        #    time to decode the (possibly trimmed) answer already
+        #    blows the deadline, shedding NOW costs nothing —
+        #    admitting costs queue space and decode steps the SLO can
+        #    never recover, and a completion that lands PAST its
+        #    deadline is worth exactly as little as a shed
+        if deadline_s is not None:
+            est = self.estimate_ttft_s(priority=policy.priority)
+            if est is not None:
+                p50 = self._rolling.p50
+                if p50 is None:
+                    p50 = self._est_decode_s or 0.0
+                est += max_new_tokens * p50
+                if est > deadline_s * self.shed_margin:
+                    return self._deny(policy, "predicted_deadline",
+                                      prompt_len=p_len,
                                       degraded=degraded,
-                                      spec_disabled=spec_disabled)
-            if (self.max_queue_total is not None
-                    and len(snapshot) >= self.max_queue_total):
-                victim = sched.steal_lowest_pending(policy.priority)
-                if victim is None:
-                    # nothing lower-priority to displace: the arrival is
-                    # itself the worst — it sheds
-                    if bucket is not None:
-                        bucket.refund()
-                    return self._deny(policy, "queue_full", prompt_len=p_len,
-                                      degraded=degraded,
-                                      spec_disabled=spec_disabled)
-                self._shed_victim(victim)
+                                      spec_disabled=spec_disabled,
+                                      deferred=deferred)
 
-            # 10. hand to the engine. Its own max_queue gate may still
-            #     shed — it completes the future IMMEDIATELY and counts
-            #     the terminal itself, so that case is slo_shed
-            #     (engine_queue), never slo_admitted: the admitted counter
-            #     means "actually queued", not "passed the frontend"
-            fut = self.engine.submit_request(req)
-            if fut.done():
-                # the engine's gate shed it: refund the rate token (a
-                # denial never burns budget) and keep the predictive
-                # model untouched — nothing was actually queued
+        # 8. build + validate the request NOW — an invalid submission
+        #    must raise to its caller BEFORE it can burn a rate token
+        #    or displace a queued victim it will never replace
+        eos = (self.engine.cfg.eos_token if eos_token is None
+               else eos_token)
+        req = GenerationRequest(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token=eos, deadline_s=deadline_s,
+            max_retries=max_retries, priority=policy.priority,
+            slo_class=policy.name, degraded=degraded,
+            spec_disabled=spec_disabled)
+        self.engine.validate_request(req)
+
+        # 8b. per-class token bucket — after the cheap caps and the
+        #     predictive check so denials there never burn rate
+        #     budget, but BEFORE the queue bounds so a rate-limited
+        #     arrival cannot displace a queued victim for nothing
+        bucket = self._buckets.get(policy.name)
+        if bucket is not None and not bucket.try_take(now):
+            return self._deny(policy, "rate_limit", prompt_len=p_len,
+                              degraded=degraded,
+                              spec_disabled=spec_disabled,
+                              deferred=deferred)
+
+        # 9. queue-depth bounds: per-class share first, then the total
+        #    bound with shed-lowest-first — an important arrival
+        #    displaces the worst queued request instead of being
+        #    refused behind it. A denial here refunds the rate token.
+        sched = self.engine.scheduler
+        snapshot = sched.pending_snapshot()
+        eff_quota = self._class_quota(policy)
+        if eff_quota is not None:
+            queued = sum(1 for it in snapshot
+                         if it[0].slo_class == policy.name)
+            if queued >= eff_quota:
                 if bucket is not None:
                     bucket.refund()
-                observe.metrics().counter(
-                    "dl4j_tpu_slo_shed_total",
-                    **{"class": policy.name, "reason": "engine_queue"}).inc()
-                return fut
-            self._est_tokens = 0.9 * self._est_tokens + 0.1 * max_new_tokens
-            self._inflight[policy.name] += 1
-            fut.add_done_callback(self._make_done_cb(policy.name))
-            observe.metrics().counter("dl4j_tpu_slo_admitted_total",
-                                      **{"class": policy.name}).inc()
-            if degraded:
-                observe.metrics().counter("dl4j_tpu_slo_degraded_total",
-                                          **{"class": policy.name}).inc()
+                return self._deny(policy, "queue_full", prompt_len=p_len,
+                                  degraded=degraded,
+                                  spec_disabled=spec_disabled,
+                                  deferred=deferred)
+        if (self.max_queue_total is not None
+                and len(snapshot) >= self.max_queue_total):
+            victim = sched.steal_lowest_pending(policy.priority)
+            if victim is None:
+                # nothing lower-priority to displace: the arrival is
+                # itself the worst — it sheds
+                if bucket is not None:
+                    bucket.refund()
+                return self._deny(policy, "queue_full", prompt_len=p_len,
+                                  degraded=degraded,
+                                  spec_disabled=spec_disabled,
+                                  deferred=deferred)
+            self._shed_victim(victim, deferred)
+
+        # 10. hand to the engine. Its own max_queue gate may still
+        #     shed — it completes the future IMMEDIATELY and counts
+        #     the terminal itself, so that case is slo_shed
+        #     (engine_queue), never slo_admitted: the admitted counter
+        #     means "actually queued", not "passed the frontend"
+        fut = self.engine.submit_request(req)
+        if fut.done():
+            # the engine's gate shed it: refund the rate token (a
+            # denial never burns budget) and keep the predictive
+            # model untouched — nothing was actually queued
+            if bucket is not None:
+                bucket.refund()
+            observe.metrics().counter(
+                "dl4j_tpu_slo_shed_total",
+                **{"class": policy.name, "reason": "engine_queue"}).inc()
             return fut
+        self._est_tokens = 0.9 * self._est_tokens + 0.1 * max_new_tokens
+        self._inflight[policy.name] += 1
+        fut.add_done_callback(self._make_done_cb(policy.name))
+        observe.metrics().counter("dl4j_tpu_slo_admitted_total",
+                                  **{"class": policy.name}).inc()
+        if degraded:
+            observe.metrics().counter("dl4j_tpu_slo_degraded_total",
+                                      **{"class": policy.name}).inc()
+        return fut
 
     def _make_done_cb(self, cls: str):
         def _done(_fut) -> None:
@@ -590,16 +622,27 @@ class SLOFrontend:
 
     def _deny(self, policy: ClassPolicy, slo_reason: str,
               terminal: str = "shed", prompt_len: int = 0,
-              degraded: bool = False,
-              spec_disabled: bool = False) -> "Future[GenerationResult]":
+              degraded: bool = False, spec_disabled: bool = False,
+              deferred: Optional[List[Callable[[], None]]] = None
+              ) -> "Future[GenerationResult]":
         """Complete a denied admission terminally (never an exception:
         overload is an expected state, and callers always get an answer).
         Counts ONCE in the slo_shed family AND once in the shared
-        terminal-reason taxonomy."""
+        terminal-reason taxonomy.
+
+        ``deferred`` is the post-lock completion list from ``_admit``:
+        ``set_result`` fires done-callbacks synchronously, so completing
+        here — under ``self._lock`` — would run foreign code inside the
+        frontend's critical section (deadlock if it blocks on a thread
+        that needs this lock)."""
         fut: "Future[GenerationResult]" = Future()
-        fut.set_result(self._terminal_result(
+        result = self._terminal_result(
             terminal, policy.name, prompt_len=prompt_len,
-            degraded=degraded, spec_disabled=spec_disabled))
+            degraded=degraded, spec_disabled=spec_disabled)
+        if deferred is not None:
+            deferred.append(lambda: fut.set_result(result))
+        else:
+            fut.set_result(result)
         observe.metrics().counter(
             "dl4j_tpu_slo_shed_total",
             **{"class": policy.name, "reason": slo_reason}).inc()
@@ -609,14 +652,22 @@ class SLOFrontend:
                           terminal=terminal)
         return fut
 
-    def _shed_victim(self, item: Tuple) -> None:
+    def _shed_victim(self, item: Tuple,
+                     deferred: Optional[List[Callable[[], None]]] = None
+                     ) -> None:
         """Complete a stolen pending item (queue-bound displacement) as a
-        terminal ``shed``."""
+        terminal ``shed``.  Completion is deferred past lock release for
+        the same reason as ``_deny`` — the victim's owner may have hung a
+        done-callback on the future."""
         req, fut, _t = item
-        if not fut.done():
-            fut.set_result(self._terminal_result(
-                "shed", req.slo_class, prompt_len=int(req.prompt.size),
-                degraded=req.degraded))
+        result = self._terminal_result(
+            "shed", req.slo_class, prompt_len=int(req.prompt.size),
+            degraded=req.degraded)
+        if deferred is not None:
+            deferred.append(
+                lambda: None if fut.done() else fut.set_result(result))
+        elif not fut.done():
+            fut.set_result(result)
         observe.metrics().counter(
             "dl4j_tpu_slo_shed_total",
             **{"class": req.slo_class, "reason": "queue_full"}).inc()

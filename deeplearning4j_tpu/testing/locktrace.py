@@ -1,0 +1,166 @@
+"""locktrace — runtime shadow-lock tracer cross-validating graftlock.
+
+The static lock-order graph (:func:`..lint.rules_concurrency.
+static_lock_order`) is an over-approximation built from the AST; this
+module is the under-approximation built from execution: wrap the real
+locks of a live system in :class:`ShadowLock`, run the threaded
+workload, and every acquisition records "held -> acquired" edges into a
+shared :class:`LockTracer`. The honesty contract, checked by
+:meth:`LockTracer.check`:
+
+* every edge actually observed must lie inside the TRANSITIVE CLOSURE of
+  the static graph (the tracer records an edge per held lock, so a
+  hold-through-two-levels surfaces as the composed edge the static graph
+  only has in two hops), and
+* the union of static and observed edges must stay acyclic.
+
+An observed edge outside the static closure means the analyzer's call
+graph missed an acquisition path — a graftlock blind spot that must be
+fixed in ``rules_concurrency``, not baselined away.
+
+Wrapping is transparent: ``ShadowLock`` delegates ``acquire`` /
+``release`` / context management to the wrapped primitive, so it can
+replace a ``Lock`` or ``RLock`` attribute in place
+(:func:`instrument_lock`), and a fresh ``Condition`` built over a shadow
+lock replaces condition-variable attributes (:func:`instrument_
+condition`) — ``Condition.wait`` then releases/reacquires through the
+shadow, which is exactly the semantics the tracer must see. Instrument
+BEFORE the object's threads start.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from deeplearning4j_tpu.lint.rules_concurrency import (
+    LockGraph, _find_cycle, static_lock_order)
+
+__all__ = ["ShadowLock", "LockTracer", "instrument_lock",
+           "instrument_condition", "static_lock_order"]
+
+
+class LockTracer:
+    """Shared edge recorder: thread-local held stacks, global edge set."""
+
+    def __init__(self):
+        self._local = threading.local()
+        self._mu = threading.Lock()  # guards _edges/_sites only
+        self._edges: Set[Tuple[str, str]] = set()
+        self._sites: Dict[Tuple[str, str], str] = {}
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    # -- ShadowLock callbacks ------------------------------------------------
+    def on_acquired(self, node: str) -> None:
+        st = self._stack()
+        held = [h for h in st if h != node]  # RLock re-entry is not an edge
+        st.append(node)
+        if not held:
+            return
+        with self._mu:
+            for h in held:
+                if (h, node) not in self._edges:
+                    self._edges.add((h, node))
+                    self._sites[(h, node)] = threading.current_thread().name
+
+    def on_released(self, node: str) -> None:
+        st = self._stack()
+        # remove the INNERMOST occurrence — out-of-order releases exist
+        # (e.g. lock handoff) and re-entrant locks release outside-in
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == node:
+                del st[i]
+                return
+
+    # -- results -------------------------------------------------------------
+    def edges(self) -> Set[Tuple[str, str]]:
+        with self._mu:
+            return set(self._edges)
+
+    def check(self, static: Optional[LockGraph] = None,
+              repo_root: str = ".") -> Dict:
+        """The cross-validation verdict: ok iff the static graph is
+        acyclic, every observed edge is in its transitive closure, and
+        static ∪ observed stays acyclic."""
+        if static is None:
+            static = static_lock_order(repo_root)
+        observed = self.edges()
+        closure = static.closure()
+        static_cycle = static.cycle()
+        unknown = sorted(e for e in observed if e not in closure)
+        combined_cycle = _find_cycle(static.edges | observed)
+        ok = (static_cycle is None and not unknown
+              and combined_cycle is None)
+        return {
+            "ok": ok,
+            "observed_edges": sorted(observed),
+            "static_edges": len(static.edges),
+            "static_cycle": static_cycle,
+            "unknown_edges": [
+                {"edge": list(e),
+                 "thread": self._sites.get(e, "?")} for e in unknown],
+            "combined_cycle": combined_cycle,
+        }
+
+
+class ShadowLock:
+    """A recording proxy around a real lock primitive.
+
+    Only ``acquire``/``release``/``__enter__``/``__exit__``/``locked``
+    are proxied — enough for ``Lock``, ``RLock``, and for serving as the
+    lock under a ``threading.Condition`` (whose default ``wait`` releases
+    and reacquires via these exact methods)."""
+
+    def __init__(self, inner, node: str, tracer: LockTracer):
+        self._inner = inner
+        self._node = node
+        self._tracer = tracer
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._tracer.on_acquired(self._node)
+        return got
+
+    def release(self) -> None:
+        # record BEFORE the real release: after it, another thread may
+        # already be inside and the stack would misattribute holds
+        self._tracer.on_released(self._node)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"ShadowLock({self._node}, {self._inner!r})"
+
+
+def instrument_lock(obj, attr: str, node: str,
+                    tracer: LockTracer) -> ShadowLock:
+    """Replace ``obj.<attr>`` (a Lock/RLock) with a recording shadow.
+    Call before any thread touches the lock."""
+    shadow = ShadowLock(getattr(obj, attr), node, tracer)
+    setattr(obj, attr, shadow)
+    return shadow
+
+
+def instrument_condition(obj, attr: str, node: str,
+                         tracer: LockTracer) -> threading.Condition:
+    """Replace ``obj.<attr>`` (a Condition) with a fresh Condition over a
+    shadowed plain Lock. The OLD condition's lock is abandoned, so this
+    must run before any thread waits on it."""
+    cv = threading.Condition(ShadowLock(threading.Lock(), node, tracer))
+    setattr(obj, attr, cv)
+    return cv

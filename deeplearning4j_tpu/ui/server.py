@@ -174,6 +174,10 @@ class UIServer:
 
     def __init__(self, port: int = 9000):
         self.port = port
+        # ThreadingHTTPServer handles each request on its own thread, so
+        # attach/detach from the trainer race _records() from handlers —
+        # every _storages touch goes through this lock (graftlock GL012)
+        self._lock = threading.Lock()
         self._storages: List[StatsStorage] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -188,24 +192,29 @@ class UIServer:
         return _INSTANCE
 
     def attach(self, storage: StatsStorage) -> None:
-        self._storages.append(storage)
+        with self._lock:
+            self._storages.append(storage)
 
     def remote_storage(self) -> StatsStorage:
         """The storage remote workers post into (auto-attached on first
         use) — the receiving half of RemoteUIStatsStorageRouter."""
-        if not hasattr(self, "_remote_storage"):
-            self._remote_storage = StatsStorage()
-            self.attach(self._remote_storage)
-        return self._remote_storage
+        with self._lock:
+            if not hasattr(self, "_remote_storage"):
+                self._remote_storage = StatsStorage()
+                self._storages.append(self._remote_storage)
+            return self._remote_storage
 
     def detach(self, storage: StatsStorage) -> None:
-        if storage in self._storages:
-            self._storages.remove(storage)
+        with self._lock:
+            if storage in self._storages:
+                self._storages.remove(storage)
 
     # -- data assembly -------------------------------------------------------
     def _records(self) -> List[Dict]:
         recs: List[Dict] = []
-        for st in self._storages:
+        with self._lock:
+            storages = list(self._storages)
+        for st in storages:
             recs.extend(r for r in getattr(st, "records", [])
                         if "static_model_info" not in r)
         return sorted(recs, key=lambda r: r.get("iteration", 0))
@@ -250,14 +259,18 @@ class UIServer:
     def graph(self) -> Dict:
         """Model topology (the reference UI's model-graph pane): the
         one-time static_model_info record StatsListener emits."""
-        for st in self._storages:
+        with self._lock:
+            storages = list(self._storages)
+        for st in storages:
             for r in getattr(st, "records", []):
                 if "static_model_info" in r:
                     return r["static_model_info"]
         return {"kind": "none", "nodes": [], "edges": []}
 
     def sessions(self) -> Dict:
-        return {"sessions": list(range(len(self._storages))),
+        with self._lock:
+            n = len(self._storages)
+        return {"sessions": list(range(n)),
                 "records": len(self._records())}
 
     # -- http ---------------------------------------------------------------

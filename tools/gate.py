@@ -47,6 +47,11 @@ Stages:
      uninterrupted oracle with zero new_shape, and async checkpointing's
      per-step overhead must be < 10% of the synchronous-save baseline
      (docs/ROBUSTNESS.md § Preemption-proof training)
+ 14. locktrace smoke: tools/locktrace.py shadow-lock cross-validation —
+     the graftlock static lock-order graph must be acyclic, every
+     lock-order edge observed under the threaded serving + checkpoint
+     workload must lie inside its transitive closure, and the combined
+     graph must stay acyclic (docs/LINT.md § graftlock)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -544,6 +549,45 @@ def cluster_stage() -> bool:
     return bool(ok)
 
 
+def locktrace_stage() -> bool:
+    """Locktrace smoke (docs/LINT.md § graftlock): runtime shadow-lock
+    cross-validation of the static lock-order graph — fails if the
+    static graph has a cycle, any observed runtime edge falls outside
+    its transitive closure (an analyzer blind spot), the combined graph
+    is cyclic, or the threaded workload leaves unresolved work. One
+    JSON line, like lint/check/obs/chaos."""
+    print("== gate: locktrace-smoke (shadow-lock vs static order) ==",
+          flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "tools/locktrace.py"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (locktrace-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (locktrace-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    ok = (bool(rec.get("ok"))
+          and rec.get("static_acyclic")
+          and not rec.get("unknown_edges")
+          and rec.get("combined_cycle") is None
+          and len(rec.get("observed_edges") or []) > 0)
+    print(f"   {'ok' if ok else 'FAIL'} (locktrace-smoke: "
+          f"{rec.get('static_edges')} static edges, "
+          f"{len(rec.get('observed_edges') or [])} observed, "
+          f"{len(rec.get('unknown_edges') or [])} outside closure, "
+          f"combined cycle {rec.get('combined_cycle')})")
+    return bool(ok)
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -617,6 +661,7 @@ def main() -> int:
         results["chaos"] = chaos_stage()
         results["trainchaos"] = trainchaos_stage()
         results["cluster"] = cluster_stage()
+        results["locktrace"] = locktrace_stage()
         results["slo"] = slo_stage()
         results["prefix"] = prefix_stage()
         results["spec"] = spec_stage()

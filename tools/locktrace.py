@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Locktrace smoke — runtime cross-validation of the graftlock static
+lock-order graph (docs/LINT.md § graftlock, docs/ROBUSTNESS.md § Lock
+discipline).
+
+Wraps the REAL locks of a live threaded serving + checkpoint stack in
+``testing/locktrace.py`` shadow locks, drives a small workload across
+worker threads, and checks the honesty contract:
+
+  * the statically derived lock-order graph is acyclic;
+  * every lock-order edge actually OBSERVED at runtime lies inside the
+    transitive closure of the static graph (an edge outside it means the
+    analyzer's call graph has a blind spot — fix rules_concurrency, do
+    not baseline);
+  * the union of static and observed edges stays acyclic.
+
+Three legs, one shared tracer:
+
+  frontend    SLOFrontend over a serving GenerativeEngine — admission
+              under the frontend RLock reaching the scheduler pending
+              lock through submit_request
+  cluster     2-engine ClusterRouter under concurrent submitters —
+              routing snapshots and engine lifecycle locks
+  checkpoint  TrainingCheckpointer with the async writer — the writer
+              condition variable and the io lock from both the trainer
+              thread and the writer thread
+
+Contract (same as lint/check/chaos): ONE JSON summary line on stdout
+with ``"tool": "locktrace"``; exit 0 iff ``ok``. ``make locktrace-smoke``
+pins JAX_PLATFORMS=cpu; ``tools/gate.py``'s ``locktrace`` stage enforces
+it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import types
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fake_net(value: float, seed: int = 0):
+    r = np.random.RandomState(seed)
+    net = types.SimpleNamespace()
+    net.params = {"W": (r.randn(4, 4) * 0 + value).astype(np.float32)}
+    net.opt_state = {"W": np.zeros((4, 4), np.float32)}
+    net.net_state = {}
+    net.iteration_count = int(value)
+    net.epoch_count = 0
+    return net
+
+
+def _instrument_engine(eng, tracer):
+    from deeplearning4j_tpu.testing.locktrace import instrument_lock
+    instrument_lock(eng, "_lifecycle", "GenerativeEngine._lifecycle",
+                    tracer)
+    instrument_lock(eng.scheduler, "_plock", "SlotScheduler._plock",
+                    tracer)
+    if eng.prefix is not None:
+        instrument_lock(eng.prefix, "_lock", "RadixPrefixCache._lock",
+                        tracer)
+
+
+def leg_frontend(tracer, n_requests: int) -> dict:
+    """SLO admission on a live serving engine: submitter threads push
+    through ``SLOFrontend.submit`` (frontend RLock -> scheduler pending
+    lock via submit_request) while the engine's worker thread drains."""
+    from deeplearning4j_tpu.models.gpt import GptConfig, GptModel
+    from deeplearning4j_tpu.serving import GenerativeEngine
+    from deeplearning4j_tpu.serving.frontend import SLOFrontend
+    from deeplearning4j_tpu.testing.locktrace import instrument_lock
+
+    cfg = GptConfig.tiny(vocab_size=256)
+    model = GptModel(cfg, seed=0)
+    eng = GenerativeEngine(model, max_slots=2, page_size=8,
+                           max_pages_per_seq=6, max_prompt=16, seed=0,
+                           default_deadline_s=300.0, restart_backoff_s=0.01)
+    eng.generate([np.array([1, 2], np.int32)], max_new_tokens=2,
+                 eos_token=-1)  # compile before the clock starts
+    _instrument_engine(eng, tracer)
+    fe = SLOFrontend(eng)
+    instrument_lock(fe, "_lock", "SLOFrontend._lock", tracer)
+    eng.start()
+    futs: list = []
+    futs_mu = threading.Lock()
+
+    def submitter(seed: int) -> None:
+        rr = np.random.RandomState(seed)
+        for _ in range(n_requests // 2):
+            p = rr.randint(1, cfg.vocab_size,
+                           size=rr.randint(2, 8)).astype(np.int32)
+            f = fe.submit(p, max_new_tokens=4, eos_token=-1)
+            with futs_mu:
+                futs.append(f)
+
+    threads = [threading.Thread(target=submitter, args=(s,))
+               for s in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = [f.result(timeout=300) for f in futs]
+    fe.snapshot()
+    eng.stop()
+    return {"submitted": len(futs),
+            "unresolved": sum(1 for f in futs if not f.done()),
+            "terminal": len(results)}
+
+
+def leg_cluster(tracer, n_requests: int) -> dict:
+    """Two engines behind a ClusterRouter, two submitter threads — the
+    router lock, engine lifecycle locks, and scheduler pending locks all
+    live at once."""
+    from deeplearning4j_tpu.models.gpt import GptConfig, GptModel
+    from deeplearning4j_tpu.serving import ClusterRouter, GenerativeEngine
+    from deeplearning4j_tpu.testing.locktrace import instrument_lock
+
+    cfg = GptConfig.tiny(vocab_size=256)
+    model = GptModel(cfg, seed=0)
+    engines = [GenerativeEngine(model, max_slots=2, page_size=8,
+                                max_pages_per_seq=6, max_prompt=16,
+                                seed=0, default_deadline_s=300.0,
+                                restart_backoff_s=0.01)
+               for _ in range(2)]
+    for e in engines:
+        e.generate([np.array([1, 2], np.int32)], max_new_tokens=2,
+                   eos_token=-1)
+        _instrument_engine(e, tracer)
+    router = ClusterRouter(engines)
+    instrument_lock(router, "_lock", "ClusterRouter._lock", tracer)
+    router.start()
+    futs: list = []
+    futs_mu = threading.Lock()
+
+    def submitter(seed: int) -> None:
+        rr = np.random.RandomState(seed)
+        for _ in range(n_requests // 2):
+            p = rr.randint(1, cfg.vocab_size,
+                           size=rr.randint(2, 8)).astype(np.int32)
+            f = router.submit(p, max_new_tokens=4, eos_token=-1)
+            with futs_mu:
+                futs.append(f)
+
+    threads = [threading.Thread(target=submitter, args=(s,))
+               for s in (3, 4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = [f.result(timeout=300) for f in futs]
+    router.stop()
+    return {"submitted": len(futs),
+            "unresolved": sum(1 for f in futs if not f.done()),
+            "terminal": len(results)}
+
+
+def leg_checkpoint(tracer, n_saves: int) -> dict:
+    """Async checkpointing: the writer condition variable crossed by the
+    trainer thread (submit/backpressure) and the writer thread (drain),
+    plus the io lock around record/retention."""
+    from deeplearning4j_tpu.parallel.checkpoint import TrainingCheckpointer
+    from deeplearning4j_tpu.testing.locktrace import (
+        instrument_condition, instrument_lock)
+
+    with tempfile.TemporaryDirectory() as d:
+        ck = TrainingCheckpointer(d, keep_last=2, use_orbax=False,
+                                  max_queue=2, overflow="block")
+        instrument_lock(ck, "_io_lock", "TrainingCheckpointer._io_lock",
+                        tracer)
+        instrument_condition(ck._writer, "_cv", "_AsyncWriter._cv",
+                             tracer)
+        for step in range(n_saves):
+            ck.save_async(step, _fake_net(float(step)))
+        drained = ck.wait_until_finished(timeout=120)
+        ck.close()
+        return {"saves": n_saves, "drained": bool(drained),
+                "failures": len(ck.drain_failures())}
+
+
+def run(n_requests: int, n_saves: int) -> dict:
+    from deeplearning4j_tpu.testing.locktrace import LockTracer
+
+    tracer = LockTracer()
+    legs = {
+        "frontend": leg_frontend(tracer, n_requests),
+        "cluster": leg_cluster(tracer, n_requests),
+        "checkpoint": leg_checkpoint(tracer, n_saves),
+    }
+    report = tracer.check(repo_root=REPO)
+    workload_ok = (legs["frontend"]["unresolved"] == 0
+                   and legs["cluster"]["unresolved"] == 0
+                   and legs["checkpoint"]["drained"]
+                   and legs["checkpoint"]["failures"] == 0
+                   and len(report["observed_edges"]) > 0)
+    return {
+        "tool": "locktrace",
+        "ok": bool(report["ok"] and workload_ok),
+        "static_acyclic": report["static_cycle"] is None,
+        "static_edges": report["static_edges"],
+        "observed_edges": report["observed_edges"],
+        "unknown_edges": report["unknown_edges"],
+        "combined_cycle": report["combined_cycle"],
+        "legs": legs,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="requests per serving leg")
+    ap.add_argument("--saves", type=int, default=6,
+                    help="async checkpoint saves")
+    args = ap.parse_args()
+    summary = run(args.requests, args.saves)
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
