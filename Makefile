@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke cluster-chaos-smoke slo-smoke prefix-smoke spec-smoke locktrace-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline shape-lint check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke cluster-chaos-smoke slo-smoke prefix-smoke spec-smoke locktrace-smoke shapetrace-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -11,6 +11,12 @@ lint:
 # regenerate the baseline (after FIXING findings — the baseline only shrinks)
 lint-baseline:
 	JAX_PLATFORMS=cpu python tools/graftlint.py --write-baseline
+
+# graftshape tier alone (docs/LINT.md § graftshape): jit-signature &
+# recompile-discipline rules GS001-GS005. Already part of `make lint` —
+# this target is the fast loop while working on shape discipline.
+shape-lint:
+	JAX_PLATFORMS=cpu python tools/graftlint.py --rules GS001,GS002,GS003,GS004,GS005
 
 # graftcheck: abstract shape/dtype verification of the SameDiff fixture
 # zoo (docs/ANALYSIS.md). Build-only — no jit, no device. Fails only on
@@ -93,6 +99,16 @@ slo-smoke:
 # ONE JSON line like lint/check/obs/chaos/slo.
 locktrace-smoke:
 	JAX_PLATFORMS=cpu python tools/locktrace.py
+
+# shapetrace smoke (docs/LINT.md § graftshape): runtime cross-validation
+# of the static jit-site inventory against the RecompileLedger — drives a
+# randomized-shape serving replay (prefix cache + speculation on) plus a
+# checkpoint-resumed training leg, then fails unless every recompile
+# event attributes to a statically ledgered callsite and every new_shape
+# event lands in a statically flagged hazard module.
+# ONE JSON line like lint/check/obs/chaos/slo/locktrace.
+shapetrace-smoke:
+	JAX_PLATFORMS=cpu python tools/shapetrace.py
 
 # prefix-cache smoke (docs/SERVING.md § Radix prefix cache): the shared-
 # prompt replay, cache on vs off with an identical request plan — fails

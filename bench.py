@@ -452,6 +452,40 @@ def _bench_generate_spec(n_requests: int, gen_tokens: int, spec_k: int):
     return speedup, "generate_spec_tokens_per_sec_speedup", extra
 
 
+def _bench_generate_random_shapes(n_requests: int, gen_max: int,
+                                  spec_k: int):
+    """Shape-diversity benchmark (BENCH_MODEL=generate +
+    BENCH_RANDOM_SHAPES=1): the graftshape cross-validation workload
+    (serving/replay.py, docs/LINT.md § graftshape) — prompt lengths
+    drawn across the whole 1..max_prompt range, varied generation
+    lengths, shared-prefix mixes, prefix cache AND speculation armed.
+    Value = distinct prompt lengths served; the assertions are the
+    point: every request terminal, ZERO serving new_shape events — the
+    bucketing contract absorbs arbitrary request geometry without a
+    single recompile."""
+    from deeplearning4j_tpu.serving.replay import run_randomized_replay
+
+    out = run_randomized_replay(n_requests=n_requests, gen_max=gen_max,
+                                spec_k=spec_k)
+    assert out["all_terminal"], (
+        "randomized-shape replay left non-terminal requests: "
+        f"{out['reasons']}")
+    assert out["new_shape_events"] == 0, (
+        "randomized request shapes leaked into a jit signature — "
+        f"{out['new_shape_events']} serving new_shape event(s)")
+    extra = {
+        "requests": out["requests"],
+        "prompt_lens": out["prompt_lens"],
+        "gen_lens": out["gen_lens"],
+        "generated_tokens": out["generated_tokens"],
+        "prefix_hit_tokens": out["prefix_hit_tokens"],
+        "first_compile_keys": out["first_compile_keys"],
+        "new_shape_events": out["new_shape_events"],
+    }
+    return (float(len(out["prompt_lens"])),
+            "generate_random_shapes_distinct_prompt_lens", extra)
+
+
 def _bench_bert_import(layers: int, seq: int, d: int, heads: int, ff: int,
                        iters: int):
     """Imported-BERT forward throughput (BENCH_MODEL=bert_import): the
@@ -640,7 +674,9 @@ _UNITS = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
           "generate_overload_goodput_tokens_per_sec":
               "deadline-met tokens/sec",
           "generate_prefix_ttft_p50_speedup": "x TTFT p50 vs cache-off",
-          "generate_spec_tokens_per_sec_speedup": "x tokens/sec vs spec-off"}
+          "generate_spec_tokens_per_sec_speedup": "x tokens/sec vs spec-off",
+          "generate_random_shapes_distinct_prompt_lens":
+              "distinct prompt lens, 0 recompiles"}
 
 _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "lenet": "lenet5_mnist_train_images_per_sec",
@@ -653,7 +689,9 @@ _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "generate_overload":
                      "generate_overload_goodput_tokens_per_sec",
                  "generate_prefix": "generate_prefix_ttft_p50_speedup",
-                 "generate_spec": "generate_spec_tokens_per_sec_speedup"}
+                 "generate_spec": "generate_spec_tokens_per_sec_speedup",
+                 "generate_random_shapes":
+                     "generate_random_shapes_distinct_prompt_lens"}
 
 
 def main() -> None:
@@ -668,6 +706,8 @@ def main() -> None:
         model = "generate_prefix"
     elif model == "generate" and os.environ.get("BENCH_SPEC") == "1":
         model = "generate_spec"
+    elif model == "generate" and os.environ.get("BENCH_RANDOM_SHAPES") == "1":
+        model = "generate_random_shapes"
     dtype = os.environ.get("BENCH_DTYPE", "mixed")
     smoke = backend == "cpu-fallback"
     # On cpu-fallback, headline workloads at device sizes would run for
@@ -751,6 +791,14 @@ def main() -> None:
             gen = int(os.environ.get("BENCH_GEN_TOKENS", "12"))
             k = int(os.environ.get("BENCH_SPEC_K", "4"))
             value, metric, extra = _bench_generate_spec(nreq, gen, k)
+            method = f"n{nreq}g{gen}k{k}"
+        elif model == "generate_random_shapes":
+            nreq = int(os.environ.get("BENCH_REQUESTS",
+                                      "16" if smoke else "48"))
+            gen = int(os.environ.get("BENCH_GEN_TOKENS", "6"))
+            k = int(os.environ.get("BENCH_SPEC_K", "3"))
+            value, metric, extra = _bench_generate_random_shapes(nreq, gen,
+                                                                 k)
             method = f"n{nreq}g{gen}k{k}"
         elif model == "generate_overload":
             nreq = int(os.environ.get("BENCH_REQUESTS",

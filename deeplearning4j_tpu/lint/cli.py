@@ -14,6 +14,10 @@ Options:
                        (the driver-artifact contract tools/gate.py relies on)
     --no-consistency   AST rules only (skip registry-loading rules — for
                        environments without jax)
+    --rules CSV        run only the named AST rules (e.g. GS001,GS002 —
+                       `make shape-lint` uses this to run the graftshape
+                       tier alone); implies --no-consistency unless a
+                       consistency rule id is in the list
     --list-rules       print the rule catalog and exit
 
 Exit code 0 iff there are no findings beyond the grandfathered baseline.
@@ -52,6 +56,8 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
                          "grandfather a regression)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--no-consistency", action="store_true")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -75,8 +81,33 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
     baseline_path = args.baseline or os.path.join(repo_root,
                                                   "lint_baseline.json")
 
-    findings: List[Finding] = lint_paths(roots, repo_root)
-    if not args.no_consistency:
+    rule_filter = None
+    if args.rules:
+        rule_filter = tuple(r.strip() for r in args.rules.split(",")
+                            if r.strip())
+        unknown = [r for r in rule_filter if r not in AST_RULES]
+        try:
+            from deeplearning4j_tpu.lint.rules_consistency import (
+                CONSISTENCY_RULES)
+            unknown = [r for r in unknown if r not in CONSISTENCY_RULES]
+        except ImportError:
+            pass
+        if unknown:
+            ap.error(f"unknown rule id(s): {', '.join(unknown)} "
+                     "(see --list-rules)")
+
+    findings: List[Finding] = lint_paths(
+        roots, repo_root,
+        rules=[r for r in rule_filter if r in AST_RULES]
+        if rule_filter else None)
+    if rule_filter is not None:
+        # a rule-filtered scan cannot see the other rules' findings, so the
+        # consistency tier only runs when one of ITS ids was asked for
+        run_cons = (not args.no_consistency and any(
+            r not in AST_RULES for r in rule_filter))
+    else:
+        run_cons = not args.no_consistency
+    if run_cons:
         # the consistency rules load the live registries (and thus jax);
         # pin the CPU backend so lint can NEVER hang on an unreachable TPU
         # (the ambient sitecustomize pins the platform at startup, so the
@@ -88,7 +119,10 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         except ImportError:
             pass
         from deeplearning4j_tpu.lint.rules_consistency import run_consistency
-        findings.extend(run_consistency(repo_root))
+        cons = run_consistency(repo_root)
+        if rule_filter is not None:
+            cons = [f for f in cons if f.rule in rule_filter]
+        findings.extend(cons)
     findings.sort()
 
     # shared baseline-CLI tail (lint/core.py — also drives graftcheck):
@@ -97,8 +131,9 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         "graftlint", findings, baseline_path,
         write=args.write_baseline, allow_growth=args.allow_growth,
         json_mode=args.json,
-        # a subset scan cannot tell "fixed" from "outside the paths"
-        suppress_fixed=subset,
+        # a subset scan cannot tell "fixed" from "outside the paths", and a
+        # rule-filtered scan cannot tell "fixed" from "rule not run"
+        suppress_fixed=subset or rule_filter is not None,
         fail_hint="fix the new findings above or (only with a written "
                   "justification) add a 'graftlint: disable=<RULE>' "
                   "comment")

@@ -1,0 +1,981 @@
+"""graftshape — static jit-signature & recompile-discipline rules.
+
+The repo's load-bearing invariant — every compiled fn's signature depends
+ONLY on server/training config, so serving and resume see zero
+``new_shape`` — is enforced at runtime by per-feature assertions. These
+rules enforce it at review time:
+
+GS001  unledgered jit: a ``jax.jit`` / ``.lower().compile()`` callsite
+       whose returned fn is never registered via
+       ``observe.note_jit_signature`` — its recompiles would be
+       unattributed in the RecompileLedger
+GS002  request-shaped signature: an array argument to a jitted fn whose
+       shape derives (intra-module dataflow: ``len()``, ``.shape``,
+       ``np.zeros(n)``-style construction, slicing by a non-config
+       variable) from request/batch state without passing through a
+       recognized bucket/pad helper
+GS003  traced-value leak: ``int()/float()/bool()/.item()/np.asarray()``
+       or Python ``if``/``while`` on traced values inside jit-decorated
+       or jit-reachable code
+GS004  weak-type churn: bare Python scalars passed positionally into a
+       jitted fn where device arrays flow on other call paths — the
+       signature splits on weak types
+GS005  static-arg hazard: ``static_argnums``/``static_argnames`` covering
+       a value the same module mutates per call — every mutation is a
+       recompile
+
+Same house rules as ``rules_ast``/``rules_concurrency``: deliberately
+conservative, blind spots documented in docs/LINT.md. A true positive the
+code *means* is suppressed inline with ``# graftshape: justified(GS00x):
+<reason>`` — the reason is mandatory; a bare marker does not suppress.
+
+Scope: GS001/GS002/GS004/GS005 apply to the package only (paths outside
+``tools/``/``examples/`` — standalone bench scripts own their throwaway
+jits; the ledger contract covers library code). GS003 is a correctness
+rule and applies everywhere.
+
+Beyond the per-file rules this module exports the repo-wide static
+jit-boundary inventory (:func:`static_shape_inventory`) that the runtime
+recompile tracer (``testing/shapetrace.py``) cross-validates: every
+``CompileEvent.callsite`` observed under the randomized-shape workloads
+must fall inside a statically known registration site, and every
+``new_shape`` event must attribute to a module the analyzer flagged as a
+hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.lint.core import Finding, ast_rule, iter_py_files
+from deeplearning4j_tpu.lint.rules_ast import (
+    _NUMPY_ALIASES, _dotted, _is_jit_expr, _jit_functions)
+
+# ---------------------------------------------------------------------------
+# inline justification (the graftshape analog of "graftlock: justified")
+# ---------------------------------------------------------------------------
+
+_JUSTIFIED_RE = re.compile(
+    r"graftshape:\s*justified\((GS\d{3})\)\s*:\s*(\S.*)")
+
+
+def _justified_lines(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """1-based line -> rule ids justified there. Only matches carrying a
+    nonempty written reason suppress — acceptance requires every justified
+    site to say WHY."""
+    out: Dict[int, Set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        for m in _JUSTIFIED_RE.finditer(text):
+            out.setdefault(i, set()).add(m.group(1))
+    return out
+
+
+def _apply_justified(findings: List[Finding],
+                     lines: Sequence[str]) -> List[Finding]:
+    """A justification suppresses a finding on its own line or on the
+    line directly below (comment-above form, for statements too long to
+    carry a trailing comment)."""
+    just = _justified_lines(lines)
+    return [f for f in findings
+            if f.rule not in just.get(f.line, ())
+            and f.rule not in just.get(f.line - 1, ())]
+
+
+def _in_library(path: str) -> bool:
+    """The ledger-discipline rules cover library code; standalone bench /
+    example scripts create deliberately throwaway jits."""
+    return not (path.startswith("tools/") or path.startswith("examples/"))
+
+
+def _is_direct_jit_call(node: ast.AST) -> bool:
+    """True for the jit-creating Call itself: ``jax.jit(f)`` / ``pjit(f)``
+    (NOT a call through a partial or an already-created handle)."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    return d is not None and d.split(".")[-1] in ("jit", "pjit")
+
+
+# ---------------------------------------------------------------------------
+# jit dataflow model: where jits are created, where handles flow, where
+# they are registered with the ledger
+# ---------------------------------------------------------------------------
+
+
+class _JitSite:
+    """One jit-creating expression (a ``jax.jit(...)`` call, a jit
+    decorator, or an AOT ``.lower().compile()`` chain rooted in one)."""
+
+    __slots__ = ("index", "line", "name_hint", "static_names", "call_node")
+
+    def __init__(self, index: int, line: int, name_hint: str,
+                 static_names: Tuple[str, ...] = (),
+                 call_node: Optional[ast.Call] = None):
+        self.index = index
+        self.line = line
+        self.name_hint = name_hint       # wrapped fn name when identifiable
+        self.static_names = static_names  # static_argnums/argnames coverage
+        self.call_node = call_node       # the jax.jit Call (None: decorator)
+
+
+class _Scope:
+    """One function/method with its jit-value bindings."""
+
+    def __init__(self, cls: Optional[str], name: str, node: ast.AST):
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.jit_names: Dict[str, Set[int]] = {}  # local name -> site idxs
+        self.returns: Set[int] = set()            # sites this scope returns
+        self.registrar_params: Set[int] = set()   # param idxs it registers
+
+
+class _ShapeModel:
+    """Per-module jit dataflow shared by GS001-GS005 (built once per tree,
+    cached on the tree object).
+
+    The fixpoint resolves the repo's real registration idioms: direct
+    ``fn = jax.jit(f); note_jit_signature(fn, ...)``; wrapper values
+    (``CompiledGraph(jax.jit(run), ...)``); producer methods
+    (``self._decode_fn = self._build_decode()`` where the builder returns
+    a jit fn, registered later through the self attribute); registrar
+    helpers (``self._note_compile(fn, ...)`` passing its param on to
+    ``note_jit_signature``); and AOT ``jax.jit(f).lower(a).compile()``
+    chains. Blind spot (documented in docs/LINT.md): names are matched
+    per-scope and self attributes per-module, so a handle exported to
+    ANOTHER module and registered there still reads as unledgered here —
+    register (or justify) at the creation module.
+    """
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.path = path
+        self.sites: List[_JitSite] = []
+        self.scopes: Dict[Tuple[Optional[str], str], _Scope] = {}
+        self.self_jit_attrs: Dict[str, Set[int]] = {}
+        self.registered: Set[int] = set()
+        # note_jit_signature / ledger.record call spans (GS inventory +
+        # the shapetrace runtime-callsite match)
+        self.registration_spans: List[Tuple[int, int]] = []
+        self._collect_scopes(tree)
+        self._fixpoint()
+
+    # -- scope collection -------------------------------------------------
+    def _collect_scopes(self, tree: ast.Module) -> None:
+        # module level statements form an implicit scope; its bindings
+        # (module-level ``fn = jax.jit(...)`` and jit-DECORATED top-level
+        # defs) are visible from every other scope in the module
+        mod = ast.Module(body=[n for n in tree.body
+                               if not isinstance(n, (ast.FunctionDef,
+                                                     ast.AsyncFunctionDef,
+                                                     ast.ClassDef))],
+                         type_ignores=[])
+        self.module_scope = _Scope(None, "<module>", mod)
+        self.scopes[(None, "<module>")] = self.module_scope
+
+        def add(cls: Optional[str],
+                node: "ast.FunctionDef | ast.AsyncFunctionDef") -> None:
+            self.scopes[(cls, node.name)] = _Scope(cls, node.name, node)
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    call = dec if isinstance(dec, ast.Call) else None
+                    idx = self._site_for(dec, node.name, call)
+                    self.module_scope.jit_names.setdefault(
+                        node.name, set()).add(idx)
+
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add(None, node)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        add(node.name, sub)
+
+    # -- site bookkeeping -------------------------------------------------
+    def _site_for(self, node: ast.AST, name_hint: str,
+                  call: Optional[ast.Call]) -> int:
+        line = node.lineno
+        for s in self.sites:
+            if s.line == line and s.name_hint == name_hint:
+                return s.index
+        static = _static_arg_names(call) if call is not None else ()
+        s = _JitSite(len(self.sites), line, name_hint, static, call)
+        self.sites.append(s)
+        return s.index
+
+    def _params(self, scope: _Scope) -> List[str]:
+        node = scope.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return []
+        names = [a.arg for a in node.args.posonlyargs + node.args.args]
+        if scope.cls is not None and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        return names
+
+    # -- jit value resolution ---------------------------------------------
+    def jit_value_sites(self, expr: ast.AST, scope: _Scope) -> Set[int]:
+        """Site indices the expression's value may carry (creates sites on
+        the fly for jit-creating expressions)."""
+        if isinstance(expr, ast.Name):
+            sites = set(scope.jit_names.get(expr.id, ()))
+            if not sites and scope is not self.module_scope:
+                sites = set(self.module_scope.jit_names.get(expr.id, ()))
+            return sites
+        if isinstance(expr, ast.Attribute):
+            if (isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"):
+                return set(self.self_jit_attrs.get(expr.attr, ()))
+            return set()
+        if isinstance(expr, ast.IfExp):
+            # step_fn = (self._make_a() if cond else self._make_b())
+            return (self.jit_value_sites(expr.body, scope)
+                    | self.jit_value_sites(expr.orelse, scope))
+        if isinstance(expr, ast.BoolOp):
+            out: Set[int] = set()
+            for v in expr.values:
+                out |= self.jit_value_sites(v, scope)
+            return out
+        if not isinstance(expr, ast.Call):
+            return set()
+        # jax.jit(f) / pjit(f) — the creation itself
+        if _is_direct_jit_call(expr):
+            hint = ""
+            if expr.args and isinstance(expr.args[0], ast.Name):
+                hint = expr.args[0].id
+            return {self._site_for(expr, hint, expr)}
+        # method chain on a jit value: jax.jit(f).lower(a).compile()
+        if isinstance(expr.func, ast.Attribute):
+            base = self.jit_value_sites(expr.func.value, scope)
+            if base:
+                return base
+            # producer method: self._build_decode()
+            if (isinstance(expr.func.value, ast.Name)
+                    and expr.func.value.id == "self"):
+                callee = self.scopes.get((scope.cls, expr.func.attr))
+                if callee is not None and callee.returns:
+                    return set(callee.returns)
+        if isinstance(expr.func, ast.Name):
+            # producer function: make_step(...)
+            callee = self.scopes.get((None, expr.func.id))
+            if callee is not None and callee.returns:
+                return set(callee.returns)
+        # wrapper: CompiledGraph(jax.jit(run), ...) — the wrapper object
+        # carries the jit value on to wherever it is registered
+        out: Set[int] = set()
+        for a in expr.args:
+            out |= self.jit_value_sites(a, scope)
+        return out
+
+    # -- the fixpoint ------------------------------------------------------
+    def _fixpoint(self) -> None:
+        for _ in range(10):
+            before = (sum(len(v) for s in self.scopes.values()
+                          for v in s.jit_names.values()),
+                      sum(len(s.returns) for s in self.scopes.values()),
+                      sum(len(s.registrar_params)
+                          for s in self.scopes.values()),
+                      sum(len(v) for v in self.self_jit_attrs.values()),
+                      len(self.registered), len(self.sites))
+            for scope in self.scopes.values():
+                self._scan_scope(scope)
+            after = (sum(len(v) for s in self.scopes.values()
+                         for v in s.jit_names.values()),
+                     sum(len(s.returns) for s in self.scopes.values()),
+                     sum(len(s.registrar_params)
+                         for s in self.scopes.values()),
+                     sum(len(v) for v in self.self_jit_attrs.values()),
+                     len(self.registered), len(self.sites))
+            if after == before:
+                break
+
+    def _scan_scope(self, scope: _Scope) -> None:
+        params = self._params(scope)
+        for node in ast.walk(scope.node):
+            # nested @jax.jit def — binds a jit name in this scope
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jit_expr(dec):
+                        call = dec if isinstance(dec, ast.Call) else None
+                        idx = self._site_for(dec, node.name, call)
+                        scope.jit_names.setdefault(node.name,
+                                                   set()).add(idx)
+            elif isinstance(node, ast.Assign):
+                sites = self.jit_value_sites(node.value, scope)
+                if sites:
+                    for tgt in node.targets:
+                        self._bind(tgt, sites, scope)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                sites = self.jit_value_sites(node.value, scope)
+                if sites:
+                    self._bind(node.target, sites, scope)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                scope.returns |= self.jit_value_sites(node.value, scope)
+            elif isinstance(node, ast.Call):
+                if _is_direct_jit_call(node):
+                    # ensure even unbound creations (``jax.jit(f)(x)``
+                    # inline) get a site — GS001 must see them
+                    hint = (node.args[0].id if node.args and isinstance(
+                        node.args[0], ast.Name) else "")
+                    self._site_for(node, hint, node)
+                self._scan_registration(node, scope, params)
+
+    def _bind(self, tgt: ast.AST, sites: Set[int], scope: _Scope) -> None:
+        if isinstance(tgt, ast.Name):
+            scope.jit_names.setdefault(tgt.id, set()).update(sites)
+        elif isinstance(tgt, ast.Attribute) and isinstance(
+                tgt.value, ast.Name) and tgt.value.id == "self":
+            self.self_jit_attrs.setdefault(tgt.attr, set()).update(sites)
+        elif isinstance(tgt, ast.Subscript):
+            # self._jit_cache[key] = fn — the container carries the value
+            self._bind(tgt.value, sites, scope)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._bind(el, sites, scope)
+
+    def _scan_registration(self, call: ast.Call, scope: _Scope,
+                           params: List[str]) -> None:
+        tail = _dotted(call.func)
+        tail = tail.split(".")[-1] if tail else None
+        if tail == "note_jit_signature":
+            self.registration_spans.append(
+                (call.lineno, getattr(call, "end_lineno", call.lineno)))
+            if call.args:
+                self._register_arg(call.args[0], scope, params)
+            return
+        if tail == "record" and any(kw.arg == "cause"
+                                    for kw in call.keywords):
+            # direct ledger.record(graph=..., cause=...) — a registration
+            # site for callsite attribution, but registers no handle
+            self.registration_spans.append(
+                (call.lineno, getattr(call, "end_lineno", call.lineno)))
+            return
+        # registrar helper: self._note_compile(fn, ...) — the callee
+        # passes its param on to note_jit_signature
+        callee = None
+        if isinstance(call.func, ast.Attribute) and isinstance(
+                call.func.value, ast.Name) and call.func.value.id == "self":
+            callee = self.scopes.get((scope.cls, call.func.attr))
+        elif isinstance(call.func, ast.Name):
+            callee = self.scopes.get((None, call.func.id))
+        if callee is not None and callee.registrar_params:
+            for i in callee.registrar_params:
+                if i < len(call.args):
+                    self._register_arg(call.args[i], scope, params)
+
+    def _register_arg(self, expr: ast.AST, scope: _Scope,
+                      params: List[str]) -> None:
+        self.registered |= self.jit_value_sites(expr, scope)
+        # is this scope itself a registrar? (its own param flows in)
+        if isinstance(expr, ast.Name) and expr.id in params:
+            scope.registrar_params.add(params.index(expr.id))
+
+    # -- queries -----------------------------------------------------------
+    def unledgered_sites(self) -> List[_JitSite]:
+        return [s for s in self.sites if s.index not in self.registered]
+
+    def is_jit_call(self, call: ast.Call, scope: _Scope) -> Set[int]:
+        """Sites a call expression dispatches into (``self._decode_fn(...)``
+        / ``step_fn(...)``), or empty if it is not a jitted-handle call."""
+        if _is_jit_expr(call.func):
+            return set()  # the creation, not a dispatch
+        return self.jit_value_sites(call.func, scope)
+
+
+def _static_arg_names(call: Optional[ast.Call]) -> Tuple[str, ...]:
+    """Param names covered by static_argnames on a jit call (argnums are
+    resolved by GS005 itself, which has the wrapped fn's params)."""
+    if call is None:
+        return ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals: List[str] = []
+            nodes = (kw.value.elts if isinstance(kw.value,
+                                                 (ast.Tuple, ast.List))
+                     else [kw.value])
+            for n in nodes:
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    vals.append(n.value)
+            return tuple(vals)
+    return ()
+
+
+def _model(tree: ast.Module, path: str) -> _ShapeModel:
+    cached = getattr(tree, "_graftshape_model", None)
+    if cached is None:
+        cached = _ShapeModel(tree, path)
+        tree._graftshape_model = cached
+    return cached
+
+
+# ---------------------------------------------------------------------------
+# GS001 — unledgered jit
+# ---------------------------------------------------------------------------
+
+
+def _gs001(model: _ShapeModel, path: str) -> List[Finding]:
+    if not _in_library(path):
+        return []
+    findings: List[Finding] = []
+    for site in model.unledgered_sites():
+        hint = f" '{site.name_hint}'" if site.name_hint else ""
+        findings.append(Finding(
+            path=path, line=site.line, rule="GS001", severity="error",
+            message=(f"jit callsite{hint} never registered via "
+                     f"observe.note_jit_signature — its recompiles would "
+                     f"be unattributed in the RecompileLedger (register "
+                     f"the returned fn where it is dispatched, or justify "
+                     f"why it stays off the ledger)")))
+    return sorted(set(findings))
+
+
+@ast_rule("GS001", "unledgered jit: jax.jit/.lower().compile() callsite "
+                   "whose fn is never registered via note_jit_signature — "
+                   "recompiles would be unattributed")
+def rule_unledgered_jit(tree, lines, path) -> List[Finding]:
+    return _apply_justified(_gs001(_model(tree, path), path), lines)
+
+
+# ---------------------------------------------------------------------------
+# GS002 — request-shaped signature
+# ---------------------------------------------------------------------------
+
+_SHAPE_SOURCES = {"shape", "size"}
+_ARRAY_CTORS = {"zeros", "ones", "empty", "full"}
+_BUCKETISH = re.compile(r"(bucket|pad|align)", re.I)
+
+
+def _refs_self(expr: ast.AST) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == "self"
+               for n in ast.walk(expr))
+
+
+def _request_tainted_names(fn: ast.AST) -> Set[str]:
+    """Names in ``fn`` whose value derives from request/batch EXTENT:
+    ``len(x)``, ``x.shape``/``x.size`` of a non-self value, propagated
+    through arithmetic. A name laundered through a bucket/pad helper
+    (``bucket_len(n)``) is deliberately NOT tainted — that is the
+    recognized fix."""
+    tainted: Set[str] = set()
+
+    def expr_tainted(expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                fname = _dotted(node.func)
+                fname = fname.split(".")[-1] if fname else None
+                if fname == "len" and node.args \
+                        and not _refs_self(node.args[0]):
+                    return True
+                if fname and _BUCKETISH.search(fname):
+                    return False  # bucketed — shape is config-quantized
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _SHAPE_SOURCES \
+                    and not _refs_self(node.value):
+                return True
+            if isinstance(node, ast.Name) and node.id in tainted:
+                return True
+        return False
+
+    for _ in range(4):  # short fixpoint over straight-line propagation
+        grew = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and expr_tainted(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id not in tainted:
+                        tainted.add(tgt.id)
+                        grew = True
+        if not grew:
+            break
+    return tainted
+
+
+def _gs002(model: _ShapeModel, tree: ast.Module,
+           path: str) -> List[Finding]:
+    if not _in_library(path):
+        return []
+    findings: List[Finding] = []
+    for scope in model.scopes.values():
+        tainted = _request_tainted_names(scope.node)
+        if not tainted:
+            continue
+        # names bound to arrays constructed with a tainted extent
+        tainted_arrays: Set[str] = set()
+
+        def ctor_tainted(expr: ast.AST) -> bool:
+            if not isinstance(expr, ast.Call):
+                return False
+            fname = _dotted(expr.func)
+            fname = fname.split(".")[-1] if fname else None
+            if fname not in _ARRAY_CTORS or not expr.args:
+                return False
+            shape_arg = expr.args[0]
+            if _BUCKETISH.search(ast.dump(shape_arg)):
+                return False
+            return any(isinstance(n, ast.Name) and n.id in tainted
+                       for n in ast.walk(shape_arg))
+
+        for node in ast.walk(scope.node):
+            if isinstance(node, ast.Assign) and ctor_tainted(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted_arrays.add(tgt.id)
+
+        def arg_request_shaped(arg: ast.AST) -> bool:
+            if isinstance(arg, ast.Name) and arg.id in tainted_arrays:
+                return True
+            if ctor_tainted(arg):
+                return True
+            # slicing by a non-config variable: ids[:, :n]
+            if isinstance(arg, ast.Subscript):
+                return any(isinstance(n, ast.Name) and n.id in tainted
+                           for n in ast.walk(arg.slice))
+            return False
+
+        for node in ast.walk(scope.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if not model.is_jit_call(node, scope):
+                continue
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                if arg_request_shaped(arg):
+                    findings.append(Finding(
+                        path=path, line=node.lineno, rule="GS002",
+                        severity="error",
+                        message=("array argument shaped by request/batch "
+                                 "state (len()/.shape dataflow) reaches a "
+                                 "jitted fn — every distinct extent is a "
+                                 "recompile; pad or bucket the shape to a "
+                                 "config-derived size first")))
+    return sorted(set(findings))
+
+
+@ast_rule("GS002", "request-shaped signature: array arg to a jitted fn "
+                   "whose shape derives from request/batch state without "
+                   "a bucket/pad helper")
+def rule_request_shaped(tree, lines, path) -> List[Finding]:
+    return _apply_justified(_gs002(_model(tree, path), tree, path), lines)
+
+
+# ---------------------------------------------------------------------------
+# GS003 — traced-value leak
+# ---------------------------------------------------------------------------
+
+# attribute reads that are STATIC under trace — they break value taint
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_TAINT_KILLING_CALLS = {"len", "isinstance", "type"}
+
+
+def _tainted_refs(expr: ast.AST, tainted: Set[str]) -> bool:
+    """Does ``expr`` reference a tainted (traced) VALUE? ``x.shape[0]``,
+    ``len(x)``, ``x is None`` do not — those are static under trace."""
+
+    def walk(node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(node, ast.Call):
+            fname = _dotted(node.func)
+            fname = fname.split(".")[-1] if fname else None
+            if fname in _TAINT_KILLING_CALLS:
+                return False
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False  # identity tests (x is None) never concretize
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        return any(walk(c) for c in ast.iter_child_nodes(node))
+
+    return walk(expr)
+
+
+def _traced_taint(fn: ast.FunctionDef,
+                  static_names: Iterable[str] = ()) -> Set[str]:
+    """Param names of a jit-traced fn (minus static args and self),
+    propagated through simple assignments."""
+    skip = set(static_names) | {"self", "cls"}
+    tainted = {a.arg for a in fn.args.posonlyargs + fn.args.args
+               if a.arg not in skip}
+    tainted |= {a.arg for a in fn.args.kwonlyargs if a.arg not in skip}
+    for _ in range(4):
+        grew = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and _tainted_refs(node.value, tainted):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id not in tainted:
+                        tainted.add(tgt.id)
+                        grew = True
+        if not grew:
+            break
+    return tainted
+
+
+def _leaks_in(fn: ast.AST, tainted: Set[str], where: str,
+              path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            if _tainted_refs(node.test, tainted):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                findings.append(Finding(
+                    path=path, line=node.lineno, rule="GS003",
+                    severity="error",
+                    message=(f"Python `{kind}` on a traced value in "
+                             f"{where} — the branch concretizes (or "
+                             f"silently bakes in) the tracer; use "
+                             f"lax.cond/lax.select or hoist the decision "
+                             f"out of the traced path")))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("int", "float", "bool") \
+                and node.args and _tainted_refs(node.args[0], tainted):
+            findings.append(Finding(
+                path=path, line=node.lineno, rule="GS003",
+                severity="error",
+                message=(f"{f.id}() on a traced value in {where} forces "
+                         f"trace-time concretization — "
+                         f"ConcretizationTypeError under jit, or a stale "
+                         f"baked-in constant")))
+        elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not node.args and _tainted_refs(f.value, tainted):
+            findings.append(Finding(
+                path=path, line=node.lineno, rule="GS003",
+                severity="error",
+                message=(f".item() on a traced value in {where} blocks "
+                         f"on device and fails under trace")))
+        elif isinstance(f, ast.Attribute) and f.attr in ("asarray", "array") \
+                and _dotted(f.value) in _NUMPY_ALIASES \
+                and node.args and _tainted_refs(node.args[0], tainted):
+            findings.append(Finding(
+                path=path, line=node.lineno, rule="GS003",
+                severity="error",
+                message=(f"np.{f.attr}() on a traced value in {where} is "
+                         f"a host sync / tracer leak; use jnp.{f.attr}")))
+    return findings
+
+
+def _gs003(model: _ShapeModel, tree: ast.Module,
+           path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    module_defs = {n.name: n for n in tree.body
+                   if isinstance(n, ast.FunctionDef)}
+    for fn in _jit_functions(tree):
+        static = set()
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and _is_jit_expr(dec):
+                static |= set(_static_arg_names(dec))
+        tainted = _traced_taint(fn, static)
+        findings += _leaks_in(fn, tainted,
+                              f"jit-traced '{fn.name}'", path)
+        # one hop into intra-module helpers the traced body calls with
+        # traced arguments — jit-REACHABLE code leaks the same way
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in module_defs
+                    and node.func.id != fn.name):
+                continue
+            helper = module_defs[node.func.id]
+            hparams = [a.arg for a in helper.args.posonlyargs
+                       + helper.args.args]
+            htaint = {hparams[i] for i, a in enumerate(node.args)
+                      if i < len(hparams) and _tainted_refs(a, tainted)}
+            if htaint:
+                findings += _leaks_in(
+                    helper, htaint,
+                    f"'{helper.name}' (jit-reachable from "
+                    f"'{fn.name}')", path)
+    return sorted(set(findings))
+
+
+@ast_rule("GS003", "traced-value leak: int()/float()/bool()/.item()/"
+                   "np.asarray() or if/while on traced values inside "
+                   "jit-decorated or jit-reachable code")
+def rule_traced_leak(tree, lines, path) -> List[Finding]:
+    return _apply_justified(_gs003(_model(tree, path), tree, path), lines)
+
+
+# ---------------------------------------------------------------------------
+# GS004 — weak-type churn
+# ---------------------------------------------------------------------------
+
+_ARRAYISH_TAILS = {"asarray", "array", "zeros", "ones", "full", "arange"}
+
+
+def _arg_class(arg: ast.AST) -> Optional[str]:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)) \
+            and not isinstance(arg.value, bool):
+        return "scalar"
+    if isinstance(arg, ast.UnaryOp) and isinstance(arg.op, ast.USub) \
+            and isinstance(arg.operand, ast.Constant):
+        return "scalar"
+    if isinstance(arg, ast.Call):
+        fname = _dotted(arg.func)
+        if fname and fname.split(".")[-1] in _ARRAYISH_TAILS:
+            return "array"
+    return None
+
+
+def _callee_label(func: ast.AST) -> Optional[str]:
+    d = _dotted(func)
+    return d
+
+
+def _gs004(model: _ShapeModel, tree: ast.Module,
+           path: str) -> List[Finding]:
+    if not _in_library(path):
+        return []
+    # callee label -> arg index -> class -> [lines]
+    seen: Dict[Tuple[str, int], Dict[str, List[int]]] = {}
+    for scope in model.scopes.values():
+        for node in ast.walk(scope.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if not model.is_jit_call(node, scope):
+                continue
+            label = _callee_label(node.func)
+            if label is None:
+                continue
+            for i, arg in enumerate(node.args):
+                cls = _arg_class(arg)
+                if cls:
+                    seen.setdefault((label, i), {}).setdefault(
+                        cls, []).append(node.lineno)
+    findings: List[Finding] = []
+    for (label, i), classes in sorted(seen.items()):
+        if "scalar" in classes and "array" in classes:
+            for line in classes["scalar"]:
+                findings.append(Finding(
+                    path=path, line=line, rule="GS004", severity="error",
+                    message=(f"bare Python scalar at positional arg {i} "
+                             f"of jitted '{label}' — other call paths "
+                             f"pass device arrays there, so the weak-type "
+                             f"signature split retraces; wrap with "
+                             f"jnp.asarray(..., dtype=...)")))
+    return sorted(set(findings))
+
+
+@ast_rule("GS004", "weak-type churn: bare Python scalar passed "
+                   "positionally into a jitted fn where device arrays "
+                   "flow on other paths — signature splits on weak types")
+def rule_weak_type_churn(tree, lines, path) -> List[Finding]:
+    return _apply_justified(_gs004(_model(tree, path), tree, path), lines)
+
+
+# ---------------------------------------------------------------------------
+# GS005 — static-arg hazard
+# ---------------------------------------------------------------------------
+
+
+def _static_coverage(call: ast.Call,
+                     wrapped: Optional[ast.FunctionDef]
+                     ) -> Tuple[Set[str], Set[int]]:
+    """(covered param names, covered positional indices) of a jit call
+    with static_argnums/static_argnames."""
+    names: Set[str] = set(_static_arg_names(call))
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nodes = (kw.value.elts if isinstance(kw.value,
+                                                 (ast.Tuple, ast.List))
+                     else [kw.value])
+            for n in nodes:
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+    if wrapped is not None:
+        params = [a.arg for a in wrapped.args.posonlyargs
+                  + wrapped.args.args]
+        for i in sorted(nums):
+            if i < len(params):
+                names.add(params[i])
+        for nm in names:
+            if nm in params:
+                nums.add(params.index(nm))
+    return names, nums
+
+
+def _mutated_self_attrs(tree: ast.Module) -> Set[str]:
+    """self attributes written OUTSIDE __init__/__new__ — per-call
+    mutable state."""
+    out: Set[str] = set()
+    for cls in (n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)):
+        for sub in cls.body:
+            if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if sub.name in ("__init__", "__new__"):
+                continue
+            for node in ast.walk(sub):
+                tgt = None
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) and isinstance(
+                                t.value, ast.Name) and t.value.id == "self":
+                            out.add(t.attr)
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                        node.target, ast.Attribute) and isinstance(
+                        node.target.value, ast.Name) \
+                        and node.target.value.id == "self":
+                    out.add(node.target.attr)
+    return out
+
+
+def _gs005(model: _ShapeModel, tree: ast.Module,
+           path: str) -> List[Finding]:
+    if not _in_library(path):
+        return []
+    mutated = _mutated_self_attrs(tree)
+    module_defs: Dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.FunctionDef):
+            module_defs.setdefault(n.name, n)
+    findings: List[Finding] = []
+    for site in model.sites:
+        call = site.call_node
+        if call is None or not any(kw.arg in ("static_argnums",
+                                              "static_argnames")
+                                   for kw in call.keywords):
+            continue
+        wrapped = module_defs.get(site.name_hint)
+        names, nums = _static_coverage(call, wrapped)
+        # call sites dispatching this jit handle: a static-covered slot
+        # receiving per-call-mutated self state recompiles per mutation
+        for scope in model.scopes.values():
+            for node in ast.walk(scope.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if site.index not in model.is_jit_call(node, scope):
+                    continue
+                hazards: List[Tuple[str, str]] = []
+                for i, arg in enumerate(node.args):
+                    if i in nums and isinstance(arg, ast.Attribute) \
+                            and isinstance(arg.value, ast.Name) \
+                            and arg.value.id == "self" \
+                            and arg.attr in mutated:
+                        hazards.append((f"arg {i}", f"self.{arg.attr}"))
+                for kw in node.keywords:
+                    if kw.arg in names and isinstance(kw.value,
+                                                      ast.Attribute) \
+                            and isinstance(kw.value.value, ast.Name) \
+                            and kw.value.value.id == "self" \
+                            and kw.value.attr in mutated:
+                        hazards.append((kw.arg, f"self.{kw.value.attr}"))
+                for slot, attr in hazards:
+                    findings.append(Finding(
+                        path=path, line=node.lineno, rule="GS005",
+                        severity="error",
+                        message=(f"static arg {slot} of jitted "
+                                 f"'{site.name_hint or '<fn>'}' receives "
+                                 f"{attr}, which this module mutates "
+                                 f"outside __init__ — every new value is "
+                                 f"a full recompile; pass it traced or "
+                                 f"make it immutable config")))
+    return sorted(set(findings))
+
+
+@ast_rule("GS005", "static-arg hazard: static_argnums/static_argnames "
+                   "covering a value the same module mutates per call — "
+                   "every mutation recompiles")
+def rule_static_arg_hazard(tree, lines, path) -> List[Finding]:
+    return _apply_justified(_gs005(_model(tree, path), tree, path), lines)
+
+
+GS_RULES = ("GS001", "GS002", "GS003", "GS004", "GS005")
+
+
+# ---------------------------------------------------------------------------
+# repo-wide static jit-boundary inventory (the shapetrace cross-validation
+# leg — the graftshape analog of rules_concurrency.static_lock_order)
+# ---------------------------------------------------------------------------
+
+
+class ShapeInventory:
+    """The statically derived jit-boundary map of the repo:
+
+    * ``jit_sites``: every jit-creating line, with whether its fn is
+      ledgered (reaches ``note_jit_signature``) and whether an inline
+      ``graftshape: justified`` marker covers it;
+    * ``registration_spans``: path -> (start, end) line spans of
+      ``note_jit_signature`` / direct ``ledger.record`` calls — the ONLY
+      places a ``CompileEvent.callsite`` may legally point at;
+    * ``hazards``: path -> raw GS findings (justified ones INCLUDED,
+      tagged) — the modules where a ``new_shape`` event is statically
+      explicable;
+    * ``clean_modules``: paths with zero raw findings — the modules the
+      honesty contract says must observe zero ``new_shape``.
+    """
+
+    def __init__(self) -> None:
+        self.jit_sites: List[Dict[str, object]] = []
+        self.registration_spans: Dict[str, List[Tuple[int, int]]] = {}
+        self.hazards: Dict[str, List[Dict[str, object]]] = {}
+        self.clean_modules: List[str] = []
+
+    def attributes_callsite(self, callsite: str) -> bool:
+        """Is a runtime ``path:line`` callsite inside a statically known
+        registration span? Line RANGES matter: a multiline
+        note_jit_signature call's runtime frame line can be any line of
+        the call expression."""
+        path, _, line_s = callsite.rpartition(":")
+        try:
+            line = int(line_s)
+        except ValueError:
+            return False
+        return any(lo <= line <= hi
+                   for lo, hi in self.registration_spans.get(path, ()))
+
+    def hazard_module(self, path: str) -> bool:
+        return bool(self.hazards.get(path))
+
+
+def static_shape_inventory(repo_root: str,
+                           roots: Sequence[str] = ("deeplearning4j_tpu",)
+                           ) -> ShapeInventory:
+    """Build the repo-wide jit-boundary inventory for the shapetrace
+    runtime cross-validation. Raw findings (pre-justification) feed the
+    hazard map — a justified hazard is still a hazard at runtime, just an
+    accepted one."""
+    inv = ShapeInventory()
+    for rel in iter_py_files(roots, repo_root):
+        with open(os.path.join(repo_root, rel), "r",
+                  encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError:
+            continue
+        lines = src.splitlines()
+        just = _justified_lines(lines)
+
+        def justified(rule: str, line: int) -> bool:
+            return (rule in just.get(line, ())
+                    or rule in just.get(line - 1, ()))
+
+        model = _model(tree, rel)
+        raw: List[Finding] = []
+        raw += _gs001(model, rel)
+        raw += _gs002(model, tree, rel)
+        raw += _gs003(model, tree, rel)
+        raw += _gs004(model, tree, rel)
+        raw += _gs005(model, tree, rel)
+        if model.registration_spans:
+            inv.registration_spans[rel] = sorted(model.registration_spans)
+        for site in model.sites:
+            inv.jit_sites.append({
+                "path": rel, "line": site.line,
+                "name": site.name_hint,
+                "ledgered": site.index in model.registered,
+                "justified": justified("GS001", site.line),
+            })
+        if raw:
+            inv.hazards[rel] = [
+                {"line": f.line, "rule": f.rule,
+                 "justified": justified(f.rule, f.line)}
+                for f in sorted(set(raw))]
+        else:
+            inv.clean_modules.append(rel)
+    inv.clean_modules.sort()
+    return inv

@@ -224,6 +224,7 @@ class BertModel:
                 new_s.append(ns)
             return treedef.unflatten(new_p), treedef.unflatten(new_s), loss
 
+        # graftshape: justified(GS001): classifier train step — batch shape is fixed by the fit_classifier iterator config; the epoch-loss history is the module's own attribution
         return jax.jit(step_fn, donate_argnums=(0, 1))
 
     def fit_classifier(self, iterator, epochs: int = 1) -> List[float]:
@@ -269,6 +270,7 @@ class BertModel:
                 new_s.append(ns)
             return treedef.unflatten(new_p), treedef.unflatten(new_s), loss
 
+        # graftshape: justified(GS001): MLM train step — batch/seq shapes are fixed by the pretraining iterator config, one compile per fit
         return jax.jit(step_fn, donate_argnums=(0, 1))
 
     def fit_mlm(self, iterator, epochs: int = 1) -> List[float]:
@@ -298,6 +300,7 @@ class BertModel:
         key = ("mlm_scanned", steps)
         many = self._jit.get(key)
         if many is None:
+            # graftshape: justified(GS001): scanned multi-step kernel — shapes fixed by the pretraining config, cached in self._jit per donation-safe key
             @functools.partial(jax.jit, donate_argnums=(0, 1))
             def many(params, opt_state, start, rng, ids, segments, mask,
                      mlm_labels, mlm_mask):
@@ -325,6 +328,7 @@ class BertModel:
     def predict(self, ids, segments=None, mask=None) -> np.ndarray:
         fn = self._jit.get("predict")
         if fn is None:
+            # graftshape: justified(GS001): inference forward — compiled once per (ids, segments, mask) geometry the caller controls; prediction is host-driven, not serving traffic
             @jax.jit
             def fn(params, ids, segments, mask):
                 return classification_logits(params, ids, segments, mask, self.cfg)

@@ -98,6 +98,7 @@ class GloVe:
         cols_j = jnp.asarray(cols)
         lr = self.lr
 
+        # graftshape: justified(GS001): whole-epoch scan step over a fixed co-occurrence table — exactly one compile per fit
         @jax.jit
         def epoch_step(state, order):
             def batch_step(state, idx):

@@ -70,6 +70,7 @@ class ParagraphVectors:
         return np.asarray(d_idx, np.int32), np.asarray(w_idx, np.int32)
 
     def _make_step(self):
+        # graftshape: justified(GS001): PV-DBOW negative-sampling step — batch geometry fixed by the training config, one compile per fit
         @jax.jit
         def step(docv, syn1, docs, words, negs, lr):
             v = docv[docs]
@@ -167,6 +168,7 @@ class ParagraphVectors:
         if fn is None:
             D = self.layer_size
 
+            # graftshape: justified(GS001): infer-vector inner step — per-document inference jit with config-fixed negative-sample geometry
             @jax.jit
             def fn(v, syn1, words, negs, lr):
                 u_pos = syn1[words]

@@ -127,6 +127,7 @@ class Word2Vec:
             syn1 = syn1 - lr * acc1 / jnp.maximum(cnt1, 1.0)[:, None]
             return syn0, syn1, loss
 
+        # graftshape: justified(GS001): hierarchical-softmax train step — batch shape fixed by batch_size (the ragged tail batch is the GS002 note in fit)
         return jax.jit(step, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------ fit
@@ -162,6 +163,7 @@ class Word2Vec:
             syn1 = syn1 - lr * acc1 / jnp.maximum(cnt1, 1.0)[:, None]
             return syn0, syn1, loss
 
+        # graftshape: justified(GS001): negative-sampling train step — same fixed batch geometry as the HS step
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _pairs(self, sentences: List[List[str]], rng: np.random.RandomState):
@@ -243,6 +245,7 @@ class Word2Vec:
                 if len(idx) < 2:
                     continue
                 ctx = contexts[idx]
+                # graftshape: justified(GS002): the permutation TAIL batch is the one ragged shape — at most one extra trace per corpus (len % batch_size), accepted; padding it would change the HS loss math
                 self.syn0, self.syn1, loss = step(
                     self.syn0, self.syn1, jnp.asarray(centers[idx]),
                     paths_j[ctx], codes_j[ctx], mask_j[ctx],

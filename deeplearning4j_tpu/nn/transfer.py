@@ -193,11 +193,18 @@ class TransferLearningHelper:
     def featurize(self, ds: DataSet) -> DataSet:
         import numpy as np
 
+        from deeplearning4j_tpu import observe
+
         fm = None if ds.features_mask is None else np.asarray(ds.features_mask,
                                                               np.float32)
+        x = np.asarray(ds.features, np.float32)
+        # ledger the frozen-prefix forward: featurize runs once per dataset,
+        # so a distinct dataset shape is an HONEST new_shape event here
+        observe.note_jit_signature(
+            self._prefix, graph="transfer", key="prefix_forward",
+            signature=observe.signature_of(x=x, mask=fm))
         feats, out_mask = self._prefix(
-            self.net.params, self.net.net_state,
-            np.asarray(ds.features, np.float32), fm)
+            self.net.params, self.net.net_state, x, fm)
         return DataSet(np.asarray(feats), ds.labels,
                        None if out_mask is None else np.asarray(out_mask),
                        ds.labels_mask)

@@ -27,6 +27,8 @@ event when ``DL4J_TPU_OBS_LOG`` is set.
 from __future__ import annotations
 
 import dataclasses
+import os
+import sys
 import threading
 import time
 from collections import deque
@@ -39,6 +41,33 @@ CAUSES = ("first_compile", "new_shape", "graph_mutation",
 
 _MAX_EVENTS = 2000
 
+# the observe package dir (frames inside it are plumbing, not callsites)
+# and the repo root callsites are reported relative to
+_OBS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_OBS_DIR))
+
+
+def _caller_callsite() -> Optional[str]:
+    """Repo-relative ``path:line`` of the nearest stack frame OUTSIDE the
+    observe package — the source site that registered this compile event.
+    graftshape's runtime cross-validation (testing/shapetrace.py) matches
+    these against the static registration-site inventory, so the format
+    (forward slashes, repo-relative when under the repo) must agree with
+    lint ``Finding.path``."""
+    f = sys._getframe(1)
+    while f is not None:
+        fname = f.f_code.co_filename
+        if not os.path.abspath(fname).startswith(_OBS_DIR):
+            try:
+                rel = os.path.relpath(fname, _REPO_ROOT)
+            except ValueError:  # different drive (windows) — keep absolute
+                rel = fname
+            if rel.startswith(".."):
+                rel = fname
+            return f"{rel.replace(os.sep, '/')}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
 
 @dataclasses.dataclass
 class CompileEvent:
@@ -49,11 +78,12 @@ class CompileEvent:
     cause: str
     timestamp: float      # epoch seconds (display only; never subtracted)
     stats: Any = None     # OptimizeStats (live reference) or None
+    callsite: Optional[str] = None  # "path:line" of the registering site
 
     def to_dict(self) -> Dict[str, Any]:
         out = {"seq": self.seq, "graph": self.graph, "key": self.key,
                "signature": self.signature, "cause": self.cause,
-               "timestamp": self.timestamp}
+               "timestamp": self.timestamp, "callsite": self.callsite}
         st = self.stats
         if st is not None:
             out["trace_seconds"] = getattr(st, "trace_seconds", None)
@@ -78,21 +108,25 @@ class RecompileLedger:
         self._seq = 0
 
     def record(self, *, graph: str, key: str, signature: str, cause: str,
-               stats: Any = None) -> CompileEvent:
+               stats: Any = None,
+               callsite: Optional[str] = None) -> CompileEvent:
         if cause not in CAUSES:
             raise ValueError(f"unknown recompile cause '{cause}'; "
                              f"valid: {list(CAUSES)}")
+        if callsite is None:
+            callsite = _caller_callsite()
         with self._lock:
             self._seq += 1
             ev = CompileEvent(seq=self._seq, graph=graph, key=key,
                               signature=signature, cause=cause,
-                              timestamp=time.time(), stats=stats)
+                              timestamp=time.time(), stats=stats,
+                              callsite=callsite)
             self._events.append(ev)
         m = default_registry()
         m.counter("dl4j_tpu_recompiles_total").inc()
         m.counter("dl4j_tpu_recompile_cause_total", cause=cause).inc()
         fields = {"graph": graph, "key": key, "signature": signature,
-                  "cause": cause}
+                  "cause": cause, "callsite": callsite}
         fusions = getattr(stats, "fusions", None) if stats is not None \
             else None
         if fusions:
@@ -113,12 +147,16 @@ class RecompileLedger:
     def summary(self) -> Dict[str, Any]:
         evs = self.events()
         by_cause: Dict[str, int] = {}
+        by_callsite: Dict[str, int] = {}
         for ev in evs:
             by_cause[ev.cause] = by_cause.get(ev.cause, 0) + 1
+            cs = ev.callsite or "<unknown>"
+            by_callsite[cs] = by_callsite.get(cs, 0) + 1
         compile_s = [getattr(ev.stats, "compile_seconds", None)
                      for ev in evs if ev.stats is not None]
         compile_s = [s for s in compile_s if s is not None]
         return {"total": len(evs), "by_cause": by_cause,
+                "by_callsite": by_callsite,
                 "compile_seconds_sum": round(sum(compile_s), 4)
                 if compile_s else None}
 
@@ -168,8 +206,8 @@ def signature_of(*arrays: Any, **named: Any) -> str:
 
 def note_jit_signature(fn: Any, *, graph: str, key: str, signature: str,
                        stats: Any = None,
-                       cause_if_new_fn: str = "first_compile"
-                       ) -> Optional[str]:
+                       cause_if_new_fn: str = "first_compile",
+                       callsite: Optional[str] = None) -> Optional[str]:
     """Record a compile event iff ``signature`` is new for ``fn``.
 
     The seen-signature set rides ON the cached function object, so the
@@ -179,8 +217,10 @@ def note_jit_signature(fn: Any, *, graph: str, key: str, signature: str,
     (jax retraces per shape under the hood). ``stats`` is attached only to
     the new-fn event: a new_shape retrace never re-ran the optimizer, so
     inheriting the original compile's OptimizeStats would double-count its
-    trace/compile seconds in ledger summaries. Returns the cause recorded,
-    or None on a plain cache hit."""
+    trace/compile seconds in ledger summaries. ``callsite`` defaults to
+    the nearest caller frame outside the observe package — the source
+    site graftshape's shapetrace attributes the event to. Returns the
+    cause recorded, or None on a plain cache hit."""
     try:
         sigs = fn._obs_sigs
     except AttributeError:
@@ -193,6 +233,11 @@ def note_jit_signature(fn: Any, *, graph: str, key: str, signature: str,
     new_fn = not sigs
     cause = cause_if_new_fn if new_fn else "new_shape"
     sigs.add(signature)
+    if callsite is None:
+        # resolved HERE (not in record) so the cache-hit fast path above
+        # never pays the stack walk
+        callsite = _caller_callsite()
     default_ledger().record(graph=graph, key=key, signature=signature,
-                            cause=cause, stats=stats if new_fn else None)
+                            cause=cause, stats=stats if new_fn else None,
+                            callsite=callsite)
     return cause

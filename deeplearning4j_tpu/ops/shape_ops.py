@@ -651,6 +651,7 @@ def reshape_dynamic(x, shape):
         # deliberately numpy-static, same family as shape_of/stack: the
         # shape operand must be trace-time concrete (tracers are refused
         # loudly below), so np here is the contract, not a fallback
+        # graftshape: justified(GS003): the shape operand is REQUIRED to be trace-time concrete — np.asarray is the concreteness probe, and a leaked tracer is converted to a loud NotImplementedError below
         dims = tuple(int(s) for s in np.asarray(shape))  # graftlint: disable=GL009
     except Exception as e:  # a tracer leaked into the shape chain
         raise NotImplementedError(
@@ -676,7 +677,13 @@ def _check_reshape_dynamic():
         tgt = _REG.exec("stack", s[0] * s[1])
         return reshape_dynamic(a, tgt)
 
-    assert f(jnp.zeros((3, 4))).shape == (12,)
+    from deeplearning4j_tpu import observe
+
+    x34 = jnp.zeros((3, 4))
+    observe.note_jit_signature(
+        f, graph="ops", key="reshape_dynamic_check",
+        signature=observe.signature_of(a=x34))
+    assert f(x34).shape == (12,)
 
 
 @validation.case("space_to_batch")

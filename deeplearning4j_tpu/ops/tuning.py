@@ -321,6 +321,7 @@ def aot_time(fn: Callable, args: Sequence[Any], iters: int = 3,
     cache with entries real dispatch would then collide with."""
     import jax
 
+    # graftshape: justified(GS001): AOT-timed candidate executables are deliberately cache-free and discarded after timing — ledgering them would record one first_compile per ladder rung as if it were serving traffic
     compiled = jax.jit(fn).lower(*args).compile()
     out = compiled(*args)
     jax.block_until_ready(out)  # warm + fail loudly before timing

@@ -634,5 +634,12 @@ class ParallelInference:
             repl = NamedSharding(self.mesh, P())
             params = jax.device_put(net.params, repl)
             net_state = jax.device_put(net.net_state, repl)
-            out = self._build_fn()(params, net_state, xs)
+            fn = self._build_fn()
+            # ledger the sharded forward: the batch is padded to a multiple
+            # of the data-mesh size, so a distinct padded batch shape is an
+            # honest (and now attributable) new_shape event
+            observe.note_jit_signature(
+                fn, graph="parallel", key="mesh_output",
+                signature=observe.signature_of(x=xs))
+            out = fn(params, net_state, xs)
         return np.asarray(out)[:orig]

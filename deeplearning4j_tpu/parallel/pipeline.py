@@ -255,6 +255,10 @@ class PipelineParallelTrainer:
         listeners, periodic checkpointing."""
         if self._jit_step is None:
             self._jit_step = self.make_train_step()
+        from deeplearning4j_tpu import observe
+        observe.note_jit_signature(
+            self._jit_step, graph="parallel", key="pipeline_train_step",
+            signature=observe.signature_of(x=x, y=y))
         (self.stacked_params, self.head_params, self.opt_state,
          loss) = self._jit_step(self.stacked_params, self.head_params,
                                 self.opt_state,

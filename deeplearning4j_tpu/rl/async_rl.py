@@ -120,9 +120,11 @@ class A3CDiscrete:
         self._obs = [e.reset() for e in self.envs]
         self._ep_rewards = np.zeros(n_envs)
         self.episode_rewards: List[float] = []
+        # graftshape: justified(GS001): actor-side policy forward — n_envs-shaped, fixed for the worker's lifetime
         self._policy_fwd = jax.jit(
             lambda p, s: policy_net._forward(p, policy_net.net_state, s, None,
                                              train=False, rng=None)[0])
+        # graftshape: justified(GS001): actor-side value forward — n_envs-shaped, fixed for the worker's lifetime
         self._value_fwd = jax.jit(
             lambda p, s: value_net._forward(p, value_net.net_state, s, None,
                                             train=False, rng=None)[0][:, 0])
@@ -163,6 +165,7 @@ class A3CDiscrete:
             return ([p for p, _ in pu], [st for _, st in pu],
                     [p for p, _ in vu], [st for _, st in vu], p_l + v_l)
 
+        # graftshape: justified(GS001): A2C fused update — rollout geometry (n_envs x n_steps) is fixed config
         return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
 
     def _rollout(self):
@@ -242,6 +245,7 @@ class AsyncNStepQLearningDiscrete:
         self._ep_rewards = np.zeros(n_envs)
         self.episode_rewards: List[float] = []
         self.target_params = jax.tree.map(jnp.asarray, q_net.params)
+        # graftshape: justified(GS001): async-DQN online forward — n_envs-shaped, fixed for the worker's lifetime
         self._fwd = jax.jit(
             lambda p, s: q_net._forward(p, q_net.net_state, s, None,
                                         train=False, rng=None)[0])
@@ -269,6 +273,7 @@ class AsyncNStepQLearningDiscrete:
                 step, net._normalize_gradient)
             return ([p for p, _ in upd], [st for _, st in upd], loss)
 
+        # graftshape: justified(GS001): async-DQN update step — replay minibatch shape is fixed config
         return jax.jit(step_fn)
 
     def train_batch(self) -> float:

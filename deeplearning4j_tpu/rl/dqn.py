@@ -189,6 +189,7 @@ class QLearningDiscrete:
         # no donation: params and target_params alias right after a target
         # sync (donating an aliased buffer is an XLA error), and RL nets are
         # small enough that the copy is irrelevant
+        # graftshape: justified(GS001): TD step over a fixed-size replay minibatch — one compile per run
         return jax.jit(td_step)
 
     def q_values(self, obs: np.ndarray) -> np.ndarray:
@@ -295,6 +296,7 @@ class ActorCritic:
             return ([p for p, _ in pu], [s_ for _, s_ in pu],
                     [p for p, _ in vu], [s_ for _, s_ in vu], p_l + v_l)
 
+        # graftshape: justified(GS001): double-DQN fused step — replay minibatch shape is fixed config, one compile per run
         return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
 
     def _action(self, obs) -> int:

@@ -1151,6 +1151,9 @@ class GenerativeEngine:
         observe.note_jit_signature(
             self._prefill_fn, graph="serving", key="prefill",
             signature=observe.signature_of(ids=ids))
+        observe.note_jit_signature(
+            self._write_fn, graph="serving", key="write_prompt",
+            signature=observe.signature_of(ids=ids))
         with observe.tracer().span("serving_prefill", category="serving",
                                    prompt_len=p_len):
             kv_prompt, tok = self._prefill_fn(

@@ -214,6 +214,7 @@ def _run_case(case: Case, cpu_dev, tpu_dev) -> List[str]:
         # be f32 math on the MXU, not silently bf16 (round-2 weak #2).
         with jax.default_device(dev), DT.precision_scope("float32"):
             args_d = jax.tree.map(lambda a: jax.device_put(a, dev), args)
+            # graftshape: justified(GS001): per-case throwaway jit — each consistency case compiles once per device and is discarded; the harness's own pass/fail report is the attribution
             return jax.tree.map(np.asarray, jax.jit(f)(*args_d))
 
     try:

@@ -1,0 +1,103 @@
+"""shapetrace — runtime ledger tracer cross-validating graftshape.
+
+The static jit-boundary inventory (:func:`..lint.rules_shape.
+static_shape_inventory`) is an over-approximation built from the AST;
+this module is the under-approximation built from execution: snapshot
+the :class:`~deeplearning4j_tpu.observe.RecompileLedger` before a
+workload, run it, then hold every ``CompileEvent`` recorded since
+against the inventory. The honesty contract, checked by
+:meth:`ShapeTracer.check`:
+
+* every event's ``callsite`` must land inside a statically known
+  registration span (a ``note_jit_signature`` / ``ledger.record`` call
+  expression) of a scanned module — an event with no callsite, or with
+  a callsite the static scan never saw, means a registration path the
+  analyzer's dataflow missed (a graftshape blind spot to fix in
+  ``rules_shape``, not to baseline away); events attributed to files
+  OUTSIDE the scanned roots (tests, tools) are counted separately as
+  ``external`` and do not fail the check;
+* every ``new_shape`` event must attribute to a module the static scan
+  flagged as a shape hazard (a raw GS finding, justified or not) — a
+  ``new_shape`` rising out of a statically CLEAN module means either
+  the module's bucketing contract broke at runtime or the analyzer has
+  a false negative; both are failures.
+
+The two directions together are the same bargain locktrace strikes for
+locks: static says "nothing outside this boundary can happen", runtime
+says "here is what did happen", and the gate fails unless runtime ⊆
+static.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.lint.rules_shape import (
+    ShapeInventory, static_shape_inventory)
+
+__all__ = ["ShapeTracer", "static_shape_inventory"]
+
+
+class ShapeTracer:
+    """Ledger-window recorder: construction snapshots the event count,
+    :meth:`check` judges everything recorded since."""
+
+    def __init__(self) -> None:
+        from deeplearning4j_tpu import observe
+        self._start = len(observe.ledger().events())
+
+    def events(self) -> List[Any]:
+        from deeplearning4j_tpu import observe
+        return list(observe.ledger().events()[self._start:])
+
+    def check(self, repo_root: str,
+              inventory: Optional[ShapeInventory] = None,
+              roots: Sequence[str] = ("deeplearning4j_tpu",)
+              ) -> Dict[str, Any]:
+        """Cross-validate the ledger window against the static
+        inventory. Returns a report dict whose ``ok`` is True iff every
+        in-root event attributes to a registration span AND every
+        ``new_shape`` lands in a statically flagged hazard module."""
+        if inventory is None:
+            inventory = static_shape_inventory(repo_root, roots=roots)
+        evs = self.events()
+        unattributed: List[Dict[str, Any]] = []
+        external = 0
+        new_shape_unexplained: List[Dict[str, Any]] = []
+        new_shape_total = 0
+        for ev in evs:
+            cs = getattr(ev, "callsite", None)
+            if cs is None:
+                unattributed.append({"graph": ev.graph, "key": ev.key,
+                                     "cause": ev.cause, "callsite": None})
+                continue
+            path = cs.rpartition(":")[0]
+            in_roots = any(path == r or path.startswith(r + "/")
+                           for r in roots)
+            if not in_roots:
+                external += 1
+            elif not inventory.attributes_callsite(cs):
+                unattributed.append({"graph": ev.graph, "key": ev.key,
+                                     "cause": ev.cause, "callsite": cs})
+            if ev.cause == "new_shape":
+                new_shape_total += 1
+                if in_roots and not inventory.hazard_module(path):
+                    new_shape_unexplained.append(
+                        {"graph": ev.graph, "key": ev.key,
+                         "callsite": cs})
+        by_cause: Dict[str, int] = {}
+        for ev in evs:
+            by_cause[ev.cause] = by_cause.get(ev.cause, 0) + 1
+        return {
+            "ok": not unattributed and not new_shape_unexplained,
+            "events": len(evs),
+            "by_cause": dict(sorted(by_cause.items())),
+            "external": external,
+            "unattributed": unattributed,
+            "new_shape_total": new_shape_total,
+            "new_shape_unexplained": new_shape_unexplained,
+            "registration_span_files": len(inventory.registration_spans),
+            "jit_sites": len(inventory.jit_sites),
+            "hazard_modules": len(inventory.hazards),
+            "clean_modules": len(inventory.clean_modules),
+        }

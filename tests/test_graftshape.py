@@ -1,0 +1,581 @@
+"""graftshape — the static jit-signature & recompile-discipline tier
+(docs/LINT.md § graftshape).
+
+Per-rule fixtures for GS001-GS005 (positives AND the linkage negatives
+the dataflow model exists for: direct registration, registrar helpers,
+wrapper objects, producer methods, IfExp selection), the justified-
+marker contract, the shrink-only baseline ride-along, the repo-wide
+zero-unbaselined acceptance assertion, the CompileEvent.callsite
+plumbing, and a slow live slice of the shapetrace cross-validation
+(the gate's ``shapetrace`` stage runs the fuller tools/shapetrace.py
+harness)."""
+
+import os
+import textwrap
+
+import pytest
+
+from deeplearning4j_tpu.lint import Finding, lint_paths, lint_source, \
+    write_baseline
+from deeplearning4j_tpu.lint.rules_shape import (
+    GS_RULES, static_shape_inventory)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src, rules=GS_RULES):
+    return lint_source(textwrap.dedent(src), path="fixture.py",
+                       rules=rules)
+
+
+def _rules_hit(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# GS001 — unledgered jit
+# ---------------------------------------------------------------------------
+
+
+class TestGS001Unledgered:
+    def test_true_positive_bare_assignment(self):
+        fs = _lint("""
+            import jax
+
+            def build(f):
+                step = jax.jit(f)
+                return step
+        """, rules={"GS001"})
+        assert _rules_hit(fs) == {"GS001"}
+
+    def test_true_positive_decorator(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * 2
+        """, rules={"GS001"})
+        assert _rules_hit(fs) == {"GS001"}
+
+    def test_true_positive_inline_call(self):
+        fs = _lint("""
+            import jax
+
+            def run(f, x):
+                return jax.jit(f)(x)
+        """, rules={"GS001"})
+        assert _rules_hit(fs) == {"GS001"}
+
+    def test_true_negative_direct_registration(self):
+        fs = _lint("""
+            import jax
+            from deeplearning4j_tpu import observe
+
+            def build(f, x):
+                step = jax.jit(f)
+                observe.note_jit_signature(
+                    step, graph="g", key="k",
+                    signature=observe.signature_of(x=x))
+                return step
+        """, rules={"GS001"})
+        assert fs == []
+
+    def test_true_negative_registrar_helper(self):
+        # samediff pattern: the jit flows through a parameter into a
+        # helper that does the note — the dataflow must follow it
+        fs = _lint("""
+            import jax
+            from deeplearning4j_tpu import observe
+
+            class G:
+                def _note(self, fn, x):
+                    observe.note_jit_signature(
+                        fn, graph="g", key="k",
+                        signature=observe.signature_of(x=x))
+
+                def build(self, f, x):
+                    step = jax.jit(f)
+                    self._note(step, x)
+                    return step
+        """, rules={"GS001"})
+        assert fs == []
+
+    def test_true_negative_producer_method(self):
+        # engine pattern: self._fn built by a producer method, noted at
+        # the dispatch site
+        fs = _lint("""
+            import jax
+            from deeplearning4j_tpu import observe
+
+            class E:
+                def _build(self):
+                    return jax.jit(lambda p, x: x)
+
+                def step(self, x):
+                    if self._fn is None:
+                        self._fn = self._build()
+                    observe.note_jit_signature(
+                        self._fn, graph="g", key="k",
+                        signature=observe.signature_of(x=x))
+                    return self._fn(None, x)
+        """, rules={"GS001"})
+        assert fs == []
+
+    def test_true_negative_ifexp_selection(self):
+        # multilayer pattern: the registered fn is chosen between two
+        # producers with a conditional expression
+        fs = _lint("""
+            import jax
+            from deeplearning4j_tpu import observe
+
+            class M:
+                def _a(self):
+                    return jax.jit(lambda x: x)
+
+                def _b(self):
+                    return jax.jit(lambda x: -x)
+
+                def fit(self, tbptt, x):
+                    step = (self._a() if tbptt else self._b())
+                    observe.note_jit_signature(
+                        step, graph="g", key="k",
+                        signature=observe.signature_of(x=x))
+                    return step(x)
+        """, rules={"GS001"})
+        assert fs == []
+
+    def test_true_negative_wrapper_object(self):
+        # CompiledGraph pattern: the jit is swallowed by a wrapper whose
+        # constructor call is itself registered
+        fs = _lint("""
+            import jax
+            from deeplearning4j_tpu import observe
+
+            class Wrapped:
+                def __init__(self, fn):
+                    self.fn = fn
+
+            def build(run, x):
+                g = Wrapped(jax.jit(run))
+                observe.note_jit_signature(
+                    g, graph="g", key="k",
+                    signature=observe.signature_of(x=x))
+                return g
+        """, rules={"GS001"})
+        assert fs == []
+
+    def test_tools_and_examples_are_out_of_scope(self):
+        src = """
+            import jax
+
+            def bench(f, x):
+                return jax.jit(f)(x)
+        """
+        assert lint_source(textwrap.dedent(src), path="tools/bench_x.py",
+                           rules={"GS001"}) == []
+        assert lint_source(textwrap.dedent(src), path="examples/demo.py",
+                           rules={"GS001"}) == []
+        assert lint_source(textwrap.dedent(src),
+                           path="deeplearning4j_tpu/x.py",
+                           rules={"GS001"}) != []
+
+
+# ---------------------------------------------------------------------------
+# GS002 — request-shaped jit signature
+# ---------------------------------------------------------------------------
+
+
+class TestGS002RequestShaped:
+    def test_true_positive_len_sized_buffer(self):
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            class S:
+                def __init__(self, run):
+                    self._fn = jax.jit(run)
+
+                def admit(self, prompt):
+                    n = len(prompt)
+                    ids = np.zeros((1, n), np.int32)
+                    return self._fn(ids)
+        """, rules={"GS002"})
+        assert _rules_hit(fs) == {"GS002"}
+
+    def test_true_positive_shape_sliced_arg(self):
+        fs = _lint("""
+            import jax
+
+            class S:
+                def __init__(self, run):
+                    self._fn = jax.jit(run)
+
+                def admit(self, prompt, table):
+                    n = prompt.shape[0]
+                    return self._fn(table[:n])
+        """, rules={"GS002"})
+        assert _rules_hit(fs) == {"GS002"}
+
+    def test_true_negative_bucketed(self):
+        # routing the raw length through a bucketing helper launders the
+        # taint — that is the fix the rule demands
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            def pad_bucket(n):
+                return 1 << max(4, n.bit_length())
+
+            class S:
+                def __init__(self, run):
+                    self._fn = jax.jit(run)
+
+                def admit(self, prompt):
+                    n = pad_bucket(len(prompt))
+                    ids = np.zeros((1, n), np.int32)
+                    return self._fn(ids)
+        """, rules={"GS002"})
+        assert fs == []
+
+    def test_true_negative_fixed_shape(self):
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            class S:
+                def __init__(self, run):
+                    self._fn = jax.jit(run)
+
+                def admit(self, prompt):
+                    ids = np.zeros((1, 128), np.int32)
+                    return self._fn(ids)
+        """, rules={"GS002"})
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# GS003 — traced-value leak
+# ---------------------------------------------------------------------------
+
+
+class TestGS003TracedLeak:
+    def test_true_positive_branch_on_traced(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                if x > 0:
+                    return x
+                return -x
+        """, rules={"GS003"})
+        assert _rules_hit(fs) == {"GS003"}
+
+    def test_true_positive_python_cast(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x)
+        """, rules={"GS003"})
+        assert _rules_hit(fs) == {"GS003"}
+
+    def test_true_negative_static_argname(self):
+        fs = _lint("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def step(x, mode):
+                if mode == "fast":
+                    return x
+                return -x
+        """, rules={"GS003"})
+        assert fs == []
+
+    def test_true_negative_shape_access_is_static(self):
+        # .shape/.ndim/.dtype of a tracer are trace-time constants
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                if x.ndim == 2:
+                    return x.reshape(x.shape[0], -1)
+                return x
+        """, rules={"GS003"})
+        assert fs == []
+
+    def test_true_negative_none_guard(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def step(x, mask=None):
+                if mask is None:
+                    return x
+                return x * mask
+        """, rules={"GS003"})
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# GS004 — weak-type churn
+# ---------------------------------------------------------------------------
+
+
+class TestGS004WeakType:
+    def test_true_positive_scalar_and_array(self):
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def run(f, x):
+                step = jax.jit(f)
+                step(x, 0.5)
+                step(x, jnp.asarray(0.5, jnp.float32))
+        """, rules={"GS004"})
+        assert _rules_hit(fs) == {"GS004"}
+
+    def test_true_negative_consistent_arrays(self):
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def run(f, x):
+                step = jax.jit(f)
+                step(x, jnp.asarray(0.5, jnp.float32))
+                step(x, jnp.asarray(0.9, jnp.float32))
+        """, rules={"GS004"})
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# GS005 — static-arg hazard
+# ---------------------------------------------------------------------------
+
+
+class TestGS005StaticArgHazard:
+    def test_true_positive_mutated_attr_as_static(self):
+        fs = _lint("""
+            import jax
+
+            class T:
+                def __init__(self):
+                    self.k = 4
+
+                def tune(self, k):
+                    self.k = k
+
+                def build(self, x):
+                    fn = jax.jit(self._step, static_argnames=("k",))
+                    return fn(x, k=self.k)
+        """, rules={"GS005"})
+        assert _rules_hit(fs) == {"GS005"}
+
+    def test_true_negative_init_only_config(self):
+        fs = _lint("""
+            import jax
+
+            class T:
+                def __init__(self, k):
+                    self.k = k
+
+                def build(self, x):
+                    fn = jax.jit(self._step, static_argnames=("k",))
+                    return fn(x, k=self.k)
+        """, rules={"GS005"})
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# justified-marker contract
+# ---------------------------------------------------------------------------
+
+
+_JITTER = """
+    import jax
+
+    def build(f):
+        step = jax.jit(f){trailer}
+        return step
+"""
+
+
+class TestJustified:
+    def test_same_line_with_reason_suppresses(self):
+        fs = _lint(_JITTER.format(
+            trailer="  # graftshape: justified(GS001): bench-local throwaway"),
+            rules={"GS001"})
+        assert fs == []
+
+    def test_line_above_suppresses(self):
+        fs = _lint("""
+            import jax
+
+            def build(f):
+                # graftshape: justified(GS001): bench-local throwaway
+                step = jax.jit(f)
+                return step
+        """, rules={"GS001"})
+        assert fs == []
+
+    def test_reason_is_mandatory(self):
+        fs = _lint(_JITTER.format(
+            trailer="  # graftshape: justified(GS001):"),
+            rules={"GS001"})
+        assert _rules_hit(fs) == {"GS001"}
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        fs = _lint(_JITTER.format(
+            trailer="  # graftshape: justified(GS003): wrong rule"),
+            rules={"GS001"})
+        assert _rules_hit(fs) == {"GS001"}
+
+
+# ---------------------------------------------------------------------------
+# baseline ride-along + repo-wide acceptance
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineAndRepo:
+    def test_gs_findings_ride_the_shrink_only_contract(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        old = Finding("GS001", "a.py", 3, "error", "unledgered jit 'step'")
+        new = Finding("GS002", "b.py", 9, "error", "request-shaped arg")
+        assert write_baseline(path, [old]) == {}
+        refused = write_baseline(path, [old, new])
+        assert refused == {new.key: 1}
+        assert write_baseline(path, [old, new], allow_growth=True) == {}
+
+    def test_repo_is_clean_of_unbaselined_gs_findings(self):
+        # the PR's acceptance criterion: every repo jit is ledgered,
+        # justified, or analyzer-visible-clean — nothing grandfathered
+        fs = lint_paths(("deeplearning4j_tpu", "tools", "examples"),
+                        REPO, rules=GS_RULES)
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# the static jit-boundary inventory (shapetrace's static half)
+# ---------------------------------------------------------------------------
+
+
+class TestShapeInventory:
+    def test_inventory_covers_the_serving_engine(self):
+        inv = static_shape_inventory(REPO)
+        assert len(inv.jit_sites) > 20
+        eng = "deeplearning4j_tpu/serving/engine.py"
+        assert eng in inv.registration_spans
+        # every engine jit site is ledgered or justified — the repo-wide
+        # GS001 cleanliness, restated through the inventory
+        for site in inv.jit_sites:
+            assert site["ledgered"] or site["justified"], site
+
+    def test_attributes_callsite_uses_line_ranges(self):
+        inv = static_shape_inventory(REPO)
+        eng = "deeplearning4j_tpu/serving/engine.py"
+        lo, hi = inv.registration_spans[eng][0]
+        assert inv.attributes_callsite(f"{eng}:{lo}")
+        assert inv.attributes_callsite(f"{eng}:{hi}")
+        assert not inv.attributes_callsite(f"{eng}:999999")
+        assert not inv.attributes_callsite("nonexistent.py:1")
+        assert not inv.attributes_callsite("garbage")
+
+    def test_justified_hazards_stay_in_the_hazard_map(self):
+        # word2vec's ragged-tail GS002 is justified in source — runtime
+        # may legally observe a new_shape there, so the inventory must
+        # keep it as a (tagged) hazard, not erase it
+        inv = static_shape_inventory(REPO)
+        w2v = "deeplearning4j_tpu/nlp/word2vec.py"
+        assert inv.hazard_module(w2v)
+        assert any(h["rule"] == "GS002" and h["justified"]
+                   for h in inv.hazards[w2v])
+
+
+# ---------------------------------------------------------------------------
+# CompileEvent.callsite plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestLedgerCallsite:
+    def test_note_jit_signature_attributes_this_file(self):
+        from deeplearning4j_tpu import observe
+
+        def fn(x):
+            return x
+
+        before = len(observe.ledger().events())
+        observe.note_jit_signature(fn, graph="t", key="cs_unit",
+                                   signature="f32[1]")
+        ev = observe.ledger().events()[before]
+        assert ev.callsite is not None
+        assert ev.callsite.split(":")[0].endswith(
+            "tests/test_graftshape.py")
+        assert int(ev.callsite.rpartition(":")[2]) > 0
+        assert ev.to_dict()["callsite"] == ev.callsite
+
+    def test_explicit_callsite_wins_over_stack_walk(self):
+        from deeplearning4j_tpu import observe
+
+        def fn(x):
+            return x
+
+        before = len(observe.ledger().events())
+        observe.note_jit_signature(fn, graph="t", key="cs_explicit",
+                                   signature="f32[2]",
+                                   callsite="somewhere/else.py:7")
+        ev = observe.ledger().events()[before]
+        assert ev.callsite == "somewhere/else.py:7"
+
+    def test_cache_hit_records_nothing(self):
+        from deeplearning4j_tpu import observe
+
+        def fn(x):
+            return x
+
+        observe.note_jit_signature(fn, graph="t", key="cs_hit",
+                                   signature="f32[3]")
+        before = len(observe.ledger().events())
+        assert observe.note_jit_signature(
+            fn, graph="t", key="cs_hit", signature="f32[3]") is None
+        assert len(observe.ledger().events()) == before
+
+    def test_summary_carries_by_callsite(self):
+        from deeplearning4j_tpu import observe
+
+        def fn(x):
+            return x
+
+        observe.note_jit_signature(fn, graph="t", key="cs_sum",
+                                   signature="f32[4]",
+                                   callsite="x/y.py:12")
+        by_cs = observe.ledger().summary()["by_callsite"]
+        assert by_cs.get("x/y.py:12", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# live shapetrace slice (the gate runs the fuller tools/shapetrace.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestShapeTraceConsistency:
+    """The runtime leg of the acceptance criterion: every recompile
+    event recorded under a live shape-diverse serving workload
+    attributes to a statically known registration span, and no
+    new_shape escapes the static hazard map."""
+
+    def test_randomized_replay_is_consistent_with_inventory(self):
+        from deeplearning4j_tpu.serving.replay import run_randomized_replay
+        from deeplearning4j_tpu.testing.shapetrace import ShapeTracer
+
+        tracer = ShapeTracer()
+        out = run_randomized_replay(n_requests=8)
+        assert out["all_terminal"]
+        assert out["new_shape_events"] == 0
+        report = tracer.check(REPO)
+        assert report["events"] > 0
+        assert report["ok"], report

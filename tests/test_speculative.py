@@ -473,8 +473,10 @@ class TestReplayHarness:
         assert on["accepted_tokens"] > 0
         assert on["new_shape_events"] == off["new_shape_events"] == 0
         assert on["first_compile_keys"] == ["draft_decode", "draft_prefill",
-                                            "prefill", "verify"]
-        assert off["first_compile_keys"] == ["decode", "prefill"]
+                                            "prefill", "verify",
+                                            "write_prompt"]
+        assert off["first_compile_keys"] == ["decode", "prefill",
+                                             "write_prompt"]
 
 
 # ---------------------------------------------------------------------------

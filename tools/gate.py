@@ -52,6 +52,13 @@ Stages:
      lock-order edge observed under the threaded serving + checkpoint
      workload must lie inside its transitive closure, and the combined
      graph must stay acyclic (docs/LINT.md § graftlock)
+ 15. shapetrace smoke: tools/shapetrace.py recompile-ledger
+     cross-validation — every CompileEvent recorded under the
+     randomized-shape serving replay + checkpoint-resumed training
+     workload must attribute to a statically known registration span,
+     every new_shape must land in a statically flagged hazard module,
+     and both legs must themselves observe zero new_shape
+     (docs/LINT.md § graftshape)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -588,6 +595,46 @@ def locktrace_stage() -> bool:
     return bool(ok)
 
 
+def shapetrace_stage() -> bool:
+    """Shapetrace smoke (docs/LINT.md § graftshape): runtime
+    recompile-ledger cross-validation of the static jit-boundary
+    inventory — fails if any ledger event recorded under the
+    randomized-shape serving + resumed-training workload is
+    unattributed (callsite outside every statically known registration
+    span), any new_shape lands in a statically clean module, either leg
+    itself pays a new_shape, or the window saw no ledger traffic at
+    all. One JSON line, like lint/check/obs/chaos/locktrace."""
+    print("== gate: shapetrace-smoke (recompile ledger vs static "
+          "jit inventory) ==", flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "tools/shapetrace.py"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (shapetrace-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (shapetrace-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    ok = (bool(rec.get("ok"))
+          and not rec.get("unattributed")
+          and not rec.get("new_shape_unexplained")
+          and (rec.get("events") or 0) > 0)
+    print(f"   {'ok' if ok else 'FAIL'} (shapetrace-smoke: "
+          f"{rec.get('events')} ledger events, "
+          f"{len(rec.get('unattributed') or [])} unattributed, "
+          f"{rec.get('new_shape_total')} new_shape / "
+          f"{len(rec.get('new_shape_unexplained') or [])} unexplained)")
+    return bool(ok)
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -662,6 +709,7 @@ def main() -> int:
         results["trainchaos"] = trainchaos_stage()
         results["cluster"] = cluster_stage()
         results["locktrace"] = locktrace_stage()
+        results["shapetrace"] = shapetrace_stage()
         results["slo"] = slo_stage()
         results["prefix"] = prefix_stage()
         results["spec"] = spec_stage()

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Shapetrace smoke — runtime cross-validation of the graftshape static
+jit-boundary inventory (docs/LINT.md § graftshape).
+
+Snapshots the RecompileLedger, drives two shape-hostile workloads, then
+holds every CompileEvent recorded since against the static inventory
+(``lint/rules_shape.static_shape_inventory``) via
+``testing/shapetrace.py``. The honesty contract:
+
+  * every recompile event's ``callsite`` lands inside a statically known
+    ``note_jit_signature`` / ``ledger.record`` registration span — an
+    unattributed event means the analyzer's dataflow missed a
+    registration path (fix rules_shape, do not baseline);
+  * every ``new_shape`` event attributes to a module the static scan
+    flagged as a shape hazard — a new_shape out of a statically clean
+    module is a broken bucketing contract or an analyzer false negative;
+  * leg-local discipline: the randomized-shape serving replay (prefix
+    cache + speculation armed, prompt lengths across the whole bucket
+    range) retires every request with ZERO serving new_shape, and the
+    checkpoint-resumed training leg replays its restore with ZERO mln
+    new_shape — resume re-traces nothing.
+
+Two legs, one shared tracer window:
+
+  serving     run_randomized_replay — 1..max_prompt prompt lengths,
+              varied generation lengths, shared-prefix mixes
+  training    supervised MLN fit -> checkpoint -> restore into a FRESH
+              net -> resumed fit over the same batch geometry
+
+Contract (same as lint/check/chaos/locktrace): ONE JSON summary line on
+stdout with ``"tool": "shapetrace"``; exit 0 iff ``ok``. ``make
+shapetrace-smoke`` pins JAX_PLATFORMS=cpu; ``tools/gate.py``'s
+``shapetrace`` stage enforces it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _train_net(seed=7, hidden=16, feat=2, depth=1):
+    from deeplearning4j_tpu import nn
+
+    b = (nn.builder().seed(seed).updater(nn.Adam(learning_rate=0.02))
+         .weight_init("xavier").list())
+    for _ in range(depth):
+        b = b.layer(nn.DenseLayer(n_out=hidden, activation="tanh"))
+    return nn.MultiLayerNetwork(
+        b.layer(nn.OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+        .set_input_type(nn.InputType.feed_forward(feat)).build()).init()
+
+
+def _train_data(n=96, seed=0, feat=2):
+    r = np.random.RandomState(seed)
+    x = r.rand(n, feat).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), r.randint(0, 2, n)] = 1.0
+    return x, y
+
+
+def leg_serving(n_requests: int) -> dict:
+    """The randomized-shape replay: arbitrary request geometry, one
+    bucketing contract. Zero serving new_shape or the leg fails."""
+    from deeplearning4j_tpu.serving.replay import run_randomized_replay
+
+    out = run_randomized_replay(n_requests=n_requests)
+    return {
+        "requests": out["requests"],
+        "distinct_prompt_lens": len(out["prompt_lens"]),
+        "generated_tokens": out["generated_tokens"],
+        "prefix_hit_tokens": out["prefix_hit_tokens"],
+        "first_compile_keys": out["first_compile_keys"],
+        "all_terminal": out["all_terminal"],
+        "new_shape_events": out["new_shape_events"],
+        "ok": bool(out["all_terminal"]
+                   and out["new_shape_events"] == 0
+                   and len(out["prompt_lens"]) >= 4),
+    }
+
+
+def leg_training(epochs: int) -> dict:
+    """Checkpoint-resumed training: fit, save, restore into a FRESH net,
+    resume over the same batch geometry. The resumed fit must re-trace
+    NOTHING — zero mln new_shape across the whole leg."""
+    from deeplearning4j_tpu import observe
+    from deeplearning4j_tpu.parallel import TrainingCheckpointer
+
+    x, y = _train_data()
+    batch = 16  # 96/16 = 6 exact batches — one jit signature, no tail
+
+    def mln_new_shape():
+        return sum(1 for e in observe.ledger().events()
+                   if e.graph == "mln" and e.cause == "new_shape")
+
+    before = mln_new_shape()
+    net = _train_net()
+    net.fit(x, y, epochs=epochs, batch_size=batch)
+    with tempfile.TemporaryDirectory(prefix="shapetrace_train_") as d:
+        ck = TrainingCheckpointer(d, keep_last=2, use_orbax=False)
+        ck.save(net.iteration_count, net)
+        fresh = _train_net(seed=11)
+        step = ck.restore(fresh)
+        resumed_from = step
+        fresh.fit(x, y, epochs=epochs, batch_size=batch)
+    params_match_shape = (net.params_flat().shape
+                          == fresh.params_flat().shape)
+    new_shape = mln_new_shape() - before
+    return {
+        "epochs": epochs,
+        "batch": batch,
+        "resumed_from_step": resumed_from,
+        "params_shape_match": bool(params_match_shape),
+        "new_shape_events": int(new_shape),
+        "ok": bool(resumed_from is not None and params_match_shape
+                   and new_shape == 0),
+    }
+
+
+def run(n_requests: int, epochs: int) -> dict:
+    from deeplearning4j_tpu.lint.rules_shape import static_shape_inventory
+    from deeplearning4j_tpu.testing.shapetrace import ShapeTracer
+
+    tracer = ShapeTracer()
+    legs = {
+        "serving": leg_serving(n_requests),
+        "training": leg_training(epochs),
+    }
+    inventory = static_shape_inventory(REPO)
+    report = tracer.check(REPO, inventory=inventory)
+    legs_ok = all(leg["ok"] for leg in legs.values())
+    # the window must actually contain ledger traffic for the
+    # cross-validation to mean anything
+    exercised = report["events"] > 0
+    return {
+        "tool": "shapetrace",
+        "ok": bool(report["ok"] and legs_ok and exercised),
+        "events": report["events"],
+        "by_cause": report["by_cause"],
+        "external": report["external"],
+        "unattributed": report["unattributed"],
+        "new_shape_total": report["new_shape_total"],
+        "new_shape_unexplained": report["new_shape_unexplained"],
+        "static": {
+            "jit_sites": report["jit_sites"],
+            "registration_span_files": report["registration_span_files"],
+            "hazard_modules": report["hazard_modules"],
+            "clean_modules": report["clean_modules"],
+        },
+        "legs": legs,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=16,
+                    help="requests on the randomized-shape serving leg")
+    ap.add_argument("--epochs", type=int, default=2,
+                    help="epochs per training-leg fit")
+    args = ap.parse_args()
+    summary = run(args.requests, args.epochs)
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

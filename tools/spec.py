@@ -16,8 +16,9 @@ its place instead of trusting it:
     correction token, and rollback must reproduce non-speculative greedy
     decoding token-for-token (the lossless property);
   * EXACTLY the expected ``first_compile`` ledger events on each leg
-    (on: prefill + draft_prefill + draft_decode + verify; off: prefill +
-    decode) and ZERO ``new_shape`` events — speculation rides two extra
+    (on: prefill + write_prompt + draft_prefill + draft_decode + verify;
+    off: prefill + write_prompt + decode) and ZERO ``new_shape`` events
+    — speculation rides two extra
     compiled functions, it never recompiles across admits/evicts/
     rejections;
   * allocator + draft/target length invariants hold after every leg
@@ -43,8 +44,9 @@ sys.path.insert(0, REPO)
 
 #: the ledger contract per leg — any drift (a surprise recompile, a
 #: silently-dead path) fails the stage
-EXPECTED_ON = ["draft_decode", "draft_prefill", "prefill", "verify"]
-EXPECTED_OFF = ["decode", "prefill"]
+EXPECTED_ON = ["draft_decode", "draft_prefill", "prefill", "verify",
+               "write_prompt"]
+EXPECTED_OFF = ["decode", "prefill", "write_prompt"]
 
 
 def main() -> int:
