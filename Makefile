@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline shape-lint check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke cluster-chaos-smoke slo-smoke prefix-smoke spec-smoke locktrace-smoke shapetrace-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline shape-lint check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke cluster-chaos-smoke slo-smoke prefix-smoke spec-smoke aot-smoke locktrace-smoke shapetrace-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -129,6 +129,17 @@ prefix-smoke:
 # ONE JSON line like lint/check/obs/chaos/slo/prefix.
 spec-smoke:
 	JAX_PLATFORMS=cpu python tools/spec.py --json
+
+# AOT warm-boot smoke (docs/SERVING.md § AOT warm boot): three fresh
+# processes replay the identical randomized-shape request mix with the
+# persistent export cache off, populating, and warm — fails unless the
+# warm restart pays ZERO serving first_compile ledger events (every
+# dispatched fn arrives as cache_hit), its greedy outputs are
+# bit-identical to the cache-off leg, zero new_shape events were paid,
+# and cold-start TTFT (process boot + first token) stays within 2x the
+# cache-off leg. ONE JSON line like lint/check/obs/chaos/slo/prefix.
+aot-smoke:
+	JAX_PLATFORMS=cpu python tools/aot.py --json
 
 # generative-serving smoke (docs/SERVING.md): continuous-batching
 # generation, smoke-sized, CPU-pinned — ONE JSON line with tokens/sec,

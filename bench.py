@@ -486,6 +486,48 @@ def _bench_generate_random_shapes(n_requests: int, gen_max: int,
             "generate_random_shapes_distinct_prompt_lens", extra)
 
 
+def _bench_generate_cold_restart(n_requests: int, seed: int):
+    """Cold-process restart benchmark (BENCH_MODEL=generate
+    BENCH_COLD_RESTART=1): the AOT warm-boot workload (tools/aot.py,
+    docs/SERVING.md § AOT warm boot) — three FRESH processes replay the
+    identical randomized-shape request mix with the compile cache off,
+    populating, and warm. Value = cold-restart TTFT ratio (cache-off
+    process boot + first token over warm ditto); the assertions are the
+    acceptance criteria: the warm leg pays ZERO serving first_compile
+    events, its outputs are bit-identical to the cache-off leg, and its
+    cold-start TTFT stays within 2x."""
+    import subprocess
+
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "aot.py"),
+           "--json", "--requests", str(n_requests), "--seed", str(seed)]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=1800)
+    rec = None
+    for ln in proc.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and '"tool"' in ln:
+            rec = json.loads(ln)
+            break
+    assert rec is not None, (
+        f"tools/aot.py emitted no summary line (rc={proc.returncode}): "
+        f"{proc.stderr[-500:]}")
+    assert rec["ok"], f"AOT warm-boot gate failed: {rec}"
+    extra = {
+        "ttft_cold_off_ms": rec["ttft_cold_off_ms"],
+        "ttft_warm_ms": rec["ttft_warm_ms"],
+        "boot_cold_s": rec["boot_cold_s"],
+        "boot_warm_s": rec["boot_warm_s"],
+        "warm_cache_hit_keys": rec["warm_cache_hit_keys"],
+        "warm_first_compile_keys": rec["warm_first_compile_keys"],
+        "outputs_identical": rec["outputs_identical"],
+        "new_shape_events": rec["new_shape_events"],
+        "requests_per_leg": rec["requests_per_leg"],
+    }
+    return float(rec["cold_restart_ttft_ratio"]), \
+        "generate_cold_restart_ttft_ratio", extra
+
+
 def _bench_bert_import(layers: int, seq: int, d: int, heads: int, ff: int,
                        iters: int):
     """Imported-BERT forward throughput (BENCH_MODEL=bert_import): the
@@ -676,7 +718,9 @@ _UNITS = {"resnet50_imagenet_train_images_per_sec": "images/sec/chip",
           "generate_prefix_ttft_p50_speedup": "x TTFT p50 vs cache-off",
           "generate_spec_tokens_per_sec_speedup": "x tokens/sec vs spec-off",
           "generate_random_shapes_distinct_prompt_lens":
-              "distinct prompt lens, 0 recompiles"}
+              "distinct prompt lens, 0 recompiles",
+          "generate_cold_restart_ttft_ratio":
+              "x cold-restart TTFT, cache-off vs warm"}
 
 _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "lenet": "lenet5_mnist_train_images_per_sec",
@@ -691,7 +735,9 @@ _MODEL_METRIC = {"resnet50": "resnet50_imagenet_train_images_per_sec",
                  "generate_prefix": "generate_prefix_ttft_p50_speedup",
                  "generate_spec": "generate_spec_tokens_per_sec_speedup",
                  "generate_random_shapes":
-                     "generate_random_shapes_distinct_prompt_lens"}
+                     "generate_random_shapes_distinct_prompt_lens",
+                 "generate_cold_restart":
+                     "generate_cold_restart_ttft_ratio"}
 
 
 def main() -> None:
@@ -708,6 +754,8 @@ def main() -> None:
         model = "generate_spec"
     elif model == "generate" and os.environ.get("BENCH_RANDOM_SHAPES") == "1":
         model = "generate_random_shapes"
+    elif model == "generate" and os.environ.get("BENCH_COLD_RESTART") == "1":
+        model = "generate_cold_restart"
     dtype = os.environ.get("BENCH_DTYPE", "mixed")
     smoke = backend == "cpu-fallback"
     # On cpu-fallback, headline workloads at device sizes would run for
@@ -800,6 +848,11 @@ def main() -> None:
             value, metric, extra = _bench_generate_random_shapes(nreq, gen,
                                                                  k)
             method = f"n{nreq}g{gen}k{k}"
+        elif model == "generate_cold_restart":
+            nreq = int(os.environ.get("BENCH_REQUESTS", "6"))
+            seed = int(os.environ.get("BENCH_SEED", "3"))
+            value, metric, extra = _bench_generate_cold_restart(nreq, seed)
+            method = f"n{nreq}s{seed}"
         elif model == "generate_overload":
             nreq = int(os.environ.get("BENCH_REQUESTS",
                                       "24" if smoke else "64"))

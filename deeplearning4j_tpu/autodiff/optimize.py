@@ -52,6 +52,7 @@ graph_compile / ``make bench-compile``).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -175,6 +176,28 @@ class GraphPlan:
 
     def resolve(self, name: str) -> str:
         return _resolve(self.alias, name)
+
+    def fingerprint(self) -> str:
+        """Canonical sha256 over everything that determines the compiled
+        computation: the optimized node list (op, inputs, sorted kwargs,
+        outputs), the folded extra constants (shape/dtype/value digest),
+        the alias map, and the requested outputs. Two plans with equal
+        fingerprints lower to the same computation — this is the
+        plan-identity half of the persistent export-cache key
+        (autodiff/export.py; the other halves are device_kind and the
+        jax version)."""
+        h = hashlib.sha256()
+        for n in self.nodes:
+            h.update(repr((n.op, tuple(n.inputs),
+                           sorted((k, repr(v)) for k, v in n.kwargs.items()),
+                           tuple(n.outputs))).encode())
+        for name in sorted(self.extra_consts):
+            a = np.asarray(self.extra_consts[name])
+            h.update(repr((name, a.shape, a.dtype.name)).encode())
+            h.update(a.tobytes())
+        h.update(repr(sorted(self.alias.items())).encode())
+        h.update(repr(tuple(self.outputs)).encode())
+        return h.hexdigest()
 
 
 def _resolve(alias: Dict[str, str], name: str) -> str:
@@ -1439,6 +1462,15 @@ class CompiledGraph:
 
     def lower(self, *args, **kwargs):  # as_stablehlo parity surface
         return self._jit.lower(*args, **kwargs)
+
+    def export(self, *specs):
+        """AOT export hook: serialize this graph's jitted fn through
+        ``jax.export`` at the given arg specs (``jax.ShapeDtypeStruct``,
+        possibly with symbolic dims). Returns the ``Exported`` —
+        autodiff/export.py serializes it into the persistent cache."""
+        from jax import export as jexport
+
+        return jexport.export(self._jit)(*specs)
 
     def __call__(self, var_arrays, feeds):
         if not self._timed:

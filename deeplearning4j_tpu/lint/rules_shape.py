@@ -352,6 +352,19 @@ class _ShapeModel:
             self.registration_spans.append(
                 (call.lineno, getattr(call, "end_lineno", call.lineno)))
             return
+        if tail == "export":
+            # jax.export.export(jitted) — the AOT export sink
+            # (autodiff/export.py): the serialized executable restores
+            # through restore_callable, which registers on the ledger
+            # with the cache_hit cause, so a jit flowing into export IS
+            # ledgered. Only the jax module spellings count
+            # (jax.export.export / jexport.export / export.export) — a
+            # stray mymod.export() must not launder an unledgered jit.
+            parts = (_dotted(call.func) or "").split(".")
+            if len(parts) >= 2 and parts[-2] in ("export", "jexport") \
+                    and call.args:
+                self._register_arg(call.args[0], scope, params)
+            return
         # registrar helper: self._note_compile(fn, ...) — the callee
         # passes its param on to note_jit_signature
         callee = None

@@ -11,9 +11,12 @@ jit wrapper — as one :class:`CompileEvent` carrying:
   train_step / output ...),
 * ``signature``: the input shape/dtype signature that compiled,
 * ``cause``: ``first_compile`` | ``new_shape`` | ``graph_mutation`` |
-  ``constant_rebind`` | ``variable_rebind`` — the invalidation that forced
-  the miss (SameDiff threads the cause from the exact `_jit_cache.clear()`
-  sites),
+  ``constant_rebind`` | ``variable_rebind`` | ``cache_hit`` — the
+  invalidation that forced the miss (SameDiff threads the cause from the
+  exact `_jit_cache.clear()` sites); ``cache_hit`` marks a fn restored
+  from the persistent AOT export cache (autodiff/export.py) — a warm
+  restore is a compile *event* (visible, attributable) but not a fresh
+  XLA compile,
 * ``stats``: the live ``OptimizeStats`` when the optimizer produced one, so
   trace-vs-XLA-compile seconds appear in the event once ``CompiledGraph``
   measures them (the stats object is shared, not copied — reads see the
@@ -37,7 +40,7 @@ from typing import Any, Dict, Optional, Tuple
 from deeplearning4j_tpu.observe.registry import default_registry, log_event
 
 CAUSES = ("first_compile", "new_shape", "graph_mutation",
-          "constant_rebind", "variable_rebind")
+          "constant_rebind", "variable_rebind", "cache_hit")
 
 _MAX_EVENTS = 2000
 
@@ -214,7 +217,14 @@ def note_jit_signature(fn: Any, *, graph: str, key: str, signature: str,
     exact cache-invalidation paths that drop the function also drop its
     history — a rebuilt fn reports ``cause_if_new_fn`` (the invalidation
     cause), a cached fn seeing a fresh signature reports ``new_shape``
-    (jax retraces per shape under the hood). ``stats`` is attached only to
+    (jax retraces per shape under the hood). Two attributes set by the
+    AOT export layer (autodiff/export.py) override those causes:
+    ``fn._aot_restored`` marks a fn deserialized from the persistent
+    export cache — every event it produces is a ``cache_hit``, not a
+    fresh compile; ``fn._aot_polymorphic`` marks a symbolic-batch-dim
+    executable — a fresh signature is served by the SAME executable
+    without a retrace, so it too records ``cache_hit`` instead of
+    ``new_shape``. ``stats`` is attached only to
     the new-fn event: a new_shape retrace never re-ran the optimizer, so
     inheriting the original compile's OptimizeStats would double-count its
     trace/compile seconds in ledger summaries. ``callsite`` defaults to
@@ -231,7 +241,13 @@ def note_jit_signature(fn: Any, *, graph: str, key: str, signature: str,
     if signature in sigs:
         return None
     new_fn = not sigs
-    cause = cause_if_new_fn if new_fn else "new_shape"
+    restored = getattr(fn, "_aot_restored", False)
+    if new_fn:
+        cause = "cache_hit" if restored else cause_if_new_fn
+    else:
+        cause = ("cache_hit"
+                 if restored or getattr(fn, "_aot_polymorphic", False)
+                 else "new_shape")
     sigs.add(signature)
     if callsite is None:
         # resolved HERE (not in record) so the cache-hit fast path above

@@ -103,6 +103,14 @@ class TrainingSupervisor:
         async queue first). Returns the restored step or None."""
         self.ckpt.wait_until_finished(timeout=60.0)
         restored = self.ckpt.restore(self.net)
+        # cold-start restore of the COMPILED state too: with
+        # $DL4J_TPU_COMPILE_CACHE set, any train step exported by a prior
+        # process (autodiff/export.py export_train_step) deserializes into
+        # the net's _jit_cache here — the resumed fit's first batch runs
+        # the restored executable (ledger: cache_hit) instead of re-jitting
+        from deeplearning4j_tpu.autodiff import export as _aot_export
+
+        _aot_export.maybe_warm_boot_net(self.net)
         if restored is not None:
             observe.metrics().counter("dl4j_tpu_ckpt_resumes_total").inc()
             observe.log_event(

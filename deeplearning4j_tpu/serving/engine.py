@@ -248,6 +248,13 @@ class GenerativeEngine:
             # hung-stop indication while that engine is still wedged
             "stopped_g": m.gauge("dl4j_tpu_serving_stopped_cleanly"),
         }
+        # AOT warm boot (serving/aot.py): with $DL4J_TPU_COMPILE_CACHE
+        # set, every compiled-fn slot fills from the persistent export
+        # cache BEFORE the first request — or, on a cache miss, compiles
+        # now and persists for the next process. Inert without the env.
+        from deeplearning4j_tpu.serving import aot as _aot
+
+        _aot.maybe_warm_boot(self)
 
     # ------------------------------------------------------------------ keys
     def _next_key(self):
@@ -665,6 +672,13 @@ class GenerativeEngine:
         # cache.kv; same-shape reallocation keeps the cached jit fns (and
         # therefore the ledger's zero-new_shape property) intact
         cache.reset_kv()
+        # cold-start restore: an in-process recovery keeps its compiled
+        # fns (every slot non-None — no-op), but a recovery driven from a
+        # FRESH process with a populated $DL4J_TPU_COMPILE_CACHE refills
+        # any empty slot from the export cache instead of re-jitting
+        from deeplearning4j_tpu.serving import aot as _aot
+
+        _aot.maybe_warm_boot(self)
         observe.log_event("engine_restart", restart=self.restarts,
                           error=repr(exc))
         delay = min(self.max_backoff_s,

@@ -168,6 +168,18 @@ def _serving_first_compile_keys(before: int) -> List[str]:
                   if e.graph == "serving" and e.cause == "first_compile")
 
 
+def _serving_cache_hit_keys(before: int) -> List[str]:
+    """The serving-graph ``cache_hit`` ledger keys after event index
+    ``before`` — the AOT warm-boot gate's "restored, not recompiled"
+    evidence (deduplicated: polymorphic fns record one hit per
+    signature)."""
+    from deeplearning4j_tpu import observe
+
+    evs = observe.ledger().events()
+    return sorted({e.key for e in evs[before:]
+                   if e.graph == "serving" and e.cause == "cache_hit"})
+
+
 def run_spec_replay(*, spec_on: bool, n_requests: int = 6,
                     prompt_len: int = 10, gen_tokens: int = 12,
                     spec_k: int = 4, max_slots: int = 2, seed: int = 0,
@@ -300,12 +312,17 @@ def run_randomized_replay(*, n_requests: int = 16, seed: int = 0,
     pages_per_seq = -(-(max_prompt + gen_max + spec_k + 1)
                       // page_size) + 1
     prefix_pages = n_prefixes * (-(-max_prompt // page_size))
+    # boot covers engine construction INCLUDING the AOT warm boot when
+    # $DL4J_TPU_COMPILE_CACHE is set (serving/aot.py) — the cold-restart
+    # TTFT the aot gate compares is boot_s + first-request TTFT
+    t_boot = time.perf_counter()
     eng = GenerativeEngine(
         model, max_slots=max_slots, page_size=page_size,
         num_pages=max_slots * pages_per_seq + prefix_pages,
         max_pages_per_seq=pages_per_seq, max_prompt=max_prompt, seed=0,
         prefix_pages=prefix_pages, suffix_bucket=suffix_bucket,
         spec_k=spec_k, draft_model=draft_model)
+    boot_s = time.perf_counter() - t_boot
     led_before = len(observe.ledger().events())
     new_shape_before = _serving_new_shape_count()
 
@@ -358,4 +375,9 @@ def run_randomized_replay(*, n_requests: int = 16, seed: int = 0,
         "new_shape_events": max(
             0, _serving_new_shape_count() - new_shape_before),
         "first_compile_keys": _serving_first_compile_keys(led_before),
+        "cache_hit_keys": _serving_cache_hit_keys(led_before),
+        "boot_s": round(boot_s, 4),
+        "ttft_first_ms": (round(results[0].ttft_s * 1e3, 3)
+                          if results and results[0].ttft_s is not None
+                          else None),
     }

@@ -1,0 +1,378 @@
+"""AOT export + persistent executable cache (docs/SERVING.md § AOT warm
+boot): the compile-once/serve-every-shape/restart-warm contract,
+asserted instead of trusted.
+
+* Round trips are BIT-EXACT: a computation exported through
+  ``autodiff/export.py``, serialized to disk, and restored must produce
+  outputs identical to the in-process jit — for an MLN fused train
+  step, a SameDiff whole-graph exec, and the serving engine fns.
+* Symbolic batch dims mean ONE artifact serves every batch size: fresh
+  signatures on a restored fn record ``cache_hit``, never ``new_shape``.
+* Every non-hit degrades to a fresh compile: corrupt entries, stale jax
+  versions, and wrong device kinds warn once and miss — they can never
+  restore the wrong toolchain's binary.
+
+The cross-process legs (a genuinely fresh interpreter restoring from a
+populated cache) live in tools/aot.py / the gate's ``aot`` stage; these
+tests exercise the same machinery in-process where the ledger is
+inspectable.
+"""
+
+import base64
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nn, observe
+from deeplearning4j_tpu.autodiff import export as aot
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(aot.ENV_DIR, raising=False)
+    observe.reset()
+    aot.reset_export_cache()
+    yield
+    observe.reset()
+    aot.reset_export_cache()
+
+
+def build_mln(seed=7, hidden=8):
+    return nn.MultiLayerNetwork(
+        nn.builder().seed(seed).updater(nn.Adam(learning_rate=0.02))
+        .weight_init("xavier").list()
+        .layer(nn.DenseLayer(n_out=hidden, activation="tanh"))
+        .layer(nn.OutputLayer(n_out=2, activation="softmax",
+                              loss="mcxent"))
+        .set_input_type(nn.InputType.feed_forward(2)).build()).init()
+
+
+def xy(n=32, seed=0):
+    r = np.random.RandomState(seed)
+    x = r.rand(n, 2).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), r.randint(0, 2, n)] = 1.0
+    return x, y
+
+
+def build_sd(seed=0):
+    r = np.random.RandomState(seed)
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    w = sd.var("w", r.randn(4, 3).astype(np.float32))
+    b = sd.var("b", np.zeros(3, np.float32))
+    out = sd.nn.softmax(sd.math.tanh(sd.nn.linear(x, w, b)))
+    return sd, out.name
+
+
+def params_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def ledger_window(start):
+    return observe.ledger().events()[start:]
+
+
+# ---------------------------------------------------------------------------
+# ExportCache: store/load discipline (the ops/tuning.py table contract)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_exported():
+    jitted = jax.jit(lambda x: x * 2.0 + 1.0)
+    from jax import export as jexport
+    return jexport.export(jitted)(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+
+
+class TestExportCache:
+    def test_store_load_roundtrip_and_atomicity(self, tmp_path):
+        cache = aot.ExportCache(str(tmp_path))
+        exported = _tiny_exported()
+        path = cache.store("fp0", "k0", exported, meta={"graph": "t"})
+        assert os.path.exists(path)
+        # atomic tmp+replace: no torn .tmp left behind
+        leftovers = [f for root, _, fs in os.walk(tmp_path)
+                     for f in fs if f.endswith(".tmp")]
+        assert leftovers == []
+        restored = cache.load("fp0", "k0")
+        assert restored is not None
+        x = jnp.arange(4, dtype=jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(restored.call(x)), np.asarray(x * 2.0 + 1.0))
+
+    def test_miss_on_unknown_key(self, tmp_path):
+        cache = aot.ExportCache(str(tmp_path))
+        assert cache.load("fp0", "nothing") is None
+
+    def test_corrupt_entry_warns_once_then_fresh_compile(self, tmp_path,
+                                                         caplog):
+        cache = aot.ExportCache(str(tmp_path))
+        path = cache.store("fp0", "k0", _tiny_exported())
+        with open(path, "w") as f:
+            f.write("{this is not json")
+        with caplog.at_level(logging.WARNING):
+            assert cache.load("fp0", "k0") is None
+        assert any("corrupt" in r.message for r in caplog.records)
+        caplog.clear()
+        with caplog.at_level(logging.WARNING):  # warn-once: second load
+            assert cache.load("fp0", "k0") is None  # is a silent miss
+        assert [r for r in caplog.records if "corrupt" in r.message] == []
+
+    def test_undeserializable_payload_degrades_to_miss(self, tmp_path,
+                                                       caplog):
+        cache = aot.ExportCache(str(tmp_path))
+        path = cache.store("fp0", "k0", _tiny_exported())
+        doc = json.load(open(path))
+        doc["payload"] = base64.b64encode(b"garbage bytes").decode("ascii")
+        json.dump(doc, open(path, "w"))
+        with caplog.at_level(logging.WARNING):
+            assert cache.load("fp0", "k0") is None
+        assert any("undeserializable" in r.message for r in caplog.records)
+
+    def test_jax_version_mismatch_invalidates(self, tmp_path, caplog):
+        cache = aot.ExportCache(str(tmp_path))
+        path = cache.store("fp0", "k0", _tiny_exported())
+        doc = json.load(open(path))
+        doc["jax_version"] = "0.0.0-stale"
+        json.dump(doc, open(path, "w"))
+        with caplog.at_level(logging.WARNING):
+            assert cache.load("fp0", "k0") is None
+        assert any("stale" in r.message for r in caplog.records)
+        # entries() also refuses to surface the stale doc
+        assert list(cache.entries()) == []
+
+    def test_device_kind_mismatch_invalidates(self, tmp_path):
+        cache = aot.ExportCache(str(tmp_path))
+        path = cache.store("fp0", "k0", _tiny_exported())
+        doc = json.load(open(path))
+        doc["device_kind"] = "tpu-v9000"
+        json.dump(doc, open(path, "w"))
+        assert cache.load("fp0", "k0") is None
+
+    def test_digest_pins_toolchain(self, tmp_path):
+        cache = aot.ExportCache(str(tmp_path))
+        d = cache.digest("fp0", "k0")
+        assert d != cache.digest("fp1", "k0")
+        assert d != cache.digest("fp0", "k1")
+        assert jax.__version__ in "|".join(
+            (aot.SCHEMA, "fp0", "k0", cache.device_kind, jax.__version__))
+
+    def test_from_env_is_inert_without_optin(self, monkeypatch, tmp_path):
+        monkeypatch.delenv(aot.ENV_DIR, raising=False)
+        assert aot.ExportCache.from_env() is None
+        monkeypatch.setenv(aot.ENV_DIR, str(tmp_path))
+        cache = aot.ExportCache.from_env()
+        assert cache is not None and cache.root == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# restore_callable ledger semantics (the cache_hit cause — satellite of
+# docs/OBSERVABILITY.md § Recompile ledger)
+# ---------------------------------------------------------------------------
+
+
+class TestRestoreLedger:
+    def test_hit_restore_records_cache_hit(self):
+        start = len(observe.ledger().events())
+        fn = aot.restore_callable(_tiny_exported(), graph="t", key="k0",
+                                  hit=True)
+        evs = ledger_window(start)
+        assert [(e.graph, e.key, e.cause) for e in evs] == \
+            [("t", "k0", "cache_hit")]
+        assert fn._aot_restored
+        summ = observe.ledger().summary()
+        assert summ["by_cause"].get("cache_hit", 0) == 1
+        assert any("export.py" in cs for cs in summ["by_callsite"])
+
+    def test_polymorphic_new_signature_is_cache_hit_not_new_shape(self):
+        fn = aot.restore_callable(_tiny_exported(), graph="t", key="k0",
+                                  hit=True, polymorphic=True)
+        start = len(observe.ledger().events())
+        observe.note_jit_signature(fn, graph="t", key="k0",
+                                   signature="x=f32[8]")
+        observe.note_jit_signature(fn, graph="t", key="k0",
+                                   signature="x=f32[3]")
+        causes = [e.cause for e in ledger_window(start)]
+        assert causes == ["cache_hit", "cache_hit"]
+
+    def test_miss_install_leaves_first_compile_to_dispatch(self):
+        fn = aot.restore_callable(_tiny_exported(), graph="t", key="k0",
+                                  hit=False)
+        start = len(observe.ledger().events())
+        observe.note_jit_signature(fn, graph="t", key="k0",
+                                   signature="x=f32[4]")
+        causes = [e.cause for e in ledger_window(start)]
+        assert causes == ["first_compile"]
+
+
+# ---------------------------------------------------------------------------
+# MLN train step: export → persist → warm boot, bit-exact vs in-process jit
+# ---------------------------------------------------------------------------
+
+
+class TestMLNRoundTrip:
+    def test_populate_and_warm_boot_are_bit_exact(self, tmp_path):
+        x, y = xy(n=32)
+        oracle = build_mln()
+        oracle.fit(x, y, epochs=2, batch_size=16)
+
+        cache = aot.ExportCache(str(tmp_path))
+        net = build_mln()
+        path = aot.export_train_step(net, x[:16], y[:16], cache=cache)
+        assert path is not None and os.path.exists(path)
+        net.fit(x, y, epochs=2, batch_size=16)
+        assert params_equal(net.params, oracle.params), \
+            "populating leg diverged from the in-process jit"
+
+        warm = build_mln()
+        start = len(observe.ledger().events())
+        assert aot.warm_boot_net(warm, cache=cache) == 1
+        warm.fit(x, y, epochs=2, batch_size=16)
+        assert params_equal(warm.params, oracle.params), \
+            "warm-booted leg diverged from the in-process jit"
+        evs = [e for e in ledger_window(start) if e.graph == "mln"]
+        assert evs and all(e.cause == "cache_hit" for e in evs), \
+            [(e.key, e.cause) for e in evs]
+
+    def test_symbolic_batch_serves_ragged_batches(self, tmp_path):
+        x, y = xy(n=12)
+        cache = aot.ExportCache(str(tmp_path))
+        net = build_mln()
+        aot.export_train_step(net, x[:5], y[:5], cache=cache)
+        warm = build_mln()
+        assert aot.warm_boot_net(warm, cache=cache) == 1
+        start = len(observe.ledger().events())
+        warm.fit(x, y, epochs=1, batch_size=5)  # batches of 5, 5, 2
+        evs = [e for e in ledger_window(start) if e.graph == "mln"]
+        assert all(e.cause == "cache_hit" for e in evs), \
+            [(e.key, e.cause) for e in evs]
+        oracle = build_mln()
+        oracle.fit(x, y, epochs=1, batch_size=5)
+        assert params_equal(warm.params, oracle.params)
+
+    def test_fingerprint_separates_configs(self):
+        assert aot.net_fingerprint(build_mln(hidden=8)) != \
+            aot.net_fingerprint(build_mln(hidden=16))
+        assert aot.net_fingerprint(build_mln()) == \
+            aot.net_fingerprint(build_mln())
+
+    def test_supervisor_resume_warm_boots(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.parallel import (
+            TrainingCheckpointer, TrainingSupervisor)
+
+        x, y = xy(n=32)
+        cache_dir = tmp_path / "aot"
+        monkeypatch.setenv(aot.ENV_DIR, str(cache_dir))
+        net = build_mln()
+        aot.export_train_step(net, x[:16], y[:16])
+        net.fit(x, y, epochs=1, batch_size=16)
+        ckpt = TrainingCheckpointer(str(tmp_path / "ckpt"), use_orbax=False)
+        ckpt.save(int(net.iteration_count), net)
+        ckpt.wait_until_finished()
+
+        # fresh net, as a restarted process would build it
+        net2 = build_mln()
+        sup = TrainingSupervisor(net2, TrainingCheckpointer(
+            str(tmp_path / "ckpt"), use_orbax=False), save_every=100)
+        start = len(observe.ledger().events())
+        sup.resume()
+        assert "train_step" in net2._jit_cache
+        evs = [e for e in ledger_window(start) if e.graph == "mln"]
+        assert evs and all(e.cause == "cache_hit" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# SameDiff whole-graph exec: export → warm boot, bit-exact at every batch
+# ---------------------------------------------------------------------------
+
+
+class TestSameDiffRoundTrip:
+    def test_populate_and_warm_boot_are_bit_exact(self, tmp_path):
+        r = np.random.RandomState(1)
+        x8 = r.randn(8, 4).astype(np.float32)
+        x3 = r.randn(3, 4).astype(np.float32)
+
+        sd0, out0 = build_sd()
+        oracle8 = sd0.output({"x": x8}, out0)[out0]
+        oracle3 = sd0.output({"x": x3}, out0)[out0]
+
+        cache = aot.ExportCache(str(tmp_path))
+        sd1, out1 = build_sd()
+        path = aot.export_exec(sd1, {"x": x8}, out1, cache=cache)
+        assert path is not None
+        np.testing.assert_array_equal(
+            sd1.output({"x": x8}, out1)[out1], oracle8)
+
+        sd2, out2 = build_sd()
+        assert aot.warm_boot_samediff(sd2, out2, cache=cache)
+        start = len(observe.ledger().events())
+        np.testing.assert_array_equal(
+            sd2.output({"x": x8}, out2)[out2], oracle8)
+        # the symbolic batch dim serves OTHER batch sizes from the same
+        # restored artifact — cache_hit, never new_shape
+        np.testing.assert_array_equal(
+            sd2.output({"x": x3}, out2)[out2], oracle3)
+        evs = [e for e in ledger_window(start) if e.graph == "samediff"]
+        assert evs and all(e.cause == "cache_hit" for e in evs), \
+            [(e.key, e.cause, e.signature) for e in evs]
+
+    def test_warm_boot_misses_on_different_graph(self, tmp_path):
+        # weight VALUES deliberately don't key the cache (variables are
+        # runtime arguments) — a different STRUCTURE must miss
+        cache = aot.ExportCache(str(tmp_path))
+        sd1, out1 = build_sd(seed=0)
+        aot.export_exec(sd1, {"x": np.zeros((2, 4), np.float32)}, out1,
+                        cache=cache)
+        r = np.random.RandomState(0)
+        sd2 = SameDiff.create()
+        x = sd2.placeholder("x", shape=(None, 4))
+        w = sd2.var("w", r.randn(4, 5).astype(np.float32))  # 3 → 5 wide
+        b = sd2.var("b", np.zeros(5, np.float32))
+        out2 = sd2.nn.softmax(sd2.math.tanh(sd2.nn.linear(x, w, b))).name
+        assert not aot.warm_boot_samediff(sd2, out2, cache=cache)
+
+
+# ---------------------------------------------------------------------------
+# Serving engine: warm boot in a config-identical engine, replay clean
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestEngineWarmBoot:
+    def test_replay_over_restored_engine_is_clean(self, tmp_path,
+                                                  monkeypatch):
+        from deeplearning4j_tpu.serving.replay import run_randomized_replay
+        from deeplearning4j_tpu.testing.shapetrace import ShapeTracer
+
+        monkeypatch.setenv(aot.ENV_DIR, str(tmp_path))
+        populate = run_randomized_replay(n_requests=4, seed=3)
+        assert populate["all_terminal"]
+        files = [f for root, _, fs in os.walk(tmp_path)
+                 for f in fs if f.endswith(".json")]
+        assert files, "populating replay stored no cache entries"
+
+        tracer = ShapeTracer()
+        warm = run_randomized_replay(n_requests=4, seed=3)
+        assert warm["all_terminal"]
+        assert warm["first_compile_keys"] == [], warm["first_compile_keys"]
+        assert warm["cache_hit_keys"], "warm leg restored nothing"
+        assert warm["new_shape_events"] == 0
+        assert warm["outputs"] == populate["outputs"], \
+            "restored executables diverged from the populating leg"
+        report = tracer.check(REPO)
+        assert report["ok"], report
+        assert report["by_cause"].get("new_shape", 0) == 0
+        assert report["by_cause"].get("cache_hit", 0) > 0

@@ -165,6 +165,43 @@ class TestGS001Unledgered:
         """, rules={"GS001"})
         assert fs == []
 
+    def test_true_negative_export_sink(self):
+        # autodiff/export.py pattern: the jit flows into jax.export —
+        # the restore side (restore_callable) registers every restored
+        # executable on the ledger, so the export site is ledgered
+        fs = _lint("""
+            import jax
+            from jax import export as jexport
+
+            def export_it(f, specs):
+                jitted = jax.jit(f)
+                return jexport.export(jitted)(*specs)
+        """, rules={"GS001"})
+        assert fs == []
+
+    def test_true_negative_export_sink_dotted(self):
+        fs = _lint("""
+            import jax
+
+            def export_it(f, specs):
+                jitted = jax.jit(f)
+                return jax.export.export(jitted)(*specs)
+        """, rules={"GS001"})
+        assert fs == []
+
+    def test_true_positive_foreign_export_is_not_a_sink(self):
+        # only jax.export/jexport spellings are the AOT sink — another
+        # module's .export() swallowing a jit must still be flagged
+        fs = _lint("""
+            import jax
+            import mymod
+
+            def export_it(f, specs):
+                jitted = jax.jit(f)
+                return mymod.export(jitted)(*specs)
+        """, rules={"GS001"})
+        assert _rules_hit(fs) == {"GS001"}
+
     def test_tools_and_examples_are_out_of_scope(self):
         src = """
             import jax

@@ -59,6 +59,11 @@ Stages:
      every new_shape must land in a statically flagged hazard module,
      and both legs must themselves observe zero new_shape
      (docs/LINT.md § graftshape)
+ 16. aot smoke: tools/aot.py cold-restart warm boot — a fresh process
+     restoring from the persistent export cache must pay zero serving
+     first_compile events (cache_hit only), emit outputs bit-identical
+     to the cache-off leg, and keep cold-start TTFT within 2x
+     (docs/SERVING.md § AOT warm boot)
 
 Exit code 0 = snapshot allowed; anything else = fix first.
 """
@@ -457,6 +462,50 @@ def spec_stage() -> bool:
     return bool(ok)
 
 
+def aot_stage() -> bool:
+    """AOT warm-boot smoke (docs/SERVING.md § AOT warm boot): three
+    fresh processes replay the identical randomized-shape request mix —
+    compile cache off, populating, and warm. The warm restart must pay
+    ZERO serving first_compile ledger events (everything it dispatches
+    arrives as cache_hit), produce outputs bit-identical to the
+    cache-off leg, observe zero new_shape, and keep cold-start TTFT
+    (process boot + first token) within 2x the cache-off leg. One JSON
+    line, like lint/check/obs/chaos/slo/prefix/spec."""
+    print("== gate: aot-smoke (cold-restart warm boot, cache off/on) ==",
+          flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_FAULTS", None)   # ambient faults would distort the
+    env.pop("DL4J_TPU_COMPILE_CACHE", None)  # paired TTFT legs / cache state
+    try:
+        proc = subprocess.run(
+            [sys.executable, "tools/aot.py", "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (aot-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (aot-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    ok = (bool(rec.get("ok"))
+          and rec.get("warm_first_compile_keys") == []
+          and len(rec.get("warm_cache_hit_keys") or []) > 0
+          and rec.get("outputs_identical")
+          and rec.get("new_shape_events") == 0)
+    print(f"   {'ok' if ok else 'FAIL'} (aot-smoke: warm first_compiles="
+          f"{rec.get('warm_first_compile_keys')}, cache_hits="
+          f"{rec.get('warm_cache_hit_keys')}, ttft cold/warm="
+          f"{rec.get('ttft_cold_off_ms')}/{rec.get('ttft_warm_ms')}ms "
+          f"(x{rec.get('cold_restart_ttft_ratio')}), "
+          f"identical={rec.get('outputs_identical')})")
+    return bool(ok)
+
+
 def trainchaos_stage() -> bool:
     """Preemption-proof-training smoke (docs/ROBUSTNESS.md §
     Preemption-proof training): training killed mid-fit by injected
@@ -713,6 +762,7 @@ def main() -> int:
         results["slo"] = slo_stage()
         results["prefix"] = prefix_stage()
         results["spec"] = spec_stage()
+        results["aot"] = aot_stage()
         results["multichip"] = multichip_stage()
 
     failed = [k for k, v in results.items() if not v]
