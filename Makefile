@@ -1,6 +1,6 @@
 # Convenience targets (the CI-role entry points — SURVEY §3.4).
 
-.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline shape-lint check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke cluster-chaos-smoke slo-smoke prefix-smoke spec-smoke aot-smoke locktrace-smoke shapetrace-smoke
+.PHONY: test gate gate-fast bench bench-compile bench-import native native-test lint lint-baseline shape-lint life-lint check check-baseline obs-smoke serve-smoke tune-smoke tune chaos-smoke train-chaos-smoke cluster-chaos-smoke slo-smoke prefix-smoke spec-smoke aot-smoke locktrace-smoke shapetrace-smoke lifetrace-smoke
 
 # graftlint: JAX-footgun static analysis (docs/LINT.md). Fails only on
 # findings NOT grandfathered in lint_baseline.json. JAX_PLATFORMS=cpu so
@@ -17,6 +17,12 @@ lint-baseline:
 # this target is the fast loop while working on shape discipline.
 shape-lint:
 	JAX_PLATFORMS=cpu python tools/graftlint.py --rules GS001,GS002,GS003,GS004,GS005
+
+# graftlife tier alone (docs/LINT.md § graftlife): resource-lifecycle &
+# exactly-once rules GR001-GR005. Already part of `make lint` — this
+# target is the fast loop while working on ownership discipline.
+life-lint:
+	JAX_PLATFORMS=cpu python tools/graftlint.py --rules GR001,GR002,GR003,GR004,GR005
 
 # graftcheck: abstract shape/dtype verification of the SameDiff fixture
 # zoo (docs/ANALYSIS.md). Build-only — no jit, no device. Fails only on
@@ -109,6 +115,18 @@ locktrace-smoke:
 # ONE JSON line like lint/check/obs/chaos/slo/locktrace.
 shapetrace-smoke:
 	JAX_PLATFORMS=cpu python tools/shapetrace.py
+
+# lifetrace smoke (docs/LINT.md § graftlife): runtime cross-validation of
+# the static ownership inventory against live allocators — wraps the real
+# paged-KV caches of a 3-engine prefix cluster in recording proxies,
+# drives a faults-armed workload (page_oom mid-prefix-admission, decode
+# crashes, one engine death) plus an async-checkpoint training leg with a
+# worker death MID-WRITE, then fails unless pages end rc-clean, every
+# request terminal counted exactly once, no thread leaked, and every
+# observed acquire/release callsite lies inside the static inventory.
+# ONE JSON line like lint/check/obs/chaos/slo/locktrace/shapetrace.
+lifetrace-smoke:
+	JAX_PLATFORMS=cpu python tools/lifetrace.py
 
 # prefix-cache smoke (docs/SERVING.md § Radix prefix cache): the shared-
 # prompt replay, cache on vs off with an identical request plan — fails

@@ -169,13 +169,21 @@ class AsyncDataSetIterator(DataSetIterator):
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is DONE:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # DONE / the exception is the worker's last put, so on normal
+            # exits this join is instant; if the consumer abandons the
+            # generator mid-epoch the worker may be blocked on a full
+            # queue — daemon=True plus the bounded join keeps close()
+            # from hanging on it
+            t.join(timeout=1.0)
 
 
 # ---------------------------------------------------------------------------

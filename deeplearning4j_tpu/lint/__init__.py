@@ -17,11 +17,13 @@ from deeplearning4j_tpu.lint.core import (  # noqa: F401
     lint_source, load_baseline, write_baseline)
 
 # register the AST rules on import (graftlock — the GL011-GL014 lock
-# discipline tier — and graftshape — the GS001-GS005 jit-signature tier —
-# ride the same registry; see rules_concurrency / rules_shape)
+# discipline tier —, graftshape — the GS001-GS005 jit-signature tier —
+# and graftlife — the GR001-GR005 resource-lifecycle tier — ride the
+# same registry; see rules_concurrency / rules_shape / rules_lifecycle)
 from deeplearning4j_tpu.lint import rules_ast  # noqa: F401
 from deeplearning4j_tpu.lint import rules_concurrency  # noqa: F401
 from deeplearning4j_tpu.lint import rules_shape  # noqa: F401
+from deeplearning4j_tpu.lint import rules_lifecycle  # noqa: F401
 
 __all__ = ["AST_RULES", "Finding", "diff_baseline", "iter_py_files",
            "lint_paths", "lint_source", "load_baseline", "write_baseline"]

@@ -190,9 +190,13 @@ def write_baseline(path: str, findings: Sequence[Finding],
         "comment": comment,
         "findings": {k: counts[k] for k in sorted(counts)},
     }
-    with open(path, "w", encoding="utf-8") as fh:
+    # atomic: the gate reads this file — a torn baseline would make every
+    # finding look new, so write tmp + os.replace
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=2, sort_keys=False)
         fh.write("\n")
+    os.replace(tmp, path)
     return refused
 
 

@@ -79,8 +79,16 @@ class ModelHub:
             "artifacts": {"model.zip": _sha256(artifact)},
             "metadata": metadata or {},
         }
-        with open(self._manifest_path(name), "w") as f:
+        # atomic publish: load() checksum-verifies against this manifest,
+        # so a torn write would brick the whole entry — write the tmp,
+        # fsync, then os.replace into place
+        mpath = self._manifest_path(name)
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, mpath)
         return d
 
     def load(self, name: str):

@@ -45,6 +45,8 @@ def _vectors_of(model) -> Tuple[List[str], np.ndarray]:
 def write_word2vec_binary(model, path: str) -> None:
     """WordVectorSerializer.writeWord2VecModel (binary) analog."""
     words, mat = _vectors_of(model)
+    # graftlife: justified(GR005): caller-owned export path, not repo durable
+    # state — a torn export is visibly truncated and simply re-exported
     with open(path, "wb") as f:
         f.write(f"{len(words)} {mat.shape[1]}\n".encode("utf-8"))
         for w, row in zip(words, mat):
@@ -83,6 +85,8 @@ def read_word2vec_binary(path: str) -> Tuple[List[str], np.ndarray]:
 def write_word2vec_text(model, path: str) -> None:
     """writeWordVectors (text) analog."""
     words, mat = _vectors_of(model)
+    # graftlife: justified(GR005): caller-owned export path, not repo durable
+    # state — a torn export is visibly truncated and simply re-exported
     with open(path, "w", encoding="utf-8") as f:
         f.write(f"{len(words)} {mat.shape[1]}\n")
         for w, row in zip(words, mat):

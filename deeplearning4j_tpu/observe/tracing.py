@@ -91,6 +91,8 @@ class SpanTracer:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def write(self, path: str) -> None:
+        # graftlife: justified(GR005): human-facing trace dump to a
+        # caller-chosen path — nothing loads it back; re-run to regenerate
         with open(path, "w", encoding="utf-8") as f:
             json.dump(self.to_dict(), f)
 

@@ -38,6 +38,7 @@ the queue before a restore or process exit.
 
 from __future__ import annotations
 
+import glob
 import hashlib
 import json
 import logging
@@ -213,10 +214,9 @@ class _AsyncWriter:
                 self._cv.notify_all()
             t0 = time.perf_counter()
             try:
-                # chaos (docs/ROBUSTNESS.md): worker_death in the WRITER
-                # thread — the checkpoint is lost, training must not be;
-                # the failure surfaces loudly on the next save
-                faults.maybe_fail("worker_death")
+                # chaos (docs/ROBUSTNESS.md): worker_death fires INSIDE the
+                # durable write (see _write_npz) — the checkpoint is lost,
+                # training must not be; the failure surfaces on the next save
                 self._ckpt._write_and_record(step, host_state)
                 dt = time.perf_counter() - t0
                 self._write_h.observe(dt)
@@ -275,6 +275,10 @@ class TrainingCheckpointer:
         self._writer = _AsyncWriter(self, max_queue=max_queue,
                                     overflow=overflow)
         self._load_marker()
+        # a writer killed mid-write (worker_death, SIGKILL) leaves its
+        # step_*.npz.tmp behind — sweep them on restart, before any new
+        # write could be racing for the same names
+        self._cleanup_orphan_tmps()
 
     # ------------------------------------------------------------------ save
     def _state_of(self, net) -> Dict[str, Any]:
@@ -326,6 +330,11 @@ class TrainingCheckpointer:
             f.flush()
             os.fsync(f.fileno())
         checksum = self._sha256_of(tmp)
+        # chaos (docs/ROBUSTNESS.md): worker_death strikes mid-write —
+        # after the bytes land under the tmp name, before the publishing
+        # rename. The checkpoint is lost AND its .tmp is orphaned; the
+        # cleanup hooks (__init__, wait_until_finished) sweep it up.
+        faults.maybe_fail("worker_death")
         os.replace(tmp, path)
         if faults.should_fire("checkpoint_torn_write"):
             # chaos (docs/ROBUSTNESS.md): simulate on-disk corruption
@@ -371,8 +380,24 @@ class TrainingCheckpointer:
         self._writer.submit(step, host_state)
 
     def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
-        """Drain the async queue (call before restore / process exit)."""
-        return self._writer.wait_until_finished(timeout=timeout)
+        """Drain the async queue (call before restore / process exit).
+        Once drained, sweeps any orphaned ``step_*.npz.tmp`` a dead
+        writer left behind — the queue is empty, so nothing is mid-write
+        and every surviving .tmp is garbage."""
+        ok = self._writer.wait_until_finished(timeout=timeout)
+        if ok:
+            self._cleanup_orphan_tmps()
+        return ok
+
+    def _cleanup_orphan_tmps(self) -> None:
+        """Remove orphaned durable-write temporaries. Only call when no
+        write is in flight (fresh __init__, drained queue)."""
+        with self._io_lock:
+            for tmp in glob.glob(os.path.join(self.dir, "step_*.npz.tmp")):
+                try:
+                    os.remove(tmp)
+                except OSError:  # pragma: no cover - best-effort sweep
+                    pass
 
     def drain_failures(self) -> List[Tuple[int, BaseException]]:
         """Take (and clear) any recorded background-write failures WITHOUT

@@ -461,6 +461,9 @@ class ParallelInference:
                 except _queue.Empty:
                     break
                 if item is not None and not item[1].done():
+                    # graftlife: justified(GR003): ParallelInference futures
+                    # are batch-inference calls, not GenerationRequests — the
+                    # FINISH_REASONS taxonomy covers the generative stack only
                     item[1].set_exception(
                         RuntimeError("ParallelInference stopped before this "
                                      "request was served"))
@@ -590,6 +593,9 @@ class ParallelInference:
                               batch_seconds=round(t_done - t_dispatch, 6))
             off = 0
             for fut, sz in zip(futs, sizes):
+                # graftlife: justified(GR003): batch-inference futures, not
+                # GenerationRequests — the FINISH_REASONS taxonomy covers
+                # the generative serving stack only
                 fut.set_result(out[off:off + sz])
                 off += sz
         except Exception as e:  # pragma: no cover - propagate to callers

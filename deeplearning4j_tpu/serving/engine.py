@@ -913,7 +913,19 @@ class GenerativeEngine:
             if not sched.remove_pending(item):
                 continue  # a frontend steal raced us — re-select
             slot = free[0]
-            status, hit_tokens = self._admit_pages(slot, req, match)
+            try:
+                status, hit_tokens = self._admit_pages(slot, req, match)
+            except BaseException:
+                # same unwind as the prefill crash below: admission may
+                # have mapped shared pages / grown the slot before dying
+                # (eviction callback, allocator fault) — release whatever
+                # the slot holds and put the request back at the queue
+                # FRONT so supervision retries it instead of leaking the
+                # pages and stranding the future
+                cache.free_slot(slot)
+                with sched._plock:
+                    sched.pending.appendleft(item)
+                raise
             if status != "ok":
                 # the free-pages precheck passed, so this is injected pool
                 # pressure (faults.page_oom) or an allocator race: complete

@@ -314,6 +314,9 @@ class SlotScheduler:
             spec_accepted_tokens=st.spec_accepted_tokens,
             spec_disabled=st.request.spec_disabled)
         if not st.future.done():
+            # graftlife: justified(GR003): retire() only forms the result —
+            # its callers (engine._retire, frontend._shed_victim) own the
+            # count_terminal(reason) increment, exactly once each
             st.future.set_result(result)
         return result
 

@@ -1,0 +1,235 @@
+"""lifetrace — runtime resource tracer cross-validating graftlife.
+
+The static ownership analyzer (:mod:`..lint.rules_lifecycle`, rules
+GR001–GR005) is an over-approximation built from the AST; this module is
+the under-approximation built from execution: wrap a live
+:class:`~deeplearning4j_tpu.serving.cache.PagedKVCache`'s allocator
+methods in recording proxies, track every submitted request future and
+every started thread, run a (faults-armed) workload, and then hold the
+observed lifecycle against four contracts (:meth:`ResourceTracer.check`):
+
+* **rc-clean pages** — every page ends the leg free XOR tree-held, with
+  the refcount bookkeeping exactly balanced: observed acquisitions
+  (``alloc_page`` successes + ``retain``) minus observed ``release``
+  calls equals the live refcount mass, and
+  :meth:`PagedKVCache.check_invariants` (with the prefix tree's per-page
+  refs when available) holds;
+* **exactly-once terminals** — every tracked request future is done and
+  the ``dl4j_tpu_serving_evicted_total`` family grew by exactly one
+  count per tracked request (the funnel discipline GR003 polices,
+  observed end-to-end);
+* **no leaked threads** — every thread started after :meth:`begin` is
+  dead again by check time (a bounded settle-join absorbs shutdown
+  stragglers — the GR004 contract);
+* **observed ⊆ static inventory** — every acquire/release callsite the
+  wrappers saw lies inside a function span of
+  :func:`..lint.rules_lifecycle.static_ownership_inventory`. An
+  observed callsite outside the inventory means the analyzer's
+  vocabulary missed a lifecycle operation — a graftlife blind spot to
+  fix in ``rules_lifecycle``, not to baseline away.
+
+Wrapping is instance-level (the bound methods are replaced on the one
+cache object), so internal composites stay honest without double
+counting: ``cow_page`` routes through the wrapped ``alloc_page``,
+``map_shared`` through the wrapped ``retain`` and ``free_slot`` through
+the wrapped ``release`` — refcount deltas are counted ONLY on the three
+primitives, while every wrapper records its caller's callsite.
+Instrument BEFORE the workload's threads start.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from deeplearning4j_tpu import observe
+from deeplearning4j_tpu.lint.rules_lifecycle import (
+    OwnershipInventory, static_ownership_inventory)
+
+__all__ = ["ResourceTracer", "static_ownership_inventory"]
+
+_TERMINAL_FAMILY = "dl4j_tpu_serving_evicted_total"
+
+# refcount deltas are counted on the primitives only — composites
+# (cow_page/map_shared/free_slot/ensure_capacity) reach them through the
+# instance-level wrappers and would otherwise double-count
+_PRIMITIVE_DELTA = {"alloc_page": +1, "retain": +1, "release": -1}
+_WRAPPED_OPS = ("alloc_page", "retain", "release", "cow_page",
+                "map_shared", "free_slot")
+
+
+class ResourceTracer:
+    """Lifecycle recorder: page refcount ledger, request-future registry,
+    thread baseline, and acquire/release callsite log."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # (cache, name, tree_refs_fn, baseline_refcount_mass)
+        self._caches: List[Tuple[object, str, Optional[Callable], int]] = []
+        self._acquires = 0
+        self._releases = 0
+        # (op, absolute_file, line)
+        self._sites: Set[Tuple[str, str, int]] = set()
+        self._futures: List[object] = []
+        self._future_ids: Set[int] = set()
+        self._threads_before: Set[int] = set()
+        self._terminals_before = 0.0
+        self.begin()
+
+    # -- baselines -----------------------------------------------------------
+    def begin(self) -> None:
+        """(Re)snapshot the thread set and the terminal-counter mass.
+        Called by ``__init__``; call again to re-baseline mid-session."""
+        with self._mu:
+            self._threads_before = {id(t) for t in threading.enumerate()}
+            self._terminals_before = observe.metrics().family_total(
+                _TERMINAL_FAMILY)
+
+    # -- instrumentation -----------------------------------------------------
+    def attach_cache(self, cache, name: str = "cache",
+                     tree_refs: Optional[Callable] = None) -> None:
+        """Wrap ``cache``'s allocator methods in recording proxies.
+        ``tree_refs`` (e.g. ``prefix.page_refs``) supplies the prefix
+        tree's per-page reference counts for the exact-invariant check."""
+        with self._mu:
+            self._caches.append(
+                (cache, name, tree_refs, sum(cache.refcount)))
+        for op in _WRAPPED_OPS:
+            setattr(cache, op, self._wrap(getattr(cache, op), op))
+
+    def _wrap(self, bound, op: str):
+        delta = _PRIMITIVE_DELTA.get(op)
+
+        def recorded(*args, **kwargs):
+            frame = sys._getframe(1)
+            site = (op, frame.f_code.co_filename, frame.f_lineno)
+            result = bound(*args, **kwargs)
+            with self._mu:
+                self._sites.add(site)
+                if delta is not None:
+                    # a failed alloc_page (pool exhausted -> None)
+                    # acquired nothing
+                    if not (op == "alloc_page" and result is None):
+                        if delta > 0:
+                            self._acquires += 1
+                        else:
+                            self._releases += 1
+            return result
+
+        return recorded
+
+    def attach_engine(self, eng, name: str = "engine") -> None:
+        """Convenience: track every future ``eng.submit_request`` returns
+        (``submit`` delegates to it through the instance attribute, so
+        one wrap sees both entry points — including the cluster router's
+        pin re-warm submissions) and attach its cache with the prefix
+        tree's refs when the engine has one."""
+        tree_refs = eng.prefix.page_refs if eng.prefix is not None else None
+        self.attach_cache(eng.cache, name=f"{name}.cache",
+                          tree_refs=tree_refs)
+        inner = eng.submit_request
+
+        def tracked_submit(req):
+            fut = inner(req)
+            self.track_future(fut)
+            return fut
+
+        eng.submit_request = tracked_submit
+
+    def track_future(self, fut) -> None:
+        """Register a request future for the exactly-once terminal check
+        (idempotent per future object)."""
+        with self._mu:
+            if id(fut) not in self._future_ids:
+                self._future_ids.add(id(fut))
+                self._futures.append(fut)
+
+    # -- results -------------------------------------------------------------
+    def observed_sites(self) -> Set[Tuple[str, str, int]]:
+        with self._mu:
+            return set(self._sites)
+
+    def check(self, repo_root: str = ".",
+              inventory: Optional[OwnershipInventory] = None,
+              settle_s: float = 5.0,
+              build_inventory: bool = True) -> Dict:
+        """The cross-validation verdict (see module docstring). Pass
+        ``build_inventory=False`` to skip the static-inventory callsite
+        validation (the chaos legs do — they assert the runtime contracts
+        on every run without paying an AST walk)."""
+        # threads: give shutdown stragglers a bounded settle window
+        deadline = time.perf_counter() + settle_s
+        while time.perf_counter() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if id(t) not in self._threads_before and t.is_alive()]
+            if not leaked:
+                break
+            time.sleep(0.02)
+        leaked = [t for t in threading.enumerate()
+                  if id(t) not in self._threads_before and t.is_alive()]
+
+        with self._mu:
+            caches = list(self._caches)
+            acquires, releases = self._acquires, self._releases
+            futures = list(self._futures)
+            sites = set(self._sites)
+            terminals_before = self._terminals_before
+
+        # pages: live mass balances the ledger, invariants hold
+        live_mass = 0
+        invariant_errors: List[str] = []
+        for cache, name, tree_refs, baseline in caches:
+            live_mass += sum(cache.refcount) - baseline
+            try:
+                cache.check_invariants(
+                    tree_refs() if tree_refs is not None else None)
+            except AssertionError as e:
+                invariant_errors.append(f"{name}: {e}")
+        rc_balanced = (acquires - releases) == live_mass
+
+        # terminals: exactly one count per tracked request
+        undone = sum(1 for f in futures if not f.done())
+        terminal_delta = (observe.metrics().family_total(_TERMINAL_FAMILY)
+                          - terminals_before)
+        exactly_once = undone == 0 and terminal_delta == len(futures)
+
+        # callsites: observed ⊆ static inventory
+        unknown_sites: List[Dict] = []
+        if inventory is None and build_inventory:
+            inventory = static_ownership_inventory(repo_root)
+        if inventory is not None:
+            root = os.path.abspath(repo_root)
+            for op, fname, line in sorted(sites):
+                rel = os.path.relpath(os.path.abspath(fname), root)
+                if not inventory.attributes_callsite(rel, line):
+                    unknown_sites.append(
+                        {"op": op, "path": rel, "line": line})
+
+        ok = (rc_balanced and not invariant_errors and exactly_once
+              and not leaked and not unknown_sites)
+        return {
+            "ok": ok,
+            "pages": {
+                "caches": [name for _, name, _, _ in caches],
+                "acquires": acquires,
+                "releases": releases,
+                "live_refs": live_mass,
+                "rc_balanced": rc_balanced,
+                "invariant_errors": invariant_errors,
+            },
+            "terminals": {
+                "tracked": len(futures),
+                "undone": undone,
+                "counted": terminal_delta,
+                "exactly_once": exactly_once,
+            },
+            "threads": {"leaked": [t.name for t in leaked]},
+            "callsites": {
+                "observed": len(sites),
+                "validated": inventory is not None,
+                "unknown": unknown_sites,
+            },
+        }

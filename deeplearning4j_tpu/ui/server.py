@@ -353,5 +353,10 @@ class UIServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            # shutdown() only signals serve_forever — join so stop()
+            # returns with the serve thread actually gone
+            self._thread.join(timeout=5.0)
+            self._thread = None
         if _INSTANCE is self:
             _INSTANCE = None

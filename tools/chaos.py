@@ -86,6 +86,7 @@ def run_serving_chaos(n_requests: int, gen_tokens: int):
     from deeplearning4j_tpu.models.gpt import GptConfig, GptModel
     from deeplearning4j_tpu.serving import GenerativeEngine
     from deeplearning4j_tpu.serving.scheduler import FINISH_REASONS
+    from deeplearning4j_tpu.testing.lifetrace import ResourceTracer
 
     cfg = GptConfig.tiny(vocab_size=256)
     model = GptModel(cfg, seed=0)
@@ -101,6 +102,12 @@ def run_serving_chaos(n_requests: int, gen_tokens: int):
     # warm both compiled paths FIRST so the fault schedule exercises
     # recovery, not first-compile latency
     eng.generate([prompts[0][:2]], max_new_tokens=2)
+    # lifecycle tracer (docs/LINT.md § graftlife): every chaos run also
+    # asserts rc-clean pages, exactly-once terminals, and no leaked
+    # threads — created AFTER the warm generate so the terminal ledger
+    # starts at zero
+    tracer = ResourceTracer()
+    tracer.attach_engine(eng)
 
     # the schedule: count-deterministic pool pressure + decode crash (the
     # acceptance-criterion triple, with the torn checkpoint below),
@@ -134,6 +141,10 @@ def run_serving_chaos(n_requests: int, gen_tokens: int):
     unresolved = sum(1 for f in futs if not f.done())
     bad_reasons = [k for k in reasons if k not in FINISH_REASONS]
     eng.cache.check_invariants()
+    # the runtime half of graftlife: rc bookkeeping balanced, every
+    # request exactly one terminal, no leaked threads (the static
+    # inventory walk is the lifetrace smoke's job — skip it here)
+    lifetrace = tracer.check(REPO, build_inventory=False)
     serving_events = [e for e in observe.ledger().events()
                       if e.graph == "serving"]
     new_shape = sum(1 for e in serving_events if e.cause == "new_shape")
@@ -148,6 +159,7 @@ def run_serving_chaos(n_requests: int, gen_tokens: int):
         "stopped_cleanly": eng.stopped_cleanly,
         "new_shape_events": new_shape,
         "invariants_ok": True,  # check_invariants above would have raised
+        "lifetrace": lifetrace,
     }
 
 
@@ -643,6 +655,8 @@ def run_cluster_chaos(n_engines=3, n_requests=18, gen_tokens=8):
                    if e.graph == "serving" and e.cause == "new_shape")
 
     def run_leg(kill: bool):
+        from deeplearning4j_tpu.testing.lifetrace import ResourceTracer
+
         engines = [GenerativeEngine(
             model, max_slots=2, page_size=8, max_pages_per_seq=6,
             max_prompt=16, seed=0, default_deadline_s=300.0,
@@ -651,6 +665,11 @@ def run_cluster_chaos(n_engines=3, n_requests=18, gen_tokens=8):
         router = ClusterRouter(engines)
         for e in engines:  # compile BEFORE the clock (and the kill) start
             e.generate([prompts[0][:2]], max_new_tokens=2, eos_token=-1)
+        # lifecycle tracer per leg (docs/LINT.md § graftlife): rc-clean
+        # exit + exactly-once terminals across death and migration too
+        tracer = ResourceTracer()
+        for e in engines:
+            tracer.attach_engine(e)
         new_shape0 = serving_new_shape()
         # slow_decode at prob 1.0: a deterministic 50ms service floor on
         # both legs, so the single-trial goodput comparison is stable
@@ -680,6 +699,7 @@ def run_cluster_chaos(n_engines=3, n_requests=18, gen_tokens=8):
             or np.array_equal(res.tokens, oracle[i][:len(res.tokens)])
             for i, res in enumerate(results))
         router.check_invariants()
+        lifetrace = tracer.check(REPO, build_inventory=False)
         return {
             "submitted": len(futs),
             "terminal": len(results),
@@ -693,6 +713,7 @@ def run_cluster_chaos(n_engines=3, n_requests=18, gen_tokens=8):
             "goodput_tokens_per_sec": round(done_tokens / max(wall, 1e-9),
                                             2),
             "new_shape_events": serving_new_shape() - new_shape0,
+            "lifetrace": lifetrace,
         }
 
     full = run_leg(kill=False)
@@ -703,6 +724,7 @@ def run_cluster_chaos(n_engines=3, n_requests=18, gen_tokens=8):
                   >= share_left * margin * full["goodput_tokens_per_sec"])
     ok = (full["unresolved"] == 0 and killed["unresolved"] == 0
           and not full["bad_reasons"] and not killed["bad_reasons"]
+          and full["lifetrace"]["ok"] and killed["lifetrace"]["ok"]
           and full["deaths"] == 0
           and killed["deaths"] == 1
           and killed["migrations"] >= 1
@@ -808,6 +830,7 @@ def main() -> int:
           and serving["restarts"] <= serving["max_restarts"]
           and serving["new_shape_events"] == 0
           and serving["stopped_cleanly"]
+          and serving["lifetrace"]["ok"]
           and ckpt["fallback_ok"]
           and frontend["beats_baseline"]
           and frontend["all_terminal"]

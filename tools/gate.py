@@ -59,7 +59,13 @@ Stages:
      every new_shape must land in a statically flagged hazard module,
      and both legs must themselves observe zero new_shape
      (docs/LINT.md § graftshape)
- 16. aot smoke: tools/aot.py cold-restart warm boot — a fresh process
+ 16. lifetrace smoke: tools/lifetrace.py runtime resource-lifecycle
+     cross-validation — the faults-armed prefix cluster + async
+     checkpoint workload must end with rc-clean pages, exactly one
+     terminal count per request, zero leaked threads, every observed
+     acquire/release callsite inside graftlife's static ownership
+     inventory, and zero new_shape (docs/LINT.md § graftlife)
+ 17. aot smoke: tools/aot.py cold-restart warm boot — a fresh process
      restoring from the persistent export cache must pay zero serving
      first_compile events (cache_hit only), emit outputs bit-identical
      to the cache-off leg, and keep cold-start TTFT within 2x
@@ -684,6 +690,53 @@ def shapetrace_stage() -> bool:
     return bool(ok)
 
 
+def lifetrace_stage() -> bool:
+    """Lifetrace smoke (docs/LINT.md § graftlife): runtime
+    resource-lifecycle cross-validation of the static ownership
+    inventory — fails unless the faults-armed cluster + checkpoint
+    workload ends rc-clean (observed acquires - releases == live
+    refcount mass, allocator invariants hold), every tracked request
+    terminal is counted exactly once, no thread leaks, every observed
+    acquire/release callsite lies inside a static inventory span, and
+    the recoveries paid zero new_shape. One JSON line, like
+    lint/check/obs/chaos/locktrace/shapetrace."""
+    print("== gate: lifetrace-smoke (resource tracer vs static "
+          "ownership inventory) ==", flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "tools/lifetrace.py"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        print("   FAIL (lifetrace-smoke timeout)")
+        return False
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("{") and '"tool"' in l), None)
+    if line:
+        print(f"   {line}")
+    if proc.returncode != 0 or line is None:
+        tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+        print(f"   FAIL (lifetrace-smoke exit {proc.returncode})\n{tail}")
+        return False
+    rec = json.loads(line)
+    pages = rec.get("pages") or {}
+    terms = rec.get("terminals") or {}
+    ok = (bool(rec.get("ok"))
+          and pages.get("rc_balanced")
+          and not pages.get("invariant_errors")
+          and terms.get("exactly_once")
+          and not (rec.get("threads") or {}).get("leaked")
+          and not (rec.get("callsites") or {}).get("unknown")
+          and (rec.get("new_shape_events") or 0) == 0)
+    print(f"   {'ok' if ok else 'FAIL'} (lifetrace-smoke: "
+          f"{pages.get('acquires')} acquires / {pages.get('releases')} "
+          f"releases, live {pages.get('live_refs')}, terminals "
+          f"{terms.get('counted')}/{terms.get('tracked')}, "
+          f"{len((rec.get('callsites') or {}).get('unknown') or [])} "
+          f"unknown callsites, new_shape {rec.get('new_shape_events')})")
+    return bool(ok)
+
+
 def multichip_stage() -> bool:
     """Multichip dryrun with explicit skipped-status passthrough: the
     hardened __graft_entry__.dryrun_multichip prints ONE JSON line with
@@ -759,6 +812,7 @@ def main() -> int:
         results["cluster"] = cluster_stage()
         results["locktrace"] = locktrace_stage()
         results["shapetrace"] = shapetrace_stage()
+        results["lifetrace"] = lifetrace_stage()
         results["slo"] = slo_stage()
         results["prefix"] = prefix_stage()
         results["spec"] = spec_stage()

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Lifetrace smoke — runtime cross-validation of the graftlife static
+resource-lifecycle analyzer (docs/LINT.md § graftlife, docs/ROBUSTNESS.md
+§ Ownership rules).
+
+Wraps the REAL paged-KV allocators of a live cluster in
+``testing/lifetrace.py`` recording proxies, drives a faults-armed
+workload, and checks the lifecycle honesty contract:
+
+  * rc-clean pages: every page ends free XOR tree-held, the observed
+    acquire/release ledger exactly balances the live refcount mass, and
+    the allocator invariants (exact per-page accounting against the
+    prefix tree) hold;
+  * exactly-once terminals: every submitted request future is done and
+    the ``dl4j_tpu_serving_evicted_total`` family grew by exactly one
+    count per request — through oom unwinds, decode crashes, and
+    whole-engine death;
+  * no leaked threads;
+  * every observed acquire/release callsite lies inside the static
+    ownership inventory (``lint/rules_lifecycle.
+    static_ownership_inventory``) — an unknown callsite is a graftlife
+    blind spot to fix in the analyzer, not to baseline;
+  * zero ``new_shape`` recompiles across all the injected recoveries.
+
+Two legs, one shared tracer:
+
+  serving    3 engines with radix prefix caches behind a ClusterRouter;
+             shared-prefix traffic under page_oom (fires through prefix
+             admission, shared pages already mapped), decode_step_error
+             (supervised restarts), and one engine_death (cluster
+             migration + pin re-warm)
+  training   async TrainingCheckpointer with a worker_death fired
+             MID-WRITE — the failure surfaces on the next save, the
+             orphaned ``*.npz.tmp`` is swept by wait_until_finished,
+             and a compensating sync save restores durability
+
+Contract (same as lint/check/chaos): ONE JSON summary line on stdout
+with ``"tool": "lifetrace"``; exit 0 iff ``ok``. ``make lifetrace-smoke``
+pins JAX_PLATFORMS=cpu; ``tools/gate.py``'s ``lifetrace`` stage
+enforces it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+import tempfile
+import time
+import types
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fake_net(value: float, seed: int = 0):
+    r = np.random.RandomState(seed)
+    net = types.SimpleNamespace()
+    net.params = {"W": (r.randn(8, 8) * 0 + value).astype(np.float32)}
+    net.opt_state = {"W": np.zeros((8, 8), np.float32)}
+    net.net_state = {}
+    net.iteration_count = int(value)
+    net.epoch_count = 0
+    return net
+
+
+def leg_serving(tracer, n_requests: int, gen_tokens: int) -> dict:
+    """Prefix-enabled cluster under the full fault triple. The tracer
+    sees every alloc/retain/release/cow/map_shared/free_slot on all
+    three caches, and every future the router hands out (pin re-warm
+    submissions included — they route through the wrapped
+    ``submit_request``)."""
+    from deeplearning4j_tpu import faults, observe
+    from deeplearning4j_tpu.models.gpt import GptConfig, GptModel
+    from deeplearning4j_tpu.serving import ClusterRouter, GenerativeEngine
+    from deeplearning4j_tpu.serving.scheduler import FINISH_REASONS
+
+    n_engines = 3
+    cfg = GptConfig.tiny(vocab_size=256)
+    model = GptModel(cfg, seed=0)
+    engines = [GenerativeEngine(
+        model, max_slots=2, page_size=8, max_pages_per_seq=6,
+        max_prompt=16, seed=0, default_deadline_s=300.0, max_restarts=6,
+        restart_backoff_s=0.01, prefix_pages=8, suffix_bucket=8)
+        for _ in range(n_engines)]
+    r = np.random.RandomState(3)
+    sysp = r.randint(1, cfg.vocab_size, size=11).astype(np.int32)
+    for e in engines:  # compile + seed the shared prefix BEFORE the clock
+        e.generate([np.concatenate([sysp, np.asarray([7], np.int32)])],
+                   max_new_tokens=2, eos_token=-1)
+
+    def serving_new_shape():
+        return sum(1 for e in observe.ledger().events()
+                   if e.graph == "serving" and e.cause == "new_shape")
+
+    new_shape0 = serving_new_shape()
+    m = observe.metrics()
+
+    def fired(point):
+        return int(m.counter("dl4j_tpu_faults_injected_total",
+                             point=point).value)
+
+    before = {p: fired(p)
+              for p in ("page_oom", "decode_step_error", "engine_death")}
+    # the warm-up generates above completed (and counted) 3 untracked
+    # requests — re-baseline so the exactly-once ledger starts at zero
+    tracer.begin()
+    for i, e in enumerate(engines):
+        tracer.attach_engine(e, name=f"engine{i}")
+    router = ClusterRouter(engines)
+
+    # the schedule: injected pool pressure lands mid-prefix-admission
+    # (shared pages already mapped — the GR001 unwind under test), decode
+    # crashes burn supervised restarts, and one whole engine dies
+    # mid-flight forcing migration + pin re-warm
+    faults.arm("page_oom", prob=1.0, after_n=2, max_fires=2)
+    faults.arm("decode_step_error", prob=1.0, after_n=4, max_fires=2)
+    faults.arm("engine_death", prob=1.0, after_n=3 * n_engines,
+               max_fires=1)
+    router.start()
+    try:
+        futs = []
+        for _ in range(n_requests):
+            tail = r.randint(1, cfg.vocab_size,
+                             size=int(r.randint(1, 4))).astype(np.int32)
+            futs.append(router.submit(np.concatenate([sysp, tail]),
+                                      max_new_tokens=gen_tokens,
+                                      eos_token=-1, max_retries=4))
+        results = [f.result(timeout=600) for f in futs]
+    finally:
+        router.stop()
+        faults.reset()
+    reasons: dict = {}
+    for res in results:
+        reasons[res.finish_reason] = reasons.get(res.finish_reason, 0) + 1
+    fires = {p: fired(p) - before[p] for p in before}
+    return {
+        "submitted": len(futs),
+        "unresolved": sum(1 for f in futs if not f.done()),
+        "reasons": reasons,
+        "bad_reasons": [k for k in reasons if k not in FINISH_REASONS],
+        "deaths": router.deaths,
+        "migrations": router.migrations,
+        "fired": fires,
+        "new_shape_events": serving_new_shape() - new_shape0,
+        "ok": (sum(1 for f in futs if not f.done()) == 0
+               and not [k for k in reasons if k not in FINISH_REASONS]
+               and router.deaths == 1
+               and all(v >= 1 for v in fires.values())
+               and serving_new_shape() - new_shape0 == 0),
+    }
+
+
+def leg_training(n_saves: int) -> dict:
+    """Async checkpointing with a worker death fired MID-WRITE: the tmp
+    is orphaned, the failure surfaces on the next save, the
+    ``wait_until_finished`` sweep removes the orphan, and a compensating
+    sync save leaves a restorable newest checkpoint."""
+    from deeplearning4j_tpu import faults
+    from deeplearning4j_tpu.parallel.checkpoint import TrainingCheckpointer
+
+    with tempfile.TemporaryDirectory(prefix="lifetrace_train_") as d:
+        ck = TrainingCheckpointer(d, keep_last=None, use_orbax=False,
+                                  max_queue=2, overflow="block")
+        # the 2nd async write dies between fsync and the publishing
+        # rename — exactly the orphaned-tmp window
+        faults.arm("worker_death", prob=1.0, after_n=1, max_fires=1)
+        try:
+            for step in range(n_saves):
+                ck.save_async(step, _fake_net(float(step)))
+            drained = ck.wait_until_finished(timeout=120)
+        finally:
+            faults.reset()
+        failures = ck.drain_failures()
+        orphans = _glob.glob(os.path.join(d, "step_*.npz.tmp"))
+        # compensating sync save: durability restored after the death
+        ck.save(n_saves, _fake_net(float(n_saves)))
+        net = _fake_net(-1.0)
+        restored = ck.restore(net)
+        ck.close()
+        return {
+            "saves": n_saves,
+            "drained": bool(drained),
+            "writer_deaths": len(failures),
+            "orphan_tmps_after_drain": len(orphans),
+            "restored_step": restored,
+            "ok": (bool(drained) and len(failures) == 1
+                   and len(orphans) == 0 and restored == n_saves),
+        }
+
+
+def run(n_requests: int, gen_tokens: int, n_saves: int) -> dict:
+    from deeplearning4j_tpu.testing.lifetrace import ResourceTracer
+
+    tracer = ResourceTracer()
+    legs = {
+        "serving": leg_serving(tracer, n_requests, gen_tokens),
+        "training": leg_training(n_saves),
+    }
+    report = tracer.check(repo_root=REPO)
+    return {
+        "tool": "lifetrace",
+        "ok": bool(report["ok"] and legs["serving"]["ok"]
+                   and legs["training"]["ok"]
+                   and report["callsites"]["observed"] > 0),
+        "pages": report["pages"],
+        "terminals": report["terminals"],
+        "threads": report["threads"],
+        "callsites": report["callsites"],
+        "new_shape_events": legs["serving"]["new_shape_events"],
+        "legs": legs,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=12,
+                    help="requests through the cluster leg")
+    ap.add_argument("--tokens", type=int, default=6,
+                    help="max new tokens per request")
+    ap.add_argument("--saves", type=int, default=5,
+                    help="async checkpoint saves in the training leg")
+    args = ap.parse_args()
+    t0 = time.perf_counter()
+    summary = run(args.requests, args.tokens, args.saves)
+    summary["elapsed_s"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
